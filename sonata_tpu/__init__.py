@@ -1,0 +1,36 @@
+"""sonata-tpu: a TPU-native neural text-to-speech serving framework.
+
+Capability-parity rebuild of mush42/sonata (see SURVEY.md) designed
+TPU-first: the VITS compute path is JAX/XLA (jit/pjit over a device mesh,
+Pallas for hot fused ops), the runtime around it is Python + C++ (phonemizer
+shim, prosody DSP, C ABI), and the frontends (CLI, gRPC, Python, C) mirror
+the reference's surface.
+"""
+
+__version__ = "0.1.0"
+
+from .core import (
+    AudioInfo,
+    BaseModel,
+    FailedToLoadResource,
+    Model,
+    OperationError,
+    Phonemes,
+    PhonemizationError,
+    SonataError,
+)
+from .audio import Audio, AudioSamples
+
+__all__ = [
+    "__version__",
+    "AudioInfo",
+    "BaseModel",
+    "FailedToLoadResource",
+    "Model",
+    "OperationError",
+    "Phonemes",
+    "PhonemizationError",
+    "SonataError",
+    "Audio",
+    "AudioSamples",
+]

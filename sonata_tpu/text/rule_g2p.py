@@ -1,0 +1,170 @@
+"""Hermetic rule-based grapheme→IPA fallback backend.
+
+The reference depends unconditionally on a patched eSpeak-ng C library plus
+~100 compiled dictionary files vendored in-tree
+(``deps/dev/espeak-ng-data``, SURVEY §2.2).  This environment ships neither,
+and a TPU serving framework should not hard-fail when the optional native
+G2P is absent: this module provides a deterministic, dependency-free
+letter-to-sound backend good enough for tests, benchmarks, and development.
+Production deployments use the eSpeak backend
+(:class:`sonata_tpu.text.phonemizer.EspeakBackend`) when libespeak-ng is
+installed.
+
+Output is genuine IPA over the same symbol inventory Piper voices use in
+their ``phoneme_id_map`` (config JSON next to each voice), so phoneme-id
+encoding works unchanged with real voice configs.
+"""
+
+from __future__ import annotations
+
+import re
+
+# -- small lexicon of irregular / very common words -------------------------
+_LEXICON = {
+    "a": "ə", "an": "æn", "the": "ðə", "of": "ʌv", "to": "tuː", "and": "ænd",
+    "in": "ɪn", "is": "ɪz", "it": "ɪt", "you": "juː", "that": "ðæt",
+    "he": "hiː", "she": "ʃiː", "was": "wʌz", "for": "fɔːɹ", "on": "ɑːn",
+    "are": "ɑːɹ", "as": "æz", "with": "wɪð", "his": "hɪz", "her": "hɜːɹ",
+    "they": "ðeɪ", "i": "aɪ", "at": "æt", "be": "biː", "this": "ðɪs",
+    "have": "hæv", "from": "fɹʌm", "or": "ɔːɹ", "one": "wʌn", "had": "hæd",
+    "by": "baɪ", "word": "wɜːd", "but": "bʌt", "not": "nɑːt", "what": "wʌt",
+    "all": "ɔːl", "were": "wɜːɹ", "we": "wiː", "when": "wɛn", "your": "jʊɹ",
+    "can": "kæn", "said": "sɛd", "there": "ðɛɹ", "use": "juːz", "each": "iːtʃ",
+    "which": "wɪtʃ", "do": "duː", "how": "haʊ", "their": "ðɛɹ", "if": "ɪf",
+    "will": "wɪl", "way": "weɪ", "about": "əbaʊt", "many": "mɛni",
+    "then": "ðɛn", "them": "ðɛm", "would": "wʊd", "like": "laɪk",
+    "so": "soʊ", "these": "ðiːz", "some": "sʌm", "two": "tuː",
+    "more": "mɔːɹ", "very": "vɛɹi", "time": "taɪm", "could": "kʊd",
+    "no": "noʊ", "my": "maɪ", "than": "ðæn", "been": "bɪn", "who": "huː",
+    "its": "ɪts", "now": "naʊ", "people": "piːpəl", "made": "meɪd",
+    "over": "oʊvɚ", "did": "dɪd", "down": "daʊn", "only": "oʊnli",
+    "little": "lɪɾəl", "world": "wɜːld", "good": "ɡʊd", "me": "miː",
+    "our": "aʊɚ", "out": "aʊt", "up": "ʌp", "other": "ʌðɚ", "new": "nuː",
+    "work": "wɜːk", "first": "fɜːst", "water": "wɔːɾɚ", "after": "æftɚ",
+    "where": "wɛɹ", "through": "θɹuː", "hello": "həloʊ", "test": "tɛst",
+    "speech": "spiːtʃ", "voice": "vɔɪs", "sound": "saʊnd", "once": "wʌns",
+    "says": "sɛz", "does": "dʌz", "gone": "ɡɔːn", "come": "kʌm",
+    "alice": "ælɪs", "here": "hɪɹ", "any": "ɛni", "again": "əɡɛn",
+}
+
+# -- ordered letter-to-sound rules ------------------------------------------
+# (pattern, ipa) — longest-match-first within position scanning.
+_RULES: list[tuple[str, str]] = [
+    ("tion", "ʃən"), ("sion", "ʒən"), ("ture", "tʃɚ"), ("ought", "ɔːt"),
+    ("aught", "ɔːt"), ("eigh", "eɪ"), ("igh", "aɪ"), ("tch", "tʃ"),
+    ("dge", "dʒ"), ("sch", "sk"), ("ing", "ɪŋ"),
+    ("th", "θ"), ("sh", "ʃ"), ("ch", "tʃ"), ("ph", "f"), ("wh", "w"),
+    ("qu", "kw"), ("ck", "k"), ("ng", "ŋ"), ("gh", "ɡ"), ("kn", "n"),
+    ("wr", "ɹ"), ("mb", "m"),
+    ("ee", "iː"), ("ea", "iː"), ("oo", "uː"), ("ou", "aʊ"), ("ow", "oʊ"),
+    ("ai", "eɪ"), ("ay", "eɪ"), ("oa", "oʊ"), ("oi", "ɔɪ"), ("oy", "ɔɪ"),
+    ("au", "ɔː"), ("aw", "ɔː"), ("ew", "uː"), ("ey", "eɪ"), ("ie", "iː"),
+    ("ar", "ɑːɹ"), ("er", "ɚ"), ("ir", "ɜː"), ("or", "ɔːɹ"), ("ur", "ɜː"),
+    ("a", "æ"), ("b", "b"), ("c", "k"), ("d", "d"), ("e", "ɛ"), ("f", "f"),
+    ("g", "ɡ"), ("h", "h"), ("i", "ɪ"), ("j", "dʒ"), ("k", "k"), ("l", "l"),
+    ("m", "m"), ("n", "n"), ("o", "ɑː"), ("p", "p"), ("r", "ɹ"), ("s", "s"),
+    ("t", "t"), ("u", "ʌ"), ("v", "v"), ("w", "w"), ("x", "ks"),
+    ("y", "j"), ("z", "z"),
+]
+
+_ONES = ["zero", "one", "two", "three", "four", "five", "six", "seven",
+         "eight", "nine", "ten", "eleven", "twelve", "thirteen", "fourteen",
+         "fifteen", "sixteen", "seventeen", "eighteen", "nineteen"]
+_TENS = ["", "", "twenty", "thirty", "forty", "fifty", "sixty", "seventy",
+         "eighty", "ninety"]
+
+# -- Arabic letters → IPA (MSA, broad) --------------------------------------
+_ARABIC = {
+    "ا": "aː", "ب": "b", "ت": "t", "ث": "θ", "ج": "dʒ", "ح": "ħ", "خ": "x",
+    "د": "d", "ذ": "ð", "ر": "r", "ز": "z", "س": "s", "ش": "ʃ", "ص": "sˤ",
+    "ض": "dˤ", "ط": "tˤ", "ظ": "ðˤ", "ع": "ʕ", "غ": "ɣ", "ف": "f",
+    "ق": "q", "ك": "k", "ل": "l", "م": "m", "ن": "n", "ه": "h", "و": "w",
+    "ي": "j", "ء": "ʔ", "ى": "aː", "ة": "a", "أ": "ʔa", "إ": "ʔi",
+    "آ": "ʔaː", "ؤ": "ʔ", "ئ": "ʔ",
+    # diacritics (possibly inserted by the tashkeel stage)
+    "َ": "a", "ُ": "u", "ِ": "i", "ّ": "ː",
+    "ً": "an", "ٌ": "un", "ٍ": "in", "ْ": "",
+}
+
+
+def number_to_words(n: int) -> str:
+    if n < 0:
+        return "minus " + number_to_words(-n)
+    if n < 20:
+        return _ONES[n]
+    if n < 100:
+        t, o = divmod(n, 10)
+        return _TENS[t] + (" " + _ONES[o] if o else "")
+    if n < 1000:
+        h, r = divmod(n, 100)
+        return _ONES[h] + " hundred" + (" " + number_to_words(r) if r else "")
+    if n < 1_000_000:
+        k, r = divmod(n, 1000)
+        return number_to_words(k) + " thousand" + (" " + number_to_words(r) if r else "")
+    m, r = divmod(n, 1_000_000)
+    return number_to_words(m) + " million" + (" " + number_to_words(r) if r else "")
+
+
+def normalize_text(text: str) -> str:
+    """Lowercase, expand integers, drop symbols the G2P cannot speak."""
+    def _num(m: re.Match) -> str:
+        try:
+            return " " + number_to_words(int(m.group(0))) + " "
+        except ValueError:
+            return " "
+
+    text = re.sub(r"\d+", _num, text)
+    return text.lower()
+
+
+def english_word_to_ipa(word: str) -> str:
+    hit = _LEXICON.get(word)
+    if hit is not None:
+        return hit
+    out: list[str] = []
+    i = 0
+    # final silent 'e' lengthens the previous vowel (rough magic-e rule)
+    magic_e = len(word) > 2 and word.endswith("e") and word[-2] not in "aeiou"
+    body = word[:-1] if magic_e else word
+    while i < len(body):
+        if body[i] == "y" and i == len(body) - 1:
+            out.append("i")  # word-final y is a vowel ("twenty" → …ti)
+            break
+        for pat, ipa in _RULES:
+            if body.startswith(pat, i):
+                out.append(ipa)
+                i += len(pat)
+                break
+        else:
+            i += 1  # unknown character: drop
+    ipa = "".join(out)
+    if magic_e:
+        # lengthen the rightmost short vowel ("fine" → faɪn, "alone" → əloʊn)
+        pairs = (("æ", "eɪ"), ("ɪ", "aɪ"), ("ɑː", "oʊ"), ("ʌ", "uː"),
+                 ("ɛ", "iː"))
+        best = max(pairs, key=lambda p: ipa.rfind(p[0]))
+        idx = ipa.rfind(best[0])
+        if idx >= 0:
+            ipa = ipa[:idx] + best[1] + ipa[idx + len(best[0]):]
+    return ipa
+
+
+def arabic_word_to_ipa(word: str) -> str:
+    return "".join(_ARABIC.get(ch, "") for ch in word)
+
+
+def phonemize_clause(text: str, voice: str = "en-us") -> str:
+    """Phonemize one clause of text into a single IPA string.
+
+    Words become space-separated IPA runs, matching the shape of eSpeak
+    output the downstream phoneme-id encoder expects (spaces are real
+    symbols in Piper's ``phoneme_id_map``).
+    """
+    lang = voice.split("-")[0].lower()
+    # \w excludes combining marks (category Mn), which would strip the very
+    # diacritics the tashkeel stage inserts — include the Arabic harakat range
+    words = re.findall(r"[\w'\u064B-\u0655\u0670]+",
+                       normalize_text(text), flags=re.UNICODE)
+    to_ipa = arabic_word_to_ipa if lang in ("ar", "fa", "ur") else english_word_to_ipa
+    ipa_words = [to_ipa(w) for w in words]
+    return " ".join(w for w in ipa_words if w)

@@ -1,0 +1,19 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Multi-chip sharding tests run on a virtual mesh
+(``--xla_force_host_platform_device_count=8``) so the suite is hermetic on
+any machine; real-TPU execution is exercised by bench.py and the driver's
+graft entry checks instead.  This must run before jax initializes a backend,
+hence module-level in conftest.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Keep XLA/CPU from oversubscribing the (possibly single-core) test machine.
+os.environ.setdefault("XLA_PYTHON_CLIENT_PREALLOCATE", "false")

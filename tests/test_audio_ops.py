@@ -1,0 +1,154 @@
+"""Audio-ops unit tests.
+
+Mirrors the reference's tier-1 suite (``crates/audio/ops/src/samples.rs:
+282-350``): fade_in / fade_out / overlap / lowpass / highpass / normalize /
+strip_silence on tiny literal vectors, plus WAV round-trip and Audio/RTF
+coverage the reference lacks.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from sonata_tpu import AudioInfo
+from sonata_tpu.audio import (
+    Audio,
+    AudioSamples,
+    get_hann_window,
+    read_wave_file,
+    write_wave_samples_to_buffer,
+    write_wave_samples_to_file,
+)
+from sonata_tpu.audio.wave_io import WaveWriterError
+
+
+def test_fade_in_ramps_from_zero():
+    s = AudioSamples([1.0] * 8).fade_in(4)
+    assert s.data[0] == 0.0
+    assert np.all(np.diff(s.data[:4]) > 0)
+    assert np.allclose(s.data[4:], 1.0)
+
+
+def test_fade_out_ramps_to_near_zero():
+    s = AudioSamples([1.0] * 8).fade_out(4)
+    assert np.allclose(s.data[:4], 1.0)
+    assert np.all(np.diff(s.data[4:]) < 0)
+    assert s.data[-1] == pytest.approx(math.cos(math.pi / 2 * 3 / 4), abs=1e-6)
+
+
+def test_crossfade_tapers_both_ends():
+    s = AudioSamples([1.0] * 10).crossfade(3)
+    assert s.data[0] == 0.0
+    assert s.data[-1] < 1.0
+    assert np.allclose(s.data[3:7], 1.0)
+
+
+def test_overlap_with_sums_to_constant_power_on_constant_input():
+    a = AudioSamples([1.0] * 6)
+    b = AudioSamples([1.0] * 6)
+    a.overlap_with(b, overlap=4)
+    assert len(a) == 8
+    # sin+cos ramps on equal signals stay bounded and continuous
+    assert np.all(a.data > 0.9)
+    assert np.all(a.data < 1.5)
+
+
+def test_overlap_with_zero_overlap_concatenates():
+    a = AudioSamples([1.0, 2.0])
+    a.overlap_with(AudioSamples([3.0, 4.0]), overlap=0)
+    assert np.allclose(a.data, [1, 2, 3, 4])
+
+
+def test_lowpass_clamps_amplitude():
+    s = AudioSamples([0.1, 0.5, -0.9, 0.2]).lowpass_filter(0.3)
+    assert np.allclose(s.data, [0.1, 0.3, -0.3, 0.2])
+
+
+def test_highpass_gates_amplitude():
+    s = AudioSamples([0.1, 0.5, -0.9, 0.2]).highpass_filter(0.3)
+    assert np.allclose(s.data, [0.0, 0.5, -0.9, 0.0])
+
+
+def test_normalize_hits_unit_peak():
+    s = AudioSamples([0.1, -0.5, 0.25]).normalize()
+    assert np.max(np.abs(s.data)) == pytest.approx(1.0)
+    assert s.data[1] == pytest.approx(-1.0)
+
+
+def test_strip_silence_trims_edges():
+    s = AudioSamples([0.0, 0.001, 0.5, -0.4, 0.001, 0.0]).strip_silence(0.01)
+    assert np.allclose(s.data, [0.5, -0.4])
+
+
+def test_strip_silence_all_quiet_empties():
+    s = AudioSamples([0.001, -0.002]).strip_silence(0.01)
+    assert len(s) == 0
+
+
+def test_to_i16_peak_normalizes():
+    s = AudioSamples([0.0, 0.5, -0.5])
+    i = s.to_i16()
+    assert i.dtype == np.int16
+    assert abs(int(i[1])) == 32767
+
+
+def test_to_i16_silence_floor_prevents_blowup():
+    s = AudioSamples([0.0, 0.001, -0.001])
+    i = s.to_i16()
+    # peak floored at 0.01 → 0.001 maps to ~3276, not full scale
+    assert abs(int(i[1])) < 4000
+
+
+def test_merge_concatenates():
+    a = AudioSamples([1.0]).merge(AudioSamples([2.0, 3.0]))
+    assert np.allclose(a.data, [1, 2, 3])
+
+
+def test_hann_window_cached_and_symmetric():
+    w = get_hann_window(256)
+    assert w is get_hann_window(256)  # cache hit
+    assert w[0] == pytest.approx(0.0)
+    assert np.allclose(w, w[::-1], atol=1e-6)
+    w5 = get_hann_window(5)
+    assert w5[2] == pytest.approx(1.0)
+
+
+def test_apply_hanning_window():
+    s = AudioSamples([1.0] * 64).apply_hanning_window()
+    assert s.data[0] == pytest.approx(0.0)
+    assert np.max(s.data) <= 1.0
+
+
+def test_audio_duration_and_rtf():
+    a = Audio(AudioSamples(np.zeros(22050)), AudioInfo(22050), inference_ms=100.0)
+    assert a.duration_ms() == pytest.approx(1000.0)
+    assert a.real_time_factor() == pytest.approx(0.1)
+
+
+def test_wave_round_trip(tmp_path):
+    samples = (np.sin(np.linspace(0, 40 * np.pi, 2205)) * 20000).astype(np.int16)
+    path = tmp_path / "t.wav"
+    write_wave_samples_to_file(path, samples, 22050)
+    back, sr, ch = read_wave_file(path)
+    assert sr == 22050 and ch == 1
+    assert np.array_equal(back, samples)
+
+
+def test_wave_buffer_header():
+    buf = write_wave_samples_to_buffer(np.zeros(10, dtype=np.int16), 16000)
+    assert buf[:4] == b"RIFF" and buf[8:12] == b"WAVE"
+    assert len(buf) == 44 + 20
+
+
+def test_wave_writer_rejects_bad_dtype():
+    with pytest.raises(WaveWriterError):
+        write_wave_samples_to_buffer(np.zeros(4, dtype=np.float32), 16000)
+
+
+def test_audio_save_to_file(tmp_path):
+    a = Audio(AudioSamples(np.sin(np.linspace(0, 10, 100))), AudioInfo(16000))
+    p = tmp_path / "a.wav"
+    a.save_to_file(p)
+    back, sr, _ = read_wave_file(p)
+    assert sr == 16000 and len(back) == 100

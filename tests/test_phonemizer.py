@@ -1,0 +1,151 @@
+"""Phonemizer tests.
+
+Ports the reference's 8 FFI integration tests
+(``crates/text/espeak-phonemizer/src/lib.rs:160-252``) to the hermetic
+rule-based backend: basic en-US, sentence-count on an Alice quote, separator
+insertion, clause-breaker preservation, Arabic phonemization, language-switch
+flag stripping, stress stripping, newline splitting.  Unlike the reference —
+which must force single-threaded tests because eSpeak's globals race
+(``espeak-phonemizer/.cargo/config.toml:1-5``) — our backends are
+lock-serialized, and we test that concurrency directly.
+"""
+
+import concurrent.futures
+
+from sonata_tpu.core import Phonemes
+from sonata_tpu.text import (
+    RuleG2PBackend,
+    split_clauses,
+    split_sentences,
+    text_to_phonemes,
+)
+
+BACKEND = RuleG2PBackend()
+
+ALICE = (
+    "Alice was beginning to get very tired of sitting by her sister on the "
+    "bank. So she was considering in her own mind, as well as she could."
+)
+
+
+def phonemize(text, **kw):
+    kw.setdefault("backend", BACKEND)
+    return text_to_phonemes(text, **kw)
+
+
+def test_basic_en_us():
+    # reference: "test" → "tˈɛst." (lib.rs:165-172); rule backend is unstressed
+    ph = phonemize("test")
+    assert len(ph) == 1
+    assert ph[0] == "tɛst."
+
+
+def test_sentence_count_alice():
+    ph = phonemize(ALICE)
+    assert len(ph) == 2
+
+
+def test_separator_insertion():
+    ph = phonemize("test", separator="_")
+    assert "_" in ph[0]
+    assert ph[0].replace("_", "") == "tɛst."
+
+
+def test_clause_breaker_preserved():
+    ph = phonemize("hello, world.")
+    assert len(ph) == 1
+    assert "," in ph[0]
+    assert ph[0].endswith(".")
+
+
+def test_arabic_phonemization():
+    ph = phonemize("مرحبا بالعالم", voice="ar")
+    assert len(ph) == 1
+    assert len(ph[0]) > 2  # produced real phonemes
+
+
+def test_language_switch_flag_stripping():
+    class Flagged:
+        name = "fake"
+
+        def phonemize_clause(self, text, voice):
+            return "(en)tɛst(ar)"
+
+    ph = text_to_phonemes("x", backend=Flagged(), remove_lang_switch_flags=True)
+    assert ph[0] == "tɛst."
+    ph2 = text_to_phonemes("x", backend=Flagged())
+    assert "(en)" in ph2[0]
+
+
+def test_stress_stripping():
+    class Stressed:
+        name = "fake"
+
+        def phonemize_clause(self, text, voice):
+            return "tˈɛstˌɪŋ"
+
+    ph = text_to_phonemes("x", backend=Stressed(), remove_stress=True)
+    assert ph[0] == "tɛstɪŋ."
+    ph2 = text_to_phonemes("x", backend=Stressed())
+    assert "ˈ" in ph2[0]
+
+
+def test_newline_splitting():
+    ph = phonemize("hello world\ngood people")
+    assert len(ph) == 2
+
+
+def test_question_terminator():
+    ph = phonemize("can you hear me?")
+    assert ph[0].endswith("?")
+
+
+def test_numbers_expanded():
+    ph = phonemize("I have 21 tests")
+    assert len(ph) == 1
+    # 21 → "twenty one" → contains IPA for twenty (begins with t) — at
+    # minimum, digits never appear in output
+    assert not any(c.isdigit() for c in ph[0])
+
+
+def test_abbreviation_not_sentence_break():
+    sents = split_sentences("Dr. Smith went home. He was tired.")
+    assert len(sents) == 2
+
+
+def test_split_clauses_metadata():
+    clauses = split_clauses("hello, world! are you there?")
+    assert [c.terminator for c in clauses] == [",", "!", "?"]
+    assert [c.sentence_end for c in clauses] == [False, True, True]
+
+
+def test_phonemes_container():
+    ph = Phonemes(["a", "b"])
+    ph.append("c")
+    assert len(ph) == 3 and ph.to_string("|") == "a|b|c"
+
+
+def test_concurrent_phonemization_is_safe():
+    # the reference cannot run this (eSpeak global state); our backends are
+    # serialized by design (SURVEY §5 latent-race fix)
+    with concurrent.futures.ThreadPoolExecutor(8) as ex:
+        results = list(ex.map(lambda i: phonemize(ALICE)[0], range(32)))
+    assert len(set(results)) == 1
+
+
+def test_pronoun_i_ends_sentence():
+    sents = split_sentences("It was I. He left.")
+    assert sents == ["It was I.", "He left."]
+
+
+def test_dotted_abbreviations_not_split():
+    assert split_sentences("Use it, e.g. like this. Then stop.") == [
+        "Use it, e.g. like this.", "Then stop.",
+    ]
+    assert len(split_sentences("Meet at 5 p.m. tomorrow. OK?")) == 2
+
+
+def test_arabic_diacritics_survive_g2p():
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    assert phonemize_clause("مَرحَبا", "ar") == "marħabaː"
