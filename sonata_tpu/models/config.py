@@ -1,0 +1,194 @@
+"""Piper voice configuration: JSON schema, synthesis params, phoneme-id
+encoding, and VITS architecture hyper-parameters.
+
+Parity targets (reference ``crates/sonata/models/piper/src/lib.rs``):
+
+- ``ModelConfig`` fields mirror the Piper ``*.json`` sidecar the reference
+  deserializes (``:144-158``): audio.sample_rate/quality, num_speakers,
+  speaker_id_map, streaming flag, espeak.voice, inference scales,
+  num_symbols, phoneme_id_map.
+- ``SynthesisConfig`` mirrors ``PiperSynthesisConfig{speaker, noise_scale,
+  length_scale, noise_w}`` (``:161-166``), seeded from the file (``:54-59``)
+  and mutable at runtime behind a lock (``:215-231``).
+- ``phonemes_to_ids`` reproduces the interleaved-pad encoding exactly
+  (``:232-250``): ``[bos]``, then ``[id, pad]`` per IPA char, then
+  ``[eos]``; unknown chars silently dropped (``:243``); BOS/EOS/PAD are the
+  characters ``^ $ _`` resolved through the map (``:20-22,173-179``).
+
+The architecture section has no reference counterpart — the reference runs a
+black-box ONNX graph; we instantiate the graph natively, so the dims live in
+:class:`VitsHyperParams` (quality presets match Piper's training configs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from pathlib import Path
+from typing import Optional, Union
+
+from ..core import FailedToLoadResource
+
+BOS_CHAR = "^"
+EOS_CHAR = "$"
+PAD_CHAR = "_"
+
+
+@dataclasses.dataclass
+class SynthesisConfig:
+    """Runtime-tunable synthesis parameters (``piper/src/lib.rs:161-166``)."""
+
+    speaker: Optional[tuple[str, int]] = None  # (name, sid)
+    noise_scale: float = 0.667
+    length_scale: float = 1.0
+    noise_w: float = 0.8
+
+    def copy(self) -> "SynthesisConfig":
+        return dataclasses.replace(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class VitsHyperParams:
+    """VITS graph dimensions.  Defaults = Piper medium/high quality
+    (22.05 kHz, hop 256)."""
+
+    inter_channels: int = 192
+    hidden_channels: int = 192
+    filter_channels: int = 768
+    n_heads: int = 2
+    n_layers: int = 6
+    kernel_size: int = 3
+    attn_window: int = 4
+    resblock_kernel_sizes: tuple[int, ...] = (3, 7, 11)
+    resblock_dilation_sizes: tuple[tuple[int, ...], ...] = (
+        (1, 3, 5), (1, 3, 5), (1, 3, 5),
+    )
+    upsample_rates: tuple[int, ...] = (8, 8, 2, 2)
+    upsample_initial_channel: int = 512
+    upsample_kernel_sizes: tuple[int, ...] = (16, 16, 4, 4)
+    gin_channels: int = 512
+    # stochastic duration predictor
+    dp_filter_channels: int = 192
+    dp_kernel_size: int = 3
+    dp_n_flows: int = 4
+    dp_num_bins: int = 10
+    dp_tail_bound: float = 5.0
+    # flow
+    flow_n_layers: int = 4
+    flow_wn_layers: int = 4
+    flow_kernel_size: int = 5
+
+    @property
+    def hop_length(self) -> int:
+        h = 1
+        for r in self.upsample_rates:
+            h *= r
+        return h
+
+
+# Piper quality presets.  "x_low" voices are 16 kHz with a slimmer decoder;
+# low/medium/high share the 22.05 kHz geometry (quality differs by training).
+QUALITY_PRESETS: dict[str, dict] = {
+    "x_low": dict(
+        hidden_channels=96, inter_channels=96, filter_channels=384,
+        upsample_initial_channel=256,
+    ),
+    "low": {},
+    "medium": {},
+    "high": {},
+}
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    """Parsed Piper voice config (``piper/src/lib.rs:144-158``)."""
+
+    sample_rate: int
+    quality: Optional[str]
+    num_speakers: int
+    speaker_id_map: dict[str, int]
+    streaming: bool
+    espeak_voice: str
+    num_symbols: int
+    phoneme_id_map: dict[str, list[int]]
+    inference: SynthesisConfig
+    hyper: VitsHyperParams
+    language: Optional[str] = None
+    path: Optional[Path] = None
+
+    @classmethod
+    def from_dict(cls, d: dict, path: Optional[Path] = None) -> "ModelConfig":
+        audio = d.get("audio", {})
+        espeak = d.get("espeak", {})
+        inference = d.get("inference", {})
+        quality = audio.get("quality")
+        lang = d.get("language")
+        if isinstance(lang, dict):
+            lang = lang.get("code") or lang.get("family")
+        preset = dict(QUALITY_PRESETS.get(quality or "", {}))
+        preset.update(d.get("model", {}))  # our extension: explicit dims
+        hyper = VitsHyperParams(**preset)
+        sc = SynthesisConfig(
+            noise_scale=float(inference.get("noise_scale", 0.667)),
+            length_scale=float(inference.get("length_scale", 1.0)),
+            noise_w=float(inference.get("noise_w", 0.8)),
+        )
+        return cls(
+            sample_rate=int(audio.get("sample_rate", 22050)),
+            quality=quality,
+            num_speakers=int(d.get("num_speakers", 1)),
+            speaker_id_map={str(k): int(v)
+                            for k, v in (d.get("speaker_id_map") or {}).items()},
+            streaming=bool(d.get("streaming", False)),
+            espeak_voice=str(espeak.get("voice", "en-us")),
+            num_symbols=int(d.get("num_symbols", 256)),
+            phoneme_id_map={str(k): [int(i) for i in v]
+                            for k, v in (d.get("phoneme_id_map") or {}).items()},
+            inference=sc,
+            hyper=hyper,
+            language=lang,
+            path=path,
+        )
+
+    @classmethod
+    def from_path(cls, config_path: Union[str, Path]) -> "ModelConfig":
+        p = Path(config_path)
+        try:
+            data = json.loads(p.read_text(encoding="utf-8"))
+        except (OSError, json.JSONDecodeError) as e:
+            raise FailedToLoadResource(f"cannot load voice config {p}: {e}") from e
+        return cls.from_dict(data, path=p)
+
+    # -- speaker helpers (reference core/src/lib.rs:95-113) -----------------
+    def reversed_speaker_map(self) -> dict[int, str]:
+        return {v: k for k, v in self.speaker_id_map.items()}
+
+    # -- phoneme-id encoding (piper/src/lib.rs:232-250) ---------------------
+    def phonemes_to_ids(self, phonemes: str) -> list[int]:
+        id_map = self.phoneme_id_map
+        pad = id_map.get(PAD_CHAR, [0])
+        ids: list[int] = list(id_map.get(BOS_CHAR, [1]))
+        for ch in phonemes:
+            mapped = id_map.get(ch)
+            if mapped is None:
+                continue  # unknown chars silently dropped (:243)
+            ids.extend(mapped)
+            ids.extend(pad)  # interleaved pad after every phoneme
+        ids.extend(id_map.get(EOS_CHAR, [2]))
+        return ids
+
+
+def default_phoneme_id_map() -> dict[str, list[int]]:
+    """A self-contained IPA symbol table for voices created without a Piper
+    JSON (tests, randomly-initialized voices).  Same structural conventions
+    as Piper: ``_`` pad=0, ``^`` bos=1, ``$`` eos=2, then punctuation,
+    space, and the IPA inventory."""
+    symbols = ["_", "^", "$", " ", "!", "'", ",", "-", ".", ":", ";", "?"]
+    ipa = (
+        "abcdefhijklmnopqrstuvwxzæçðøħŋœǀǁǂǃɐɑɒɓɔɕɖɗɘəɚɛɜɞɟɠɡɢɣɤɥɦɧɨɪɫɬɭɮɯɰ"
+        "ɱɲɳɴɵɶɸɹɺɻɽɾʀʁʂʃʄʈʉʊʋʌʍʎʏʐʑʒʔʕʘʙʛʜʝʟʡʢʰʲʷʼˈˌːˑ˞ˤ̩̪̯̺̻̃̊"
+        "βθχᵻⱱ"
+    )
+    symbols.extend(dict.fromkeys(ipa))
+    return {s: [i] for i, s in enumerate(symbols)}

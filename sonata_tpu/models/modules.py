@@ -1,0 +1,423 @@
+"""Neural building blocks for VITS, as pure JAX functions over param pytrees.
+
+Design notes (TPU-first, not a port):
+
+- The reference never contains this math — it executes a black-box ONNX graph
+  via onnxruntime (``crates/sonata/models/piper/src/lib.rs:342-399``).  These
+  modules re-implement the *architecture* of Piper-flavor VITS (text encoder
+  with windowed relative attention, stochastic duration predictor over
+  rational-quadratic-spline flows, residual-coupling flow with WaveNet
+  blocks, HiFi-GAN decoder) natively in JAX so XLA owns fusion/layout.
+- Everything is ``[batch, time, channels]`` (NTC): the lane dimension maps to
+  channels, convs lower to MXU matmuls, and no transposes are needed between
+  attention and conv blocks.
+- Params are plain nested dicts (a JAX pytree).  Each block has
+  ``init_*(rng, ...) -> params`` and a pure ``apply`` function, so the whole
+  model jits/pjits and weights import cleanly from Piper torch checkpoints.
+- Masks are explicit ``[B, T, 1]`` float tensors; all shapes static — no
+  data-dependent control flow anywhere (XLA traces once per bucket).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = dict
+
+LRELU_SLOPE = 0.1
+
+
+# ---------------------------------------------------------------------------
+# initializers
+# ---------------------------------------------------------------------------
+
+def _normal(rng, shape, std=0.02):
+    return jax.random.normal(rng, shape, dtype=jnp.float32) * std
+
+
+def _conv_init(rng, k, c_in, c_out):
+    # kaiming-uniform-ish fan-in scaling, matching torch conv defaults
+    bound = 1.0 / math.sqrt(c_in * k)
+    w_rng, b_rng = jax.random.split(rng)
+    return {
+        "w": jax.random.uniform(w_rng, (k, c_in, c_out), jnp.float32, -bound, bound),
+        "b": jax.random.uniform(b_rng, (c_out,), jnp.float32, -bound, bound),
+    }
+
+
+# ---------------------------------------------------------------------------
+# conv primitives (NTC layout)
+# ---------------------------------------------------------------------------
+
+def conv1d(x, p, *, dilation: int = 1, stride: int = 1,
+           padding: str | int = "SAME"):
+    """1-D convolution, ``x: [B, T, C_in]``, weight ``[K, C_in, C_out]``."""
+    if isinstance(padding, int):
+        pad = [(padding, padding)]
+    elif padding == "SAME":
+        k_eff = (p["w"].shape[0] - 1) * dilation + 1
+        pad = [(k_eff // 2, k_eff - 1 - k_eff // 2)]
+    else:
+        pad = padding
+    y = lax.conv_general_dilated(
+        x, p["w"], window_strides=(stride,), padding=pad,
+        rhs_dilation=(dilation,),
+        dimension_numbers=("NHC", "HIO", "NHC"),
+    )
+    return y + p["b"]
+
+
+def conv_transpose1d(x, p, *, stride: int, padding: int):
+    """Transposed 1-D conv matching torch ``ConvTranspose1d`` semantics.
+
+    ``x: [B, T, C_in]``, weight stored ``[K, C_in, C_out]``.  Output length is
+    ``(T-1)*stride - 2*padding + K`` — identical to torch, so HiFi-GAN
+    upsample stacks produce exactly ``T * prod(rates)`` samples when
+    ``padding=(K-stride)//2`` with even ``K-stride``.
+    """
+    k = p["w"].shape[0]
+    y = lax.conv_general_dilated(
+        x, jnp.flip(p["w"], 0), window_strides=(1,),
+        padding=[(k - 1 - padding, k - 1 - padding)],
+        lhs_dilation=(stride,),
+        dimension_numbers=("NHC", "HIO", "NHC"),
+    )
+    return y + p["b"]
+
+
+def layer_norm(x, p, eps: float = 1e-5):
+    """LayerNorm over channels (last dim)."""
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * lax.rsqrt(var + eps) * p["gamma"] + p["beta"]
+
+
+def init_layer_norm(c):
+    return {"gamma": jnp.ones((c,)), "beta": jnp.zeros((c,))}
+
+
+# ---------------------------------------------------------------------------
+# windowed relative-position multi-head attention (VITS text encoder)
+# ---------------------------------------------------------------------------
+
+def init_rel_attention(rng, channels: int, n_heads: int, window: int):
+    head = channels // n_heads
+    rngs = jax.random.split(rng, 6)
+    std = (head ** -0.5)
+    return {
+        "q": _conv_init(rngs[0], 1, channels, channels),
+        "k": _conv_init(rngs[1], 1, channels, channels),
+        "v": _conv_init(rngs[2], 1, channels, channels),
+        "o": _conv_init(rngs[3], 1, channels, channels),
+        # learned relative embeddings over [-window, window]
+        "emb_rel_k": _normal(rngs[4], (1, 2 * window + 1, head), std),
+        "emb_rel_v": _normal(rngs[5], (1, 2 * window + 1, head), std),
+    }
+
+
+def _rel_to_abs(x):
+    """[B*H, T, 2T-1] relative-indexed logits → [B*H, T, T] absolute."""
+    b, t, _ = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (0, 1)))
+    x = x.reshape(b, t * 2 * t)
+    x = jnp.pad(x, ((0, 0), (0, t - 1)))
+    x = x.reshape(b, t + 1, 2 * t - 1)
+    return x[:, :t, t - 1:]
+
+
+def _abs_to_rel(x):
+    """[B*H, T, T] absolute attention weights → [B*H, T, 2T-1] relative."""
+    b, t, _ = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (0, t - 1)))
+    x = x.reshape(b, t * (2 * t - 1))
+    x = jnp.pad(x, ((0, 0), (t, 0)))
+    x = x.reshape(b, t, 2 * t)
+    return x[:, :, 1:]
+
+
+def _rel_embeddings(emb, window, t):
+    """Slice/pad the learned [-window, window] table to [2T-1] positions."""
+    pad = max(t - window - 1, 0)
+    start = max(window + 1 - t, 0)
+    emb = jnp.pad(emb, ((0, 0), (pad, pad), (0, 0)))
+    return lax.dynamic_slice_in_dim(emb, start, 2 * t - 1, axis=1)
+
+
+def rel_attention(x, mask, p, *, n_heads: int, window: int):
+    """Self-attention with learned relative position embeddings, window
+    ±``window`` (VITS text encoder uses window=4).
+
+    ``x: [B, T, C]``, ``mask: [B, T, 1]`` (1 = valid).
+    """
+    b, t, c = x.shape
+    head = c // n_heads
+    q = conv1d(x, p["q"])
+    k = conv1d(x, p["k"])
+    v = conv1d(x, p["v"])
+
+    def split(u):  # [B, T, C] -> [B*H, T, head]
+        return u.reshape(b, t, n_heads, head).transpose(0, 2, 1, 3).reshape(
+            b * n_heads, t, head
+        )
+
+    q, k, v = split(q), split(k), split(v)
+    scale = head ** -0.5
+    logits = jnp.einsum("btd,bsd->bts", q * scale, k)
+    # relative key contribution
+    rel_k = _rel_embeddings(p["emb_rel_k"], window, t)  # [1, 2T-1, head]
+    rel_logits = jnp.einsum("btd,msd->bts", q * scale, rel_k)
+    logits = logits + _rel_to_abs(rel_logits)
+
+    attn_mask = (mask[:, None, :, 0] * mask[:, :, None, 0])  # [B, T, T]
+    attn_mask = jnp.repeat(attn_mask, n_heads, axis=0).reshape(b * n_heads, t, t)
+    logits = jnp.where(attn_mask > 0, logits, -1e4)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bts,bsd->btd", weights, v)
+    # relative value contribution
+    rel_v = _rel_embeddings(p["emb_rel_v"], window, t)  # [1, 2T-1, head]
+    out = out + jnp.einsum("btm,bmd->btd", _abs_to_rel(weights), rel_v)
+
+    out = out.reshape(b, n_heads, t, head).transpose(0, 2, 1, 3).reshape(b, t, c)
+    return conv1d(out, p["o"]) * mask
+
+
+# ---------------------------------------------------------------------------
+# conv feed-forward (VITS encoder FFN)
+# ---------------------------------------------------------------------------
+
+def init_ffn(rng, channels, filter_channels, kernel):
+    r1, r2 = jax.random.split(rng)
+    return {
+        "c1": _conv_init(r1, kernel, channels, filter_channels),
+        "c2": _conv_init(r2, kernel, filter_channels, channels),
+    }
+
+
+def ffn(x, mask, p):
+    y = conv1d(x * mask, p["c1"])
+    y = jax.nn.relu(y)
+    return conv1d(y * mask, p["c2"]) * mask
+
+
+# ---------------------------------------------------------------------------
+# transformer encoder stack
+# ---------------------------------------------------------------------------
+
+def init_transformer(rng, *, channels, filter_channels, n_heads, n_layers,
+                     kernel, window):
+    layers = []
+    for i in range(n_layers):
+        r = jax.random.fold_in(rng, i)
+        r1, r2 = jax.random.split(r)
+        layers.append({
+            "attn": init_rel_attention(r1, channels, n_heads, window),
+            "ln1": init_layer_norm(channels),
+            "ffn": init_ffn(r2, channels, filter_channels, kernel),
+            "ln2": init_layer_norm(channels),
+        })
+    return {"layers": layers}
+
+
+def transformer(x, mask, p, *, n_heads, window):
+    """Post-norm transformer: x = LN(x + attn(x)); x = LN(x + ffn(x))."""
+    x = x * mask
+    for layer in p["layers"]:
+        y = rel_attention(x, mask, layer["attn"], n_heads=n_heads, window=window)
+        x = layer_norm(x + y, layer["ln1"])
+        y = ffn(x, mask, layer["ffn"])
+        x = layer_norm(x + y, layer["ln2"])
+    return x * mask
+
+
+# ---------------------------------------------------------------------------
+# WaveNet block (used by the coupling flow)
+# ---------------------------------------------------------------------------
+
+def init_wn(rng, *, hidden, kernel, dilation_rate, n_layers, gin_channels=0):
+    in_layers, res_skip = [], []
+    for i in range(n_layers):
+        r = jax.random.fold_in(rng, i)
+        r1, r2 = jax.random.split(r)
+        dil = dilation_rate ** i
+        in_layers.append(_conv_init(r1, kernel, hidden, 2 * hidden))
+        out_ch = 2 * hidden if i < n_layers - 1 else hidden
+        res_skip.append(_conv_init(r2, 1, hidden, out_ch))
+    p = {"in": in_layers, "res_skip": res_skip}
+    if gin_channels:
+        p["cond"] = _conv_init(jax.random.fold_in(rng, 999), 1, gin_channels,
+                               2 * hidden * n_layers)
+    return p
+
+
+def fused_gate(a, b):
+    """tanh/sigmoid gated activation: tanh(x+g_a) * sigmoid(y+g_b).
+
+    The WaveNet hot op; kept as a seam for a Pallas fused kernel
+    (:mod:`sonata_tpu.ops.gate`) — XLA already fuses this well, so the
+    default path is plain jnp.
+    """
+    return jnp.tanh(a) * jax.nn.sigmoid(b)
+
+
+def wn(x, mask, p, *, kernel, dilation_rate, n_layers, g=None):
+    """Non-causal WaveNet: dilated convs, gated tanh units, residual+skip.
+
+    ``x: [B, T, H]``; ``g: [B, 1, gin]`` speaker conditioning or None.
+    """
+    hidden = x.shape[-1]
+    output = jnp.zeros_like(x)
+    if g is not None and "cond" in p:
+        g_all = conv1d(g, p["cond"])  # [B, 1, 2*H*n_layers]
+    for i in range(n_layers):
+        x_in = conv1d(x, p["in"][i], dilation=dilation_rate ** i)
+        if g is not None and "cond" in p:
+            g_l = lax.dynamic_slice_in_dim(g_all, i * 2 * hidden, 2 * hidden, axis=2)
+            x_in = x_in + g_l
+        acts = fused_gate(x_in[..., :hidden], x_in[..., hidden:])
+        rs = conv1d(acts, p["res_skip"][i])
+        if i < n_layers - 1:
+            x = (x + rs[..., :hidden]) * mask
+            output = output + rs[..., hidden:]
+        else:
+            output = output + rs
+    return output * mask
+
+
+# ---------------------------------------------------------------------------
+# DDSConv — dilated depth-separable convs (duration predictor backbone)
+# ---------------------------------------------------------------------------
+
+def init_dds_conv(rng, *, channels, kernel, n_layers):
+    layers = []
+    for i in range(n_layers):
+        r = jax.random.fold_in(rng, i)
+        r1, r2 = jax.random.split(r)
+        layers.append({
+            # depthwise stored [K, 1, C] and applied with feature_group_count
+            "dw": {"w": _normal(r1, (kernel, 1, channels),
+                                1.0 / math.sqrt(kernel)),
+                   "b": jnp.zeros((channels,))},
+            "pw": _conv_init(r2, 1, channels, channels),
+            "ln1": init_layer_norm(channels),
+            "ln2": init_layer_norm(channels),
+        })
+    return {"layers": layers}
+
+
+def dds_conv(x, mask, p, *, kernel: int, g=None):
+    if g is not None:
+        x = x + g
+    c = x.shape[-1]
+    for i, layer in enumerate(p["layers"]):
+        dilation = kernel ** i
+        k_eff = (kernel - 1) * dilation + 1
+        pad = k_eff // 2
+        y = lax.conv_general_dilated(
+            x * mask, layer["dw"]["w"], window_strides=(1,),
+            padding=[(pad, k_eff - 1 - pad)], rhs_dilation=(dilation,),
+            dimension_numbers=("NHC", "HIO", "NHC"),
+            feature_group_count=c,
+        ) + layer["dw"]["b"]
+        y = jax.nn.gelu(layer_norm(y, layer["ln1"]))
+        y = conv1d(y, layer["pw"])
+        y = jax.nn.gelu(layer_norm(y, layer["ln2"]))
+        x = x + y
+    return x * mask
+
+
+# ---------------------------------------------------------------------------
+# rational-quadratic spline (inverse mode) — ConvFlow transform
+# ---------------------------------------------------------------------------
+
+DEFAULT_MIN_BIN_WIDTH = 1e-3
+DEFAULT_MIN_BIN_HEIGHT = 1e-3
+DEFAULT_MIN_DERIVATIVE = 1e-3
+
+
+def rational_quadratic_spline_inverse(
+    y, unnorm_widths, unnorm_heights, unnorm_derivs, *, tail_bound: float
+):
+    """Inverse pass of an unconstrained monotonic rational-quadratic spline
+    (Durkan et al., Neural Spline Flows).  Identity outside
+    ``[-tail_bound, tail_bound]``.
+
+    All inputs broadcast elementwise with a trailing ``num_bins`` dim on the
+    parameter tensors.  Fully vectorized; no data-dependent control flow, so
+    it jits to a single fused XLA computation.
+    """
+    num_bins = unnorm_widths.shape[-1]
+    inside = (y >= -tail_bound) & (y <= tail_bound)
+
+    widths = jax.nn.softmax(unnorm_widths, axis=-1)
+    widths = DEFAULT_MIN_BIN_WIDTH + (1 - DEFAULT_MIN_BIN_WIDTH * num_bins) * widths
+    cumwidths = jnp.cumsum(widths, axis=-1)
+    cumwidths = jnp.pad(cumwidths, [(0, 0)] * (cumwidths.ndim - 1) + [(1, 0)])
+    cumwidths = (2 * tail_bound) * cumwidths - tail_bound
+    widths = cumwidths[..., 1:] - cumwidths[..., :-1]
+
+    derivs = DEFAULT_MIN_DERIVATIVE + jax.nn.softplus(unnorm_derivs)
+    # boundary derivatives pinned to 1 (linear tails)
+    pad_val = math.log(math.exp(1 - DEFAULT_MIN_DERIVATIVE) - 1)
+    derivs = jnp.concatenate(
+        [jnp.full_like(derivs[..., :1], DEFAULT_MIN_DERIVATIVE
+                       + jax.nn.softplus(jnp.float32(pad_val))),
+         derivs,
+         jnp.full_like(derivs[..., :1], DEFAULT_MIN_DERIVATIVE
+                       + jax.nn.softplus(jnp.float32(pad_val)))],
+        axis=-1,
+    )
+
+    heights = jax.nn.softmax(unnorm_heights, axis=-1)
+    heights = DEFAULT_MIN_BIN_HEIGHT + (1 - DEFAULT_MIN_BIN_HEIGHT * num_bins) * heights
+    cumheights = jnp.cumsum(heights, axis=-1)
+    cumheights = jnp.pad(cumheights, [(0, 0)] * (cumheights.ndim - 1) + [(1, 0)])
+    cumheights = (2 * tail_bound) * cumheights - tail_bound
+    heights = cumheights[..., 1:] - cumheights[..., :-1]
+
+    y_in = jnp.clip(y, -tail_bound, tail_bound)
+    # locate bin by cumheights (inverse mode): one-hot over bins
+    idx = jnp.sum((y_in[..., None] >= cumheights[..., :-1]).astype(jnp.int32),
+                  axis=-1) - 1
+    idx = jnp.clip(idx, 0, num_bins - 1)
+
+    def gather(t):
+        return jnp.take_along_axis(t, idx[..., None], axis=-1)[..., 0]
+
+    in_cumwidths = gather(cumwidths[..., :-1])
+    in_widths = gather(widths)
+    in_cumheights = gather(cumheights[..., :-1])
+    in_heights = gather(heights)
+    in_delta = in_heights / in_widths
+    in_d = gather(derivs[..., :-1])
+    in_d_plus = gather(derivs[..., 1:])
+
+    # solve the quadratic for xi (Durkan et al. eq. 6-8, inverse)
+    rel_y = y_in - in_cumheights
+    term = rel_y * (in_d + in_d_plus - 2 * in_delta)
+    a = in_heights * (in_delta - in_d) + term
+    b = in_heights * in_d - term
+    c = -in_delta * rel_y
+    disc = b * b - 4 * a * c
+    disc = jnp.maximum(disc, 0.0)
+    xi = (2 * c) / (-b - jnp.sqrt(disc))
+    xi = jnp.clip(xi, 0.0, 1.0)
+    x_val = xi * in_widths + in_cumwidths
+
+    # log|det d y / d x| (forward direction), negated by the caller if needed
+    denom = in_delta + (in_d + in_d_plus - 2 * in_delta) * xi * (1 - xi)
+    nom = in_delta ** 2 * (
+        in_d_plus * xi ** 2 + 2 * in_delta * xi * (1 - xi) + in_d * (1 - xi) ** 2
+    )
+    logabsdet = jnp.log(jnp.maximum(nom, 1e-12)) - 2 * jnp.log(
+        jnp.maximum(denom, 1e-12)
+    )
+
+    x_out = jnp.where(inside, x_val, y)
+    logabsdet = jnp.where(inside, logabsdet, 0.0)
+    return x_out, logabsdet

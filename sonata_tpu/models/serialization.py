@@ -1,0 +1,70 @@
+"""Native parameter serialization: flat-keyed ``.npz`` archives.
+
+The reference's "model state" is an immutable ONNX file next to the JSON
+config (SURVEY §5 checkpoint/resume).  Our native equivalent is a numpy
+``.npz`` holding the flattened param pytree — loadable with zero
+dependencies, mmap-friendly, and the target format the ONNX/torch importers
+convert into.  (Orbax is used for sharded multi-host checkpoints in
+:mod:`sonata_tpu.parallel`; a single-voice file doesn't need it.)
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Union
+
+import jax
+import numpy as np
+
+SEP = "/"
+
+
+def flatten_params(params) -> dict[str, np.ndarray]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        key = SEP.join(_segment(s) for s in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def _segment(entry) -> str:
+    if isinstance(entry, jax.tree_util.DictKey):
+        return str(entry.key)
+    if isinstance(entry, jax.tree_util.SequenceKey):
+        return str(entry.idx)
+    return str(entry)
+
+
+def unflatten_params(flat: dict[str, np.ndarray]):
+    """Rebuild the nested dict/list pytree from flat keys."""
+    root: dict = {}
+    for key, value in flat.items():
+        parts = key.split(SEP)
+        node = root
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return _listify(root)
+
+
+def _listify(node):
+    """Convert dicts whose keys are 0..n-1 into lists (restores pytree
+    structure of layer stacks)."""
+    if not isinstance(node, dict):
+        return node
+    out = {k: _listify(v) for k, v in node.items()}
+    keys = list(out.keys())
+    if keys and all(k.isdigit() for k in keys):
+        idx = sorted(int(k) for k in keys)
+        if idx == list(range(len(idx))):
+            return [out[str(i)] for i in idx]
+    return out
+
+
+def save_params(path: Union[str, Path], params) -> None:
+    np.savez(Path(path), **flatten_params(params))
+
+
+def load_params(path: Union[str, Path]):
+    with np.load(Path(path)) as data:
+        return unflatten_params({k: data[k] for k in data.files})

@@ -1,0 +1,349 @@
+"""Piper-flavor VITS, implemented natively in JAX.
+
+The reference executes this model as a black-box ONNX graph through
+onnxruntime (``crates/sonata/models/piper/src/lib.rs:342-399`` single-graph;
+``:537-574`` + ``:736-762`` encoder/decoder split).  Here the graph is
+re-implemented as pure functions so XLA compiles it straight to TPU:
+
+- ``encode_text``    — text encoder + stochastic duration predictor
+                       → frame durations and phoneme-level priors.
+- ``acoustics``      — length regulation (generate_path), prior sampling,
+                       residual-coupling flow (reverse) → latent ``z``.
+- ``decode``         — HiFi-GAN generator: ``z`` → waveform.
+- ``infer``          — the composition, one jittable graph.
+
+The encode/decode split mirrors the reference's streaming
+``VitsStreamingModel`` contract (``EncoderOutputs{z, y_mask, g}`` →
+decoder slices of ``z``, ``piper/src/lib.rs:671-762``), but the split point
+is chosen for TPU: everything with data-dependent sizing (durations) lives
+in ``encode_text``; ``acoustics``/``decode`` take static frame buckets so
+each bucket compiles once and is reused.
+
+RNG is explicit: the reference's ``scales``-driven noise is generated inside
+the ONNX graph; here the caller passes a ``jax.random`` key so batched
+synthesis draws independent noise per sentence (SURVEY §7 "RNG semantics").
+
+All tensors are ``[batch, time, channels]``; masks ``[B, T, 1]``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .config import VitsHyperParams
+from . import modules as m
+
+Params = dict
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def init_text_encoder(rng, hp: VitsHyperParams, n_vocab: int) -> Params:
+    r_emb, r_enc, r_proj = jax.random.split(rng, 3)
+    return {
+        "emb": jax.random.normal(r_emb, (n_vocab, hp.hidden_channels))
+        * (hp.hidden_channels ** -0.5),
+        "encoder": m.init_transformer(
+            r_enc, channels=hp.hidden_channels,
+            filter_channels=hp.filter_channels, n_heads=hp.n_heads,
+            n_layers=hp.n_layers, kernel=hp.kernel_size, window=hp.attn_window,
+        ),
+        "proj": m._conv_init(r_proj, 1, hp.hidden_channels, 2 * hp.inter_channels),
+    }
+
+
+def init_duration_predictor(rng, hp: VitsHyperParams, gin: int) -> Params:
+    rngs = jax.random.split(rng, 8)
+    filt = hp.dp_filter_channels
+    p: Params = {
+        "pre": m._conv_init(rngs[0], 1, hp.hidden_channels, filt),
+        "convs": m.init_dds_conv(rngs[1], channels=filt,
+                                 kernel=hp.dp_kernel_size, n_layers=3),
+        "proj": m._conv_init(rngs[2], 1, filt, filt),
+        "affine": {"m": jnp.zeros((2,)), "logs": jnp.zeros((2,))},
+        "flows": [],
+    }
+    if gin:
+        p["cond"] = m._conv_init(rngs[3], 1, gin, filt)
+    for i in range(hp.dp_n_flows):
+        r = jax.random.fold_in(rngs[4], i)
+        r1, r2, r3 = jax.random.split(r, 3)
+        n_out = 3 * hp.dp_num_bins - 1
+        p["flows"].append({
+            "pre": m._conv_init(r1, 1, 1, filt),
+            "convs": m.init_dds_conv(r2, channels=filt,
+                                     kernel=hp.dp_kernel_size, n_layers=3),
+            "proj": {"w": jnp.zeros((1, filt, n_out)),
+                     "b": jnp.zeros((n_out,))},  # zero-init → identity start
+        })
+    return p
+
+
+def init_flow(rng, hp: VitsHyperParams, gin: int) -> Params:
+    half = hp.inter_channels // 2
+    layers = []
+    for i in range(hp.flow_n_layers):
+        r = jax.random.fold_in(rng, i)
+        r1, r2, r3 = jax.random.split(r, 3)
+        layers.append({
+            "pre": m._conv_init(r1, 1, half, hp.hidden_channels),
+            "wn": m.init_wn(r2, hidden=hp.hidden_channels,
+                            kernel=hp.flow_kernel_size, dilation_rate=1,
+                            n_layers=hp.flow_wn_layers, gin_channels=gin),
+            "post": {"w": jnp.zeros((1, hp.hidden_channels, half)),
+                     "b": jnp.zeros((half,))},  # zero-init (identity start)
+        })
+    return {"layers": layers}
+
+
+def init_generator(rng, hp: VitsHyperParams, gin: int) -> Params:
+    rngs = jax.random.split(rng, 4)
+    ch0 = hp.upsample_initial_channel
+    p: Params = {
+        "conv_pre": m._conv_init(rngs[0], 7, hp.inter_channels, ch0),
+        "ups": [],
+        "resblocks": [],
+        "conv_post": m._conv_init(rngs[1], 7, ch0 // (2 ** len(hp.upsample_rates)), 1),
+    }
+    if gin:
+        p["cond"] = m._conv_init(rngs[2], 1, gin, ch0)
+    for i, (r_up, k_up) in enumerate(zip(hp.upsample_rates, hp.upsample_kernel_sizes)):
+        r = jax.random.fold_in(rngs[3], i)
+        c_in, c_out = ch0 // (2 ** i), ch0 // (2 ** (i + 1))
+        p["ups"].append(m._conv_init(r, k_up, c_in, c_out))
+        for j, (k_res, dils) in enumerate(
+            zip(hp.resblock_kernel_sizes, hp.resblock_dilation_sizes)
+        ):
+            rr = jax.random.fold_in(r, 100 + j)
+            block = {"convs1": [], "convs2": []}
+            for di, d in enumerate(dils):
+                ra = jax.random.fold_in(rr, di)
+                ra1, ra2 = jax.random.split(ra)
+                block["convs1"].append(m._conv_init(ra1, k_res, c_out, c_out))
+                block["convs2"].append(m._conv_init(ra2, k_res, c_out, c_out))
+            p["resblocks"].append(block)
+    return p
+
+
+def init_vits(rng, hp: VitsHyperParams, *, n_vocab: int,
+              n_speakers: int = 1) -> Params:
+    rngs = jax.random.split(rng, 5)
+    gin = hp.gin_channels if n_speakers > 1 else 0
+    p: Params = {
+        "enc_p": init_text_encoder(rngs[0], hp, n_vocab),
+        "dp": init_duration_predictor(rngs[1], hp, gin),
+        "flow": init_flow(rngs[2], hp, gin),
+        "dec": init_generator(rngs[3], hp, gin),
+    }
+    if n_speakers > 1:
+        p["emb_g"] = jax.random.normal(rngs[4], (n_speakers, hp.gin_channels)) * 0.02
+    return p
+
+
+# ---------------------------------------------------------------------------
+# stage 1: text encoder + stochastic duration predictor
+# ---------------------------------------------------------------------------
+
+def sequence_mask(lengths, max_len: int):
+    """[B] lengths → [B, max_len, 1] float mask."""
+    idx = jnp.arange(max_len)[None, :]
+    return (idx < lengths[:, None]).astype(jnp.float32)[..., None]
+
+
+def text_encoder(p: Params, hp: VitsHyperParams, ids, x_mask):
+    x = p["emb"][ids] * math.sqrt(hp.hidden_channels)  # [B, T, H]
+    x = m.transformer(x, x_mask, p["encoder"], n_heads=hp.n_heads,
+                      window=hp.attn_window)
+    stats = m.conv1d(x, p["proj"]) * x_mask
+    m_p, logs_p = jnp.split(stats, 2, axis=-1)
+    return x, m_p, logs_p
+
+
+def duration_predictor_reverse(p: Params, hp: VitsHyperParams, x, x_mask,
+                               rng, noise_w, g=None):
+    """Stochastic duration predictor, inference (reverse-flow) path → logw.
+
+    Flow order replicates VITS inference exactly, including the quirk that
+    the first ConvFlow is skipped at inference time (the exported Piper
+    graphs bake this in, so weight-parity requires it).
+    """
+    h = m.conv1d(x, p["pre"])
+    if g is not None and "cond" in p:
+        h = h + m.conv1d(g, p["cond"])
+    h = m.dds_conv(h, x_mask, p["convs"], kernel=hp.dp_kernel_size)
+    h = m.conv1d(h, p["proj"]) * x_mask
+
+    b, t, _ = x.shape
+    z = jax.random.normal(rng, (b, t, 2)) * noise_w * x_mask
+
+    # reversed flow stack: Flip/ConvFlow pairs (skipping ConvFlow #0), then
+    # the elementwise affine
+    for i in range(hp.dp_n_flows - 1, 0, -1):
+        z = z[..., ::-1]  # Flip
+        z = _conv_flow_reverse(p["flows"][i], hp, z, x_mask, h)
+    z = z[..., ::-1]  # Flip preceding the skipped ConvFlow #0
+    # ElementwiseAffine reverse: x = (z - m) * exp(-logs)
+    aff = p["affine"]
+    z = (z - aff["m"]) * jnp.exp(-aff["logs"]) * x_mask
+    logw = z[..., 0:1]
+    return logw
+
+
+def _conv_flow_reverse(pf: Params, hp: VitsHyperParams, z, mask, g):
+    z0, z1 = z[..., 0:1], z[..., 1:2]
+    h = m.conv1d(z0, pf["pre"])
+    h = m.dds_conv(h, mask, pf["convs"], kernel=hp.dp_kernel_size, g=g)
+    h = m.conv1d(h, pf["proj"]) * mask  # [B, T, 3*bins-1]
+    nb = hp.dp_num_bins
+    filt = hp.dp_filter_channels
+    uw = h[..., :nb] / math.sqrt(filt)
+    uh = h[..., nb:2 * nb] / math.sqrt(filt)
+    ud = h[..., 2 * nb:]
+    x1, _ = m.rational_quadratic_spline_inverse(
+        z1[..., 0], uw, uh, ud, tail_bound=hp.dp_tail_bound
+    )
+    return jnp.concatenate([z0, x1[..., None] * mask], axis=-1)
+
+
+def encode_text(p: Params, hp: VitsHyperParams, ids, x_lengths, rng, *,
+                noise_w: float, length_scale: float, sid=None):
+    """ids [B, T] → (m_p, logs_p [B, T, C], durations w_ceil [B, T], g).
+
+    Everything whose output size depends on data (durations) is computed
+    here; downstream stages take a static frame budget.
+    """
+    x_mask = sequence_mask(x_lengths, ids.shape[1])
+    g = None
+    if sid is not None and "emb_g" in p:
+        g = p["emb_g"][sid][:, None, :]  # [B, 1, gin]
+    x, m_p, logs_p = text_encoder(p["enc_p"], hp, ids, x_mask)
+    logw = duration_predictor_reverse(p["dp"], hp, x, x_mask, rng,
+                                      noise_w, g=g)
+    w = jnp.exp(logw) * x_mask * length_scale
+    w_ceil = jnp.ceil(w)[..., 0]  # [B, T]
+    return m_p, logs_p, w_ceil, x_mask, g
+
+
+# ---------------------------------------------------------------------------
+# stage 2: length regulation + prior + flow reverse
+# ---------------------------------------------------------------------------
+
+def generate_path(w_ceil, x_mask, max_frames: int):
+    """Monotonic alignment path from durations.
+
+    ``w_ceil: [B, T]`` → ``path: [B, T, F]`` with ``path[b, t, f] = 1`` iff
+    frame ``f`` belongs to phoneme ``t``.  Pure broadcasting — no scatter,
+    no dynamic shapes; the MXU eats the downstream einsum.
+    """
+    w = w_ceil * x_mask[..., 0]
+    cum = jnp.cumsum(w, axis=1)  # [B, T]
+    f = jnp.arange(max_frames)[None, None, :]
+    upper = f < cum[..., None]
+    lower = f >= jnp.concatenate(
+        [jnp.zeros_like(cum[:, :1]), cum[:, :-1]], axis=1
+    )[..., None]
+    return (upper & lower).astype(jnp.float32)
+
+
+def acoustics(p: Params, hp: VitsHyperParams, m_p, logs_p, w_ceil, x_mask,
+              rng, *, noise_scale: float, max_frames: int, g=None):
+    """Durations + priors → latent ``z`` [B, F, C] and frame mask."""
+    y_lengths = jnp.clip(jnp.sum(w_ceil, axis=1), 1, max_frames).astype(jnp.int32)
+    y_mask = sequence_mask(y_lengths, max_frames)  # [B, F, 1]
+    path = generate_path(w_ceil, x_mask, max_frames)  # [B, T, F]
+    m_p_f = jnp.einsum("btf,btc->bfc", path, m_p)
+    logs_p_f = jnp.einsum("btf,btc->bfc", path, logs_p)
+    noise = jax.random.normal(rng, m_p_f.shape)
+    z_p = m_p_f + noise * jnp.exp(logs_p_f) * noise_scale
+    z = flow_reverse(p["flow"], hp, z_p, y_mask, g=g)
+    return z * y_mask, y_mask, y_lengths
+
+
+def flow_reverse(pf: Params, hp: VitsHyperParams, z, mask, g=None):
+    half = hp.inter_channels // 2
+    for layer in reversed(pf["layers"]):
+        z = z[..., ::-1]  # Flip (reverse order: undo the flip first)
+        z0, z1 = z[..., :half], z[..., half:]
+        h = m.conv1d(z0, layer["pre"]) * mask
+        h = m.wn(h, mask, layer["wn"], kernel=hp.flow_kernel_size,
+                 dilation_rate=1, n_layers=hp.flow_wn_layers, g=g)
+        mean = m.conv1d(h, layer["post"]) * mask
+        z1 = (z1 - mean) * mask  # mean-only coupling, reverse
+        z = jnp.concatenate([z0, z1], axis=-1)
+    return z
+
+
+# ---------------------------------------------------------------------------
+# stage 3: HiFi-GAN decoder
+# ---------------------------------------------------------------------------
+
+def decode(p: Params, hp: VitsHyperParams, z, g=None):
+    """Latent ``z`` [B, F, C] → waveform [B, F * hop].
+
+    The FLOPs live here (upsampling convs); channels shrink as time grows,
+    keeping every conv an MXU-friendly matmul over the channel dim.
+    """
+    pd = p["dec"]
+    x = m.conv1d(z, pd["conv_pre"])
+    if g is not None and "cond" in pd:
+        x = x + m.conv1d(g, pd["cond"])
+    n_kernels = len(hp.resblock_kernel_sizes)
+    for i, (r_up, k_up) in enumerate(zip(hp.upsample_rates, hp.upsample_kernel_sizes)):
+        x = jax.nn.leaky_relu(x, m.LRELU_SLOPE)
+        x = m.conv_transpose1d(x, pd["ups"][i], stride=r_up,
+                               padding=(k_up - r_up) // 2)
+        xs = None
+        for j in range(n_kernels):
+            block = pd["resblocks"][i * n_kernels + j]
+            y = _resblock1(block, x, hp.resblock_kernel_sizes[j],
+                           hp.resblock_dilation_sizes[j])
+            xs = y if xs is None else xs + y
+        x = xs / n_kernels
+    x = jax.nn.leaky_relu(x, m.LRELU_SLOPE)
+    x = m.conv1d(x, pd["conv_post"])
+    return jnp.tanh(x)[..., 0]  # [B, samples]
+
+
+def _resblock1(block: Params, x, kernel: int, dilations):
+    for c1, c2, d in zip(block["convs1"], block["convs2"], dilations):
+        y = jax.nn.leaky_relu(x, m.LRELU_SLOPE)
+        y = m.conv1d(y, c1, dilation=d)
+        y = jax.nn.leaky_relu(y, m.LRELU_SLOPE)
+        y = m.conv1d(y, c2)
+        x = x + y
+    return x
+
+
+# ---------------------------------------------------------------------------
+# full graph
+# ---------------------------------------------------------------------------
+
+def infer(p: Params, hp: VitsHyperParams, ids, x_lengths, rng, *,
+          noise_scale: float = 0.667, length_scale: float = 1.0,
+          noise_w: float = 0.8, max_frames: int = 1024, sid=None):
+    """Single-graph inference: ids → waveform.
+
+    Matches the reference's single-ONNX contract — inputs
+    ``(input [B,T], input_lengths [B], scales, sid?)``
+    (``piper/src/lib.rs:345-368``) — with explicit RNG and a static frame
+    budget ``max_frames`` (the dynamic-shape boundary the ONNX graph hides).
+
+    Returns (wav [B, max_frames*hop], wav_lengths [B] in samples).
+    """
+    rng_dur, rng_noise = jax.random.split(rng)
+    m_p, logs_p, w_ceil, x_mask, g = encode_text(
+        p, hp, ids, x_lengths, rng_dur, noise_w=noise_w,
+        length_scale=length_scale, sid=sid,
+    )
+    z, y_mask, y_lengths = acoustics(
+        p, hp, m_p, logs_p, w_ceil, x_mask, rng_noise,
+        noise_scale=noise_scale, max_frames=max_frames, g=g,
+    )
+    wav = decode(p, hp, z, g=g)
+    return wav, y_lengths * hp.hop_length

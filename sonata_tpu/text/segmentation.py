@@ -40,7 +40,7 @@ _TERMINATOR_CANON = {
 
 _ABBREVIATIONS = {
     "mr", "mrs", "ms", "dr", "prof", "sr", "jr", "st", "vs", "etc", "inc",
-    "ltd", "co", "fig", "al", "no", "dept", "est", "approx",
+    "ltd", "co", "fig", "al", "dept", "est", "approx",
     "e.g", "i.e", "a.m", "p.m",  # matched after placeholder restoration
 }
 

@@ -1,0 +1,58 @@
+"""Arabic diacritization (tashkeel) stage.
+
+In the reference, ``libtashkeel`` (a Rust crate running its own bundled ONNX
+seq-tagging model) is auto-enabled whenever the voice's eSpeak language is
+``ar`` (``crates/sonata/models/piper/src/lib.rs:63-77,253-258,270-281``).
+
+Here the same rule applies (see ``PiperVoice.phonemize_text``), and the
+engine is a small JAX character tagger (:mod:`sonata_tpu.models.tashkeel`)
+when weights are available, with an identity fallback otherwise so the
+Arabic chain never hard-fails.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class TashkeelEngine:
+    """Diacritize Arabic text.  Identity fallback when no model is loaded."""
+
+    def __init__(self, model_path: Optional[str] = None):
+        self._model = None
+        self._lock = threading.Lock()
+        if model_path is not None:
+            try:
+                from ..models.tashkeel import TashkeelModel
+            except ImportError as e:
+                from ..core import FailedToLoadResource
+
+                raise FailedToLoadResource(
+                    f"tashkeel model support unavailable: {e}") from e
+            self._model = TashkeelModel.from_path(model_path)
+
+    @property
+    def has_model(self) -> bool:
+        return self._model is not None
+
+    def diacritize(self, text: str) -> str:
+        if self._model is None:
+            return text
+        with self._lock:
+            return self._model.diacritize(text)
+
+
+_GLOBAL: Optional[TashkeelEngine] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_default_engine() -> TashkeelEngine:
+    """Lazy module-global engine (parity: the Python frontend's lazy global
+    tashkeel instance, ``crates/frontends/python/src/lib.rs:17-18``)."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _GLOBAL_LOCK:
+            if _GLOBAL is None:
+                _GLOBAL = TashkeelEngine()
+    return _GLOBAL

@@ -3,8 +3,13 @@
 Multi-chip sharding tests run on a virtual mesh
 (``--xla_force_host_platform_device_count=8``) so the suite is hermetic on
 any machine; real-TPU execution is exercised by bench.py and the driver's
-graft entry checks instead.  This must run before jax initializes a backend,
-hence module-level in conftest.
+graft entry checks instead.
+
+Note: this environment's sitecustomize imports jax and registers the axon
+TPU plugin before any test code runs, so setting ``JAX_PLATFORMS`` via
+``os.environ`` is too late — we must go through ``jax.config``.  The CPU
+backend itself is not initialized until first use, so ``XLA_FLAGS`` set here
+still takes effect.
 """
 
 import os
@@ -14,6 +19,8 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-# Keep XLA/CPU from oversubscribing the (possibly single-core) test machine.
-os.environ.setdefault("XLA_PYTHON_CLIENT_PREALLOCATE", "false")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

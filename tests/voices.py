@@ -1,0 +1,44 @@
+"""Shared test fixtures: tiny randomly-initialized voices.
+
+The reference's e2e tier needs real voice files a developer must download
+(``synth/models/.gitignore``, SURVEY §4) — its suite cannot run hermetically.
+Ours can: a structurally-complete VITS with tiny dims exercises every code
+path (jit, bucketing, streaming, speakers) in seconds on CPU.
+"""
+
+from sonata_tpu.models import PiperVoice
+
+# Small enough to compile fast on a 1-core CPU runner; structurally complete.
+TINY_MODEL = dict(
+    inter_channels=32,
+    hidden_channels=32,
+    filter_channels=64,
+    n_heads=2,
+    n_layers=2,
+    upsample_rates=(4, 4),
+    upsample_initial_channel=64,
+    upsample_kernel_sizes=(8, 8),
+    resblock_kernel_sizes=(3,),
+    resblock_dilation_sizes=((1, 3),),
+    dp_filter_channels=32,
+    gin_channels=16,
+    flow_n_layers=2,
+    flow_wn_layers=2,
+)
+
+
+def tiny_voice(seed: int = 0, **overrides) -> PiperVoice:
+    kw = {
+        "model": dict(TINY_MODEL),
+        "audio": {"sample_rate": 16000, "quality": None},
+    }
+    kw.update(overrides)
+    return PiperVoice.random(seed=seed, **kw)
+
+
+def tiny_multispeaker_voice(n: int = 4, seed: int = 0) -> PiperVoice:
+    return tiny_voice(
+        seed=seed,
+        num_speakers=n,
+        speaker_id_map={f"spk{i}": i for i in range(n)},
+    )
