@@ -1,0 +1,200 @@
+"""Command-line frontend.
+
+Mirrors the reference's ``sonata`` binary (``crates/frontends/cli/src/
+main.rs``): voice config path + text, output file or raw-bytes-to-stdout,
+three modes (lazy / batched / realtime), synthesis scales, prosody
+percentages, and — when no text is given — a loop reading JSON
+``SynthesisRequest`` lines from stdin with auto-enumerated output filenames
+``stem-N.ext`` (``main.rs:78-92,118-124,234-258``).
+
+Logging via the ``SONATA_LOG`` env var (``main.rs:113-116``).  The ort
+EP selection of the reference (``main.rs:184-197``) has no counterpart:
+the backend is always XLA/PJRT; ``--backend`` is accepted for parity and
+validated.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+from pathlib import Path
+
+from ..core import SonataError
+from ..models import from_config_path
+from ..synth import AudioOutputConfig, SpeechSynthesizer
+
+log = logging.getLogger("sonata.cli")
+
+REALTIME_DEFAULT_CHUNK = 100  # main.rs:158
+REALTIME_DEFAULT_PADDING = 3  # main.rs:159
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sonata-tpu",
+        description="TPU-native neural text-to-speech (Piper voices)")
+    p.add_argument("config", help="voice config JSON path")
+    p.add_argument("text", nargs="?", help="text to speak; omit to read "
+                   "JSON requests from stdin")
+    p.add_argument("-f", "--input-file", help="read input text from file")
+    p.add_argument("-o", "--output", help="output WAV path ('-' = raw "
+                   "sample bytes to stdout)")
+    p.add_argument("--mode", choices=("lazy", "parallel", "batched",
+                                      "realtime"), default="parallel")
+    p.add_argument("--speaker-id", type=int)
+    p.add_argument("--length-scale", type=float)
+    p.add_argument("--noise-scale", type=float)
+    p.add_argument("--noise-w", type=float)
+    p.add_argument("--rate", type=int, help="0-100")
+    p.add_argument("--volume", type=int, help="0-100")
+    p.add_argument("--pitch", type=int, help="0-100")
+    p.add_argument("--silence-ms", type=int, dest="silence_ms",
+                   help="appended silence per sentence")
+    p.add_argument("--chunk-size", type=int, default=REALTIME_DEFAULT_CHUNK)
+    p.add_argument("--chunk-padding", type=int,
+                   default=REALTIME_DEFAULT_PADDING)
+    p.add_argument("--backend", choices=("xla",), default="xla",
+                   help="compute backend (XLA/PJRT only)")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def _apply_scales(synth: SpeechSynthesizer, args) -> None:
+    sc = synth.get_fallback_synthesis_config()
+    if args.speaker_id is not None:
+        speakers = synth.get_speakers() or {}
+        name = speakers.get(args.speaker_id, str(args.speaker_id))
+        sc.speaker = (name, args.speaker_id)
+    if args.length_scale is not None:
+        sc.length_scale = args.length_scale
+    if args.noise_scale is not None:
+        sc.noise_scale = args.noise_scale
+    if args.noise_w is not None:
+        sc.noise_w = args.noise_w
+    synth.set_fallback_synthesis_config(sc)
+
+
+def _output_config(args) -> AudioOutputConfig | None:
+    if all(v is None for v in (args.rate, args.volume, args.pitch,
+                               args.silence_ms)):
+        return None
+    return AudioOutputConfig(rate=args.rate, volume=args.volume,
+                             pitch=args.pitch,
+                             appended_silence_ms=args.silence_ms)
+
+
+def _stream_for(synth: SpeechSynthesizer, args, text: str):
+    cfg = _output_config(args)
+    if args.mode == "lazy":
+        return synth.synthesize_lazy(text, cfg)
+    if args.mode == "realtime":
+        return synth.synthesize_streamed(text, cfg, args.chunk_size,
+                                         args.chunk_padding)
+    return synth.synthesize_parallel(text, cfg)
+
+
+def process_synthesis_request(synth: SpeechSynthesizer, args, text: str,
+                              out_path: str | None) -> None:
+    """Synthesize one request to a file or stdout (``main.rs:126-182``)."""
+    t0 = time.perf_counter()
+    if out_path == "-":
+        stream = _stream_for(synth, args, text)
+        raw = sys.stdout.buffer
+        for audio in stream:
+            raw.write(audio.as_wave_bytes())  # raw samples (main.rs:167-182)
+            raw.flush()
+    elif out_path:
+        from ..audio import AudioSamples, write_wave_samples_to_file
+
+        merged = AudioSamples()
+        for audio in _stream_for(synth, args, text):
+            merged.merge(audio.samples)
+        write_wave_samples_to_file(
+            out_path, merged.to_i16(),
+            synth.audio_output_info().sample_rate)
+        log.info("wrote %s (%.1f ms synthesis)", out_path,
+                 (time.perf_counter() - t0) * 1e3)
+    else:
+        # no sink: drain and report timing (useful for benchmarking)
+        n = sum(len(a.samples) for a in _stream_for(synth, args, text))
+        sr = synth.audio_output_info().sample_rate
+        elapsed = time.perf_counter() - t0
+        print(f"synthesized {n / sr:.2f}s of audio in {elapsed * 1e3:.1f} ms "
+              f"(RTF {elapsed / max(n / sr, 1e-9):.4f})")
+
+
+def _numbered_output(template: str, i: int) -> str:
+    """stem-N.ext auto-enumeration (``main.rs:235-247``)."""
+    p = Path(template)
+    return str(p.with_name(f"{p.stem}-{i}{p.suffix}"))
+
+
+def stdin_json_loop(synth: SpeechSynthesizer, args) -> None:
+    """Read one JSON ``SynthesisRequest`` per line (``main.rs:234-258``).
+
+    Request schema: ``{"text": str, "output_file"?: str, "speaker_id"?: int,
+    "rate"?: int, "volume"?: int, "pitch"?: int,
+    "appended_silence_ms"?: int, "noise_scale"?: float,
+    "length_scale"?: float, "noise_w"?: float}``.
+    """
+    counter = 0
+    # snapshot the CLI-level baseline so one request's scales never leak
+    # into the next
+    base_config = synth.get_fallback_synthesis_config()
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = json.loads(line)
+            text = req["text"]
+        except (json.JSONDecodeError, KeyError) as e:
+            log.error("bad request line: %s", e)  # main.rs:252-255
+            continue
+        synth.set_fallback_synthesis_config(base_config.copy())
+        ns = argparse.Namespace(**vars(args))
+        for field in ("speaker_id", "rate", "volume", "pitch",
+                      "noise_scale", "length_scale", "noise_w"):
+            if field in req:
+                setattr(ns, field, req[field])
+        if "appended_silence_ms" in req:
+            ns.silence_ms = req["appended_silence_ms"]
+        _apply_scales(synth, ns)
+        out = req.get("output_file") or args.output
+        if out and out != "-":
+            out = _numbered_output(out, counter)
+            counter += 1
+        try:
+            process_synthesis_request(synth, ns, text, out)
+        except SonataError as e:
+            log.error("synthesis failed: %s", e)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=os.environ.get("SONATA_LOG", "INFO").upper(),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    args = build_parser().parse_args(argv)
+    try:
+        voice = from_config_path(args.config, seed=args.seed)
+        synth = SpeechSynthesizer(voice)
+        _apply_scales(synth, args)
+        text = args.text
+        if args.input_file:
+            text = Path(args.input_file).read_text(encoding="utf-8")
+        if text is not None:
+            process_synthesis_request(synth, args, text, args.output)
+        else:
+            stdin_json_loop(synth, args)
+    except SonataError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

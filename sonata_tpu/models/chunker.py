@@ -22,7 +22,6 @@ decoder takes any shape; XLA cannot).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Iterator
 
 MAX_CHUNK_SIZE = 1024  # frames (piper/src/lib.rs:18)
 MIN_CHUNK_SIZE = 44    # frames (piper/src/lib.rs:19)
@@ -70,6 +69,3 @@ def plan_chunks(total_frames: int, chunk_size: int,
     return plans
 
 
-def iter_chunks(total_frames: int, chunk_size: int,
-                chunk_padding: int) -> Iterator[ChunkPlan]:
-    yield from plan_chunks(total_frames, chunk_size, chunk_padding)

@@ -1,0 +1,168 @@
+"""Import Piper ONNX voice files without an ONNX runtime or the ``onnx``
+package.
+
+The reference hands the whole file to onnxruntime
+(``crates/sonata/models/piper/src/lib.rs:79-86``).  We only need the
+*weights*: ONNX is protobuf, and protobuf's wire format is simple enough to
+parse directly — varint-keyed fields, length-delimited submessages.  This
+module implements a minimal wire reader, walks ``ModelProto.graph`` (field
+7) → ``GraphProto.initializer`` (field 5), decodes each ``TensorProto``, and
+maps the torch-style initializer names that ``torch.onnx.export`` preserves
+onto our pytree via :func:`.import_torch.state_dict_to_params`.
+
+Field numbers follow the public ONNX schema (onnx/onnx.proto):
+``TensorProto``: dims=1, data_type=2, float_data=4, int64_data=7, name=8,
+raw_data=9.  Data types: FLOAT=1, INT64=7, FLOAT16=10, DOUBLE=11.
+"""
+
+from __future__ import annotations
+
+import struct
+from pathlib import Path
+from typing import Iterator, Union
+
+import numpy as np
+
+from ..core import FailedToLoadResource
+from .config import VitsHyperParams
+
+_WIRE_VARINT = 0
+_WIRE_64BIT = 1
+_WIRE_LEN = 2
+_WIRE_32BIT = 5
+
+
+def _read_varint(buf: memoryview, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(buf):
+            raise FailedToLoadResource("truncated protobuf varint")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise FailedToLoadResource("malformed protobuf varint")
+
+
+def iter_fields(buf: memoryview) -> Iterator[tuple[int, int, object]]:
+    """Yield (field_number, wire_type, value) over a protobuf message."""
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 0x7
+        if wire == _WIRE_VARINT:
+            value, pos = _read_varint(buf, pos)
+        elif wire == _WIRE_64BIT:
+            value = buf[pos:pos + 8]
+            pos += 8
+        elif wire == _WIRE_LEN:
+            n, pos = _read_varint(buf, pos)
+            value = buf[pos:pos + n]
+            pos += n
+        elif wire == _WIRE_32BIT:
+            value = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise FailedToLoadResource(f"unsupported protobuf wire type {wire}")
+        yield field, wire, value
+
+
+_DTYPE = {1: np.float32, 7: np.int64, 10: np.float16, 11: np.float64,
+          6: np.int32, 9: np.bool_}
+
+
+def _decode_tensor(buf: memoryview) -> tuple[str, np.ndarray]:
+    dims: list[int] = []
+    data_type = 1
+    name = ""
+    raw = None
+    float_data: list[float] = []
+    int64_data: list[int] = []
+    for field, wire, value in iter_fields(buf):
+        if field == 1:  # dims
+            if wire == _WIRE_VARINT:
+                dims.append(int(value))
+            else:  # packed
+                pos = 0
+                mv = memoryview(value)
+                while pos < len(mv):
+                    v, pos = _read_varint(mv, pos)
+                    dims.append(v)
+        elif field == 2 and wire == _WIRE_VARINT:
+            data_type = int(value)
+        elif field == 8:
+            name = bytes(value).decode("utf-8", errors="replace")
+        elif field == 9:
+            raw = bytes(value)
+        elif field == 4:  # float_data (packed or repeated)
+            if wire == _WIRE_LEN:
+                float_data.extend(
+                    struct.unpack(f"<{len(value) // 4}f", bytes(value)))
+            else:
+                float_data.append(struct.unpack("<f", bytes(value))[0])
+        elif field == 7:  # int64_data
+            if wire == _WIRE_LEN:
+                pos = 0
+                mv = memoryview(value)
+                while pos < len(mv):
+                    v, pos = _read_varint(mv, pos)
+                    int64_data.append(v)
+            else:
+                int64_data.append(int(value))
+    dtype = _DTYPE.get(data_type)
+    if dtype is None:
+        raise FailedToLoadResource(
+            f"initializer {name!r}: unsupported ONNX data type {data_type}")
+    if raw is not None:
+        arr = np.frombuffer(raw, dtype=dtype).copy()
+    elif float_data:
+        arr = np.asarray(float_data, dtype=np.float32)
+    elif int64_data:
+        arr = np.asarray(int64_data, dtype=np.int64)
+    else:
+        arr = np.zeros(0, dtype=dtype)
+    if dims:
+        arr = arr.reshape(dims)
+    return name, arr
+
+
+def read_onnx_initializers(path: Union[str, Path]) -> dict[str, np.ndarray]:
+    """Extract ``{initializer name: ndarray}`` from an ONNX file."""
+    data = Path(path).read_bytes()
+    out: dict[str, np.ndarray] = {}
+    for field, wire, value in iter_fields(memoryview(data)):
+        if field == 7 and wire == _WIRE_LEN:  # ModelProto.graph
+            for gfield, gwire, gvalue in iter_fields(value):
+                if gfield == 5 and gwire == _WIRE_LEN:  # initializer
+                    name, arr = _decode_tensor(gvalue)
+                    out[name] = arr
+                elif gfield == 1 and gwire == _WIRE_LEN:
+                    # nodes may carry Constant-op tensors; skip (weights for
+                    # VITS live in initializers)
+                    continue
+    if not out:
+        raise FailedToLoadResource(
+            f"{path}: no initializers found (not an ONNX model?)")
+    return out
+
+
+def import_onnx_weights(path: Union[str, Path], hp: VitsHyperParams, *,
+                        n_vocab: int, n_speakers: int = 1) -> dict:
+    """ONNX initializers → native param pytree.
+
+    ``torch.onnx.export`` keeps parameter names for initializers, so the
+    state-dict mapper applies directly.  Weight-norm is usually already
+    fused in exports (piper removes it); if ``weight_g/v`` pairs survive,
+    the mapper fuses them.
+    """
+    from .import_torch import state_dict_to_params, strip_prefix
+
+    sd = read_onnx_initializers(path)
+    sd = {k: v.astype(np.float32) if v.dtype in (np.float16, np.float64)
+          else v for k, v in sd.items()}
+    return state_dict_to_params(strip_prefix(sd), hp, n_vocab=n_vocab,
+                                n_speakers=n_speakers)

@@ -41,7 +41,13 @@ from ..core import (
 )
 from ..text import text_to_phonemes
 from ..text.tashkeel import TashkeelEngine, get_default_engine
-from ..utils.buckets import FRAME_BUCKETS, TEXT_BUCKETS, bucket_for, pad_to
+from ..utils.buckets import (
+    BATCH_BUCKETS,
+    FRAME_BUCKETS,
+    TEXT_BUCKETS,
+    bucket_for,
+    pad_to,
+)
 from . import vits
 from .chunker import CROSSFADE_SAMPLES, plan_chunks
 from .config import ModelConfig, SynthesisConfig, default_phoneme_id_map
@@ -313,10 +319,19 @@ class PiperVoice(BaseModel):
         return fn
 
     def _run_encode(self, ids_list: list[list[int]], sc: SynthesisConfig):
-        b = len(ids_list)
+        """Pad to (batch, text) buckets and run stage 1.
+
+        Both axes are bucketed so the number of compiled executables stays
+        bounded under arbitrary workloads; dummy rows are masked out by
+        their length-0 semantics and dropped by callers.
+        """
+        n_real = len(ids_list)
+        b = bucket_for(n_real, BATCH_BUCKETS)
         t = bucket_for(max(len(i) for i in ids_list), TEXT_BUCKETS)
-        ids = jnp.asarray([pad_to(i, t) for i in ids_list], dtype=jnp.int32)
-        lens = jnp.asarray([len(i) for i in ids_list], dtype=jnp.int32)
+        padded = ids_list + [[0]] * (b - n_real)
+        ids = jnp.asarray([pad_to(i, t) for i in padded], dtype=jnp.int32)
+        lens = jnp.asarray([len(i) for i in ids_list] + [1] * (b - n_real),
+                           dtype=jnp.int32)
         sid = self._sid_array(sc, b)
         args = [self.params, ids, lens, self._next_rng(),
                 jnp.float32(sc.noise_w), jnp.float32(sc.length_scale)]
@@ -326,8 +341,10 @@ class PiperVoice(BaseModel):
         return m_p, logs_p, w_ceil, x_mask, sid, b, t
 
     def _infer_batch(self, ids_list: list[list[int]], sc: SynthesisConfig):
+        n_real = len(ids_list)
         m_p, logs_p, w_ceil, x_mask, sid, b, t = self._run_encode(ids_list, sc)
-        frames = int(jnp.sum(w_ceil, axis=1).max())  # host sync: [B] ints
+        # host sync on [B] ints only; dummy rows excluded from the bucket pick
+        frames = int(jnp.sum(w_ceil[:n_real], axis=1).max())
         f = bucket_for(max(frames, 1), FRAME_BUCKETS)
         syn = self._synth_fn(b, t, f)
         args = [self.params, m_p, logs_p, w_ceil, x_mask, self._next_rng(),
@@ -335,8 +352,8 @@ class PiperVoice(BaseModel):
         if sid is not None:
             args.append(sid)
         wav, wav_lengths = syn(*args)
-        wav = np.asarray(jax.block_until_ready(wav))
-        return wav, np.asarray(wav_lengths)
+        wav = np.asarray(jax.block_until_ready(wav))[:n_real]
+        return wav, np.asarray(wav_lengths)[:n_real]
 
     # ------------------------------------------------------------------
     # streaming (reference stream_synthesis, piper/src/lib.rs:652-668)

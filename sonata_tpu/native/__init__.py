@@ -1,0 +1,12 @@
+"""Native (C++) runtime components and their loaders.
+
+The reference's native layer is vendored C linked through -sys crates
+(Sonic, eSpeak-ng, nanosnap — SURVEY §2.2).  Ours is first-party C++
+compiled on demand with the system toolchain and loaded via ctypes; every
+native component has a pure-Python fallback so the framework degrades
+gracefully on machines without a compiler.
+"""
+
+from .build import load_dsp_library, native_dir
+
+__all__ = ["load_dsp_library", "native_dir"]

@@ -1,0 +1,80 @@
+"""Build-and-load machinery for the first-party C++ components.
+
+Compiles ``src/*.cpp`` into shared libraries next to this file on first use
+(equivalent to the reference's build.rs + cc/cmake static builds,
+``crates/audio/sonic-sys/build.rs:9-12``), caches by source mtime, and
+exposes ctypes handles.  Failures are non-fatal: callers fall back to the
+numpy implementations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+log = logging.getLogger("sonata.native")
+
+_DIR = Path(__file__).resolve().parent
+_LOCK = threading.Lock()
+_CACHE: dict[str, Optional[ctypes.CDLL]] = {}
+
+
+def native_dir() -> Path:
+    return _DIR
+
+
+def _build(name: str) -> Optional[Path]:
+    src = _DIR / "src" / f"{name}.cpp"
+    lib = _DIR / f"lib{name}.so"
+    if not src.exists():
+        return None
+    if lib.exists() and lib.stat().st_mtime >= src.stat().st_mtime:
+        return lib
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           "-o", str(lib), str(src)]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.warning("native build of %s failed to run: %s", name, e)
+        return None
+    if proc.returncode != 0:
+        log.warning("native build of %s failed:\n%s", name, proc.stderr[-2000:])
+        return None
+    return lib
+
+
+def _load(name: str) -> Optional[ctypes.CDLL]:
+    with _LOCK:
+        if name in _CACHE:
+            return _CACHE[name]
+        lib_path = _build(name)
+        handle = None
+        if lib_path is not None:
+            try:
+                handle = ctypes.CDLL(str(lib_path))
+            except OSError as e:
+                log.warning("cannot load %s: %s", lib_path, e)
+        _CACHE[name] = handle
+        return handle
+
+
+def load_dsp_library() -> Optional[ctypes.CDLL]:
+    """The prosody DSP library (rate/pitch/volume), or None."""
+    lib = _load("sonata_dsp")
+    if lib is not None and not hasattr(lib, "_sonata_configured"):
+        lib.sonata_dsp_output_len.restype = ctypes.c_int64
+        lib.sonata_dsp_output_len.argtypes = [ctypes.c_int64, ctypes.c_float,
+                                              ctypes.c_float]
+        lib.sonata_dsp_process.restype = ctypes.c_int64
+        lib.sonata_dsp_process.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+        ]
+        lib.sonata_dsp_version.restype = ctypes.c_char_p
+        lib._sonata_configured = True
+    return lib

@@ -1,0 +1,21 @@
+"""Synthesis orchestration (analogue of ``crates/sonata/synth``)."""
+
+from .output import AudioOutputConfig, percent_to_param, process_prosody
+from .synthesizer import (
+    RealtimeSpeechStream,
+    SpeechStreamBatched,
+    SpeechStreamLazy,
+    SpeechSynthesizer,
+    synthesis_thread_pool,
+)
+
+__all__ = [
+    "AudioOutputConfig",
+    "percent_to_param",
+    "process_prosody",
+    "RealtimeSpeechStream",
+    "SpeechStreamBatched",
+    "SpeechStreamLazy",
+    "SpeechSynthesizer",
+    "synthesis_thread_pool",
+]

@@ -1,0 +1,236 @@
+"""Speech synthesis orchestration: lazy / batched / realtime streams.
+
+TPU-native analogue of ``crates/sonata/synth/src/lib.rs``:
+
+- :class:`SpeechSynthesizer` wraps a :class:`~sonata_tpu.core.Model` and
+  delegates the model protocol (reference ``SonataSpeechSynthesizer``,
+  ``:119-247``).
+- **Lazy** — phonemize once, synthesize one sentence per ``next()``
+  (``SonataSpeechStreamLazy``, ``:282-307``).
+- **Batched** — the reference's "parallel" mode precomputes all sentences
+  via a rayon CPU fan-out (``:310-325``) and its ``speak_batch`` loops
+  sentences serially (``piper/src/lib.rs:425-437``).  Here both collapse
+  into one true padded device batch (``Model.speak_batch``) — the batch
+  axis is the TPU data-parallel axis, so this mode is also what shards
+  across a mesh (:mod:`sonata_tpu.parallel`).
+- **Realtime** — producer thread streams chunks through a queue with the
+  reference's chunk-size growth heuristic between sentences
+  (``RealtimeSpeechStream``, ``:335-430``; growth ``:351-356``).
+- A shared synthesis thread pool of ``4 × cpu`` threads named
+  ``sonata_synth_N`` (``:17-26``) serves realtime producers and the C API's
+  nonblocking mode.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+from typing import Iterator, Optional, Union
+
+from ..audio import Audio, AudioSamples, write_wave_samples_to_file
+from ..core import Model, OperationError, Phonemes
+from .output import AudioOutputConfig
+
+_POOL: Optional[ThreadPoolExecutor] = None
+_POOL_LOCK = threading.Lock()
+
+
+def synthesis_thread_pool() -> ThreadPoolExecutor:
+    """Global pool, 4 × available parallelism (``synth/lib.rs:17-26``)."""
+    global _POOL
+    if _POOL is None:
+        with _POOL_LOCK:
+            if _POOL is None:
+                workers = 4 * (os.cpu_count() or 1)
+                _POOL = ThreadPoolExecutor(
+                    max_workers=workers,
+                    thread_name_prefix="sonata_synth")
+    return _POOL
+
+
+class SpeechSynthesizer:
+    """Wraps a model; adds output-config processing and stream modes."""
+
+    def __init__(self, model: Model):
+        self.model = model
+
+    # -- delegation (reference :205-247) ------------------------------------
+    def audio_output_info(self):
+        return self.model.audio_output_info()
+
+    def phonemize_text(self, text: str) -> Phonemes:
+        return self.model.phonemize_text(text)
+
+    def get_language(self):
+        return self.model.get_language()
+
+    def get_speakers(self):
+        return self.model.get_speakers()
+
+    def properties(self):
+        return self.model.properties()
+
+    def supports_streaming_output(self) -> bool:
+        return self.model.supports_streaming_output()
+
+    def get_fallback_synthesis_config(self):
+        return self.model.get_fallback_synthesis_config()
+
+    def set_fallback_synthesis_config(self, cfg) -> None:
+        self.model.set_fallback_synthesis_config(cfg)
+
+    # -- processing helper ---------------------------------------------------
+    def _post_process(self, audio: Audio,
+                      output_config: Optional[AudioOutputConfig]) -> Audio:
+        if output_config is None:
+            return audio
+        processed = output_config.apply(audio.samples,
+                                        audio.info.sample_rate)
+        return Audio(processed, audio.info, inference_ms=audio.inference_ms)
+
+    # -- modes ---------------------------------------------------------------
+    def synthesize_lazy(
+        self, text: str,
+        output_config: Optional[AudioOutputConfig] = None,
+    ) -> "SpeechStreamLazy":
+        return SpeechStreamLazy(self, self.phonemize_text(text), output_config)
+
+    def synthesize_parallel(
+        self, text: str,
+        output_config: Optional[AudioOutputConfig] = None,
+    ) -> "SpeechStreamBatched":
+        return SpeechStreamBatched(self, self.phonemize_text(text),
+                                   output_config)
+
+    # the reference name is kept as an alias; "parallel" on TPU means the
+    # sentence batch rides the data axis of the mesh, not a thread pool
+    synthesize_batched = synthesize_parallel
+
+    def synthesize_streamed(
+        self, text: str,
+        output_config: Optional[AudioOutputConfig] = None,
+        chunk_size: int = 45, chunk_padding: int = 3,
+    ) -> "RealtimeSpeechStream":
+        if not self.model.supports_streaming_output():
+            raise OperationError("model does not support streamed synthesis")
+        return RealtimeSpeechStream(self, self.phonemize_text(text),
+                                    output_config, chunk_size, chunk_padding)
+
+    def synthesize_to_file(
+        self, path: Union[str, Path], text: str,
+        output_config: Optional[AudioOutputConfig] = None,
+    ) -> None:
+        """Drain the batched stream and write one WAV
+        (``synth/lib.rs:170-198``)."""
+        samples = AudioSamples()
+        sample_rate = self.audio_output_info().sample_rate
+        for audio in self.synthesize_parallel(text, output_config):
+            samples.merge(audio.samples)
+        if len(samples) == 0:
+            raise OperationError("no audio synthesized")
+        write_wave_samples_to_file(path, samples.to_i16(), sample_rate)
+
+
+class SpeechStreamLazy:
+    """One sentence per ``next()`` (``synth/lib.rs:282-307``)."""
+
+    def __init__(self, synth: SpeechSynthesizer, phonemes: Phonemes,
+                 output_config: Optional[AudioOutputConfig]):
+        self._synth = synth
+        self._sentences = list(phonemes)
+        self._output_config = output_config
+        self._idx = 0
+
+    def __iter__(self) -> Iterator[Audio]:
+        return self
+
+    def __next__(self) -> Audio:
+        if self._idx >= len(self._sentences):
+            raise StopIteration
+        sentence = self._sentences[self._idx]
+        self._idx += 1
+        audio = self._synth.model.speak_one_sentence(sentence)
+        return self._synth._post_process(audio, self._output_config)
+
+
+class SpeechStreamBatched:
+    """All sentences in one padded device batch, precomputed at construction
+    (behavioral parity with the reference's parallel stream, ``:310-325``,
+    but a single device program instead of a rayon fan-out)."""
+
+    def __init__(self, synth: SpeechSynthesizer, phonemes: Phonemes,
+                 output_config: Optional[AudioOutputConfig]):
+        sentences = list(phonemes)
+        audios = synth.model.speak_batch(sentences) if sentences else []
+        self._results = [synth._post_process(a, output_config)
+                         for a in audios]
+        self._idx = 0
+
+    def __iter__(self) -> Iterator[Audio]:
+        return self
+
+    def __next__(self) -> Audio:
+        if self._idx >= len(self._results):
+            raise StopIteration
+        audio = self._results[self._idx]
+        self._idx += 1
+        return audio
+
+
+_SENTINEL = object()
+
+
+class RealtimeSpeechStream:
+    """Pipelined chunked streaming (``synth/lib.rs:335-430``).
+
+    A producer task on the shared pool walks sentences, calls the model's
+    ``stream_synthesis``, post-processes each chunk, and pushes it through a
+    queue; the consumer is this iterator.  Chunk size grows by the number of
+    chunks already produced when a new sentence starts (``:351-356``) —
+    small first chunk for TTFB, big later chunks for throughput.
+    """
+
+    def __init__(self, synth: SpeechSynthesizer, phonemes: Phonemes,
+                 output_config: Optional[AudioOutputConfig],
+                 chunk_size: int, chunk_padding: int):
+        self._queue: "queue.Queue" = queue.Queue()
+        self._synth = synth
+        self._cancelled = threading.Event()
+
+        def produce():
+            try:
+                chunks_done = 1
+                for sentence in phonemes:
+                    size = min(chunk_size * chunks_done, 1024)
+                    for chunk in synth.model.stream_synthesis(
+                            sentence, size, chunk_padding):
+                        if self._cancelled.is_set():
+                            return
+                        chunk = synth._post_process(chunk, output_config)
+                        self._queue.put(chunk)
+                        chunks_done += 1
+            except Exception as e:  # forwarded, then stream ends (:374-378)
+                self._queue.put(e)
+            finally:
+                self._queue.put(_SENTINEL)
+
+        synthesis_thread_pool().submit(produce)
+
+    def cancel(self) -> None:
+        self._cancelled.set()
+
+    def __iter__(self) -> Iterator[Audio]:
+        return self
+
+    def __next__(self) -> Audio:
+        item = self._queue.get()
+        if item is _SENTINEL:
+            raise StopIteration
+        if isinstance(item, Exception):
+            if isinstance(item, OperationError):
+                raise item
+            raise OperationError(str(item)) from item
+        return item

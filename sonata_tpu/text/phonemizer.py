@@ -38,6 +38,30 @@ from .segmentation import Clause, split_clauses
 LANG_SWITCH_RE = re.compile(r"\([^)]*\)")
 STRESS_RE = re.compile(r"[ˈˌ]")
 
+# Characters that extend the preceding phoneme rather than starting a new
+# one: length marks, aspiration/secondary articulations, rhotic hook, and
+# all combining diacritics (category Mn).
+_MODIFIERS = set("ːˑʰʲʷˤ˞")
+# Two-codepoint phonemes written without a tie bar: affricates + diphthongs.
+_DIGRAPHS = {"tʃ", "dʒ", "ts", "dz", "aɪ", "eɪ", "ɔɪ", "aʊ", "oʊ",
+             "ɪə", "eə", "ʊə"}
+
+
+def split_ipa_segments(ipa: str) -> list[str]:
+    """Split an IPA string into phoneme-level segments: base character plus
+    attached modifiers/diacritics, with affricate/diphthong digraphs kept
+    whole."""
+    import unicodedata
+
+    segments: list[str] = []
+    for ch in ipa:
+        attached = ch in _MODIFIERS or unicodedata.combining(ch)
+        if segments and (attached or segments[-1] + ch in _DIGRAPHS):
+            segments[-1] += ch
+        else:
+            segments.append(ch)
+    return segments
+
 ESPEAK_DATA_ENV = "SONATA_ESPEAKNG_DATA_DIRECTORY"
 
 
@@ -198,9 +222,11 @@ def _phonemize_line(
         if remove_stress:
             ipa = STRESS_RE.sub("", ipa)  # lib.rs:148-154
         if separator:
-            # insert separator between phoneme characters, preserving it as
-            # the reference does via phoneme_mode bits (lib.rs:102-105)
-            ipa = separator.join(ipa)
+            # insert separator between phonemes, as the reference does via
+            # phoneme_mode bits (lib.rs:102-105).  A "phoneme" is a base
+            # character plus its modifiers — not a code point: affricate
+            # ties, length marks, and combining diacritics stay attached.
+            ipa = separator.join(split_ipa_segments(ipa))
         # terminator punctuation is a real symbol for VITS (lib.rs:124-133)
         current.append(ipa + clause.terminator)
         if clause.sentence_end:

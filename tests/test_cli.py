@@ -1,0 +1,118 @@
+"""CLI frontend tests (reference: ``crates/frontends/cli/src/main.rs``).
+
+Run in-process through ``main(argv)`` so the jit caches warm once per
+module; the process-level surface (arg parsing, files, stdin JSON loop,
+stdout raw mode) is identical.
+"""
+
+import io
+import json
+import sys
+
+import numpy as np
+import pytest
+
+from sonata_tpu.audio import read_wave_file
+from sonata_tpu.frontends.cli import _numbered_output, build_parser, main
+
+from voices import write_tiny_voice
+
+
+@pytest.fixture(scope="module")
+def voice_path(tmp_path_factory):
+    return write_tiny_voice(tmp_path_factory.mktemp("voice"))
+
+
+def test_synthesize_to_wav(tmp_path, voice_path):
+    out = tmp_path / "out.wav"
+    rc = main([str(voice_path), "Hello world.", "-o", str(out)])
+    assert rc == 0
+    samples, sr, _ = read_wave_file(out)
+    assert sr == 16000 and len(samples) > 0
+
+
+def test_modes(tmp_path, voice_path):
+    for mode in ("lazy", "parallel", "realtime"):
+        out = tmp_path / f"{mode}.wav"
+        rc = main([str(voice_path), "One. Two.", "-o", str(out),
+                   "--mode", mode, "--chunk-size", "15"])
+        assert rc == 0
+        samples, _, _ = read_wave_file(out)
+        assert len(samples) > 0, mode
+
+
+def test_raw_stdout(voice_path, capsysbinary):
+    rc = main([str(voice_path), "Hi.", "-o", "-"])
+    assert rc == 0
+    raw = capsysbinary.readouterr().out
+    assert len(raw) > 0 and len(raw) % 2 == 0  # 16-bit samples
+
+
+def test_scales_and_prosody_flags(tmp_path, voice_path):
+    out = tmp_path / "p.wav"
+    rc = main([str(voice_path), "Testing flags now.", "-o", str(out),
+               "--length-scale", "1.5", "--rate", "10", "--volume", "80",
+               "--silence-ms", "50"])
+    assert rc == 0
+    assert read_wave_file(out)[0].size > 0
+
+
+def test_input_file(tmp_path, voice_path):
+    src = tmp_path / "in.txt"
+    src.write_text("From a file.")
+    out = tmp_path / "f.wav"
+    assert main([str(voice_path), "-f", str(src), "-o", str(out)]) == 0
+    assert read_wave_file(out)[0].size > 0
+
+
+def test_missing_voice_errors(tmp_path, capsys):
+    rc = main([str(tmp_path / "nope.json"), "hi"])
+    assert rc == 1
+    assert "error:" in capsys.readouterr().err
+
+
+def test_numbered_output():
+    # stem-N.ext enumeration (main.rs:235-247)
+    assert _numbered_output("out.wav", 0) == "out-0.wav"
+    assert _numbered_output("/a/b/x.wav", 3).endswith("/a/b/x-3.wav")
+
+
+def test_stdin_json_loop(tmp_path, voice_path, monkeypatch):
+    out = tmp_path / "req.wav"
+    requests = "\n".join([
+        json.dumps({"text": "First request.", "output_file": str(out)}),
+        "not json at all",
+        json.dumps({"text": "Second one.", "length_scale": 1.2,
+                    "output_file": str(out)}),
+    ]) + "\n"
+    monkeypatch.setattr(sys, "stdin", io.StringIO(requests))
+    rc = main([str(voice_path)])
+    assert rc == 0
+    # auto-enumerated outputs: req-0.wav, req-1.wav
+    a0, _, _ = read_wave_file(tmp_path / "req-0.wav")
+    a1, _, _ = read_wave_file(tmp_path / "req-1.wav")
+    assert a0.size > 0 and a1.size > 0
+
+
+def test_parser_defaults():
+    args = build_parser().parse_args(["cfg.json", "hello"])
+    assert args.mode == "parallel"
+    assert args.chunk_size == 100 and args.chunk_padding == 3  # main.rs:158-159
+    assert args.backend == "xla"
+
+
+def test_stdin_requests_do_not_leak_scales(tmp_path, voice_path, monkeypatch):
+    # request 1 sets length_scale=2.0; request 2 must get voice defaults
+    out = tmp_path / "leak.wav"
+    reqs = "\n".join([
+        json.dumps({"text": "Set scales here now.", "length_scale": 2.5,
+                    "output_file": str(out)}),
+        json.dumps({"text": "Set scales here now.",
+                    "output_file": str(out)}),
+    ]) + "\n"
+    monkeypatch.setattr(sys, "stdin", io.StringIO(reqs))
+    assert main([str(voice_path)]) == 0
+    a0, _, _ = read_wave_file(tmp_path / "leak-0.wav")
+    a1, _, _ = read_wave_file(tmp_path / "leak-1.wav")
+    # stretched request must be materially longer than the default one
+    assert a0.size > a1.size * 1.5

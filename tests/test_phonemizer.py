@@ -149,3 +149,14 @@ def test_arabic_diacritics_survive_g2p():
     from sonata_tpu.text.rule_g2p import phonemize_clause
 
     assert phonemize_clause("مَرحَبا", "ar") == "marħabaː"
+
+
+def test_separator_respects_phoneme_segments():
+    from sonata_tpu.text.phonemizer import split_ipa_segments
+
+    assert split_ipa_segments("tʃɛɹ") == ["tʃ", "ɛ", "ɹ"]
+    assert split_ipa_segments("iːɡəl") == ["iː", "ɡ", "ə", "l"]
+    ph = text_to_phonemes("x", separator="_", backend=type(
+        "B", (), {"name": "b",
+                  "phonemize_clause": lambda s, t, v: "tʃiːz"})())
+    assert ph[0] == "tʃ_iː_z."

@@ -214,3 +214,12 @@ def test_out_of_range_speaker_id_raises():
     v.set_fallback_synthesis_config(sc)
     with pytest.raises(OperationError):
         v.speak_one_sentence("tɛst.")
+
+
+def test_batch_is_bucketed(voice):
+    # 3 sentences must pad to the 4-batch bucket: one compiled executable
+    # shared by any 3-or-4 sentence batch
+    audios = voice.speak_batch(["tɛst.", "wʌn.", "tuː."])
+    assert len(audios) == 3
+    key_batches = {k[0] for k in voice._enc_cache}
+    assert 3 not in key_batches and 4 in key_batches

@@ -42,3 +42,31 @@ def tiny_multispeaker_voice(n: int = 4, seed: int = 0) -> PiperVoice:
         num_speakers=n,
         speaker_id_map={f"spk{i}": i for i in range(n)},
     )
+
+
+def write_tiny_voice(dirpath, seed: int = 0, **overrides):
+    """Materialize a tiny voice on disk (config JSON + npz weights);
+    returns the config path."""
+    import json
+    from pathlib import Path
+
+    from sonata_tpu.models.serialization import save_params
+
+    v = tiny_voice(seed=seed, **overrides)
+    dirpath = Path(dirpath)
+    cfg = {
+        "audio": {"sample_rate": 16000, "quality": None},
+        "num_speakers": v.config.num_speakers,
+        "speaker_id_map": v.config.speaker_id_map,
+        "espeak": {"voice": v.config.espeak_voice},
+        "num_symbols": v.config.num_symbols,
+        "phoneme_id_map": v.config.phoneme_id_map,
+        "model": {k: (list(x) if isinstance(x, tuple) else x)
+                  for k, x in TINY_MODEL.items()},
+    }
+    cfg["model"]["resblock_dilation_sizes"] = [
+        list(d) for d in TINY_MODEL["resblock_dilation_sizes"]]
+    config_path = dirpath / "voice.onnx.json"
+    config_path.write_text(json.dumps(cfg))
+    save_params(dirpath / "voice.npz", v.params)
+    return config_path
