@@ -1,0 +1,316 @@
+"""Streaming gRPC server.
+
+TPU-native analogue of the reference's ``sonata-grpc`` frontend
+(``crates/frontends/grpc/src/main.rs``):
+
+- same service surface (see :mod:`.grpc_messages`);
+- voice registry keyed by a stable hash of the canonical config path,
+  idempotent per path (``main.rs:83-98``; the reference uses
+  ``xxh3_64(path)/10^13`` — we use blake2b since ids are opaque strings);
+- ``SynthesizeUtterance`` streams per-sentence ``SynthesisResult`` with RTF
+  (``main.rs:321-355``); unlike the reference — which ignores
+  ``synthesis_mode`` and always goes lazy (``:332-333``, MODE_BATCHED
+  vestigial) — batched mode is honored here, because batched is where the
+  TPU wins;
+- ``SynthesizeUtteranceRealtime`` streams raw wave chunks with
+  chunk 55 / padding 3 (``main.rs:383``);
+- synthesis runs on the shared synthesis pool so the gRPC threads stay
+  responsive (the reference's ``spawn_blocking`` + channel bridge,
+  ``main.rs:381-409``, maps onto grpc's own worker threads plus our pool);
+- error mapping SonataError → Status (``main.rs:47-59``);
+- binds ``127.0.0.1:$SONATA_GRPC_SERVER_PORT``, default 49314
+  (``main.rs:17,437-440``); logging env ``SONATA_GRPC`` (``:413-416``).
+
+grpcio is used through a ``GenericRpcHandler`` with our own message codec —
+no protoc plugin exists in this environment.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import threading
+from pathlib import Path
+from typing import Iterator, Optional
+
+import grpc
+
+from .. import __version__
+from ..core import FailedToLoadResource, OperationError, SonataError
+from ..models import PiperVoice, from_config_path
+from ..synth import AudioOutputConfig, SpeechSynthesizer
+from . import grpc_messages as pb
+
+log = logging.getLogger("sonata.grpc")
+
+DEFAULT_PORT = 49314  # main.rs:17
+_SERVICE_PATH = f"{pb.PACKAGE}.{pb.SERVICE}"
+
+
+def voice_id_for(config_path: str) -> str:
+    """Stable opaque id per canonical path (``main.rs:18,83-95``)."""
+    canon = str(Path(config_path).resolve())
+    digest = hashlib.blake2b(canon.encode(), digest_size=8).hexdigest()
+    return str(int(digest, 16) // 10**13)
+
+
+class _Voice:
+    def __init__(self, voice: PiperVoice, config_path: str, voice_id: str):
+        self.voice = voice
+        self.synth = SpeechSynthesizer(voice)
+        self.config_path = config_path
+        self.voice_id = voice_id
+
+
+def _status_for(e: SonataError) -> grpc.StatusCode:
+    # main.rs:47-59 mapping
+    if isinstance(e, FailedToLoadResource):
+        return grpc.StatusCode.NOT_FOUND
+    if isinstance(e, OperationError):
+        return grpc.StatusCode.ABORTED
+    return grpc.StatusCode.UNKNOWN
+
+
+class SonataGrpcService:
+    """RPC implementations over a lock-protected voice registry
+    (``main.rs:76``)."""
+
+    def __init__(self, mesh=None, seed: int = 0):
+        self._voices: dict[str, _Voice] = {}
+        self._lock = threading.RLock()
+        self._loading: dict[str, threading.Lock] = {}
+        self._mesh = mesh
+        self._seed = seed
+
+    # -- helpers -------------------------------------------------------------
+    def _get(self, voice_id: str, context) -> _Voice:
+        with self._lock:
+            v = self._voices.get(voice_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"voice {voice_id!r} not loaded")
+        return v
+
+    def _voice_info(self, v: _Voice) -> pb.VoiceInfo:
+        # main.rs:124-170
+        sc = v.voice.get_fallback_synthesis_config()
+        info = v.voice.audio_output_info()
+        return pb.VoiceInfo(
+            voice_id=v.voice_id,
+            synth_options=pb.SynthesisOptions(
+                speaker=sc.speaker[0] if sc.speaker else None,
+                length_scale=sc.length_scale,
+                noise_scale=sc.noise_scale,
+                noise_w=sc.noise_w,
+            ),
+            speakers=v.voice.get_speakers() or {},
+            audio=pb.AudioInfo(sample_rate=info.sample_rate,
+                               num_channels=info.num_channels,
+                               sample_width=info.sample_width),
+            language=v.voice.get_language(),
+            quality=pb.Quality.from_string(v.voice.config.quality),
+            supports_streaming_output=v.voice.supports_streaming_output(),
+        )
+
+    # -- unary RPCs -----------------------------------------------------------
+    def GetSonataVersion(self, request: pb.Empty, context) -> pb.Version:
+        return pb.Version(version=__version__)
+
+    def LoadVoice(self, request: pb.VoicePath, context) -> pb.VoiceInfo:
+        vid = voice_id_for(request.config_path)
+        # per-voice load lock: concurrent loads of the same path block on
+        # one load instead of each importing the model (the reference holds
+        # its registry lock across the load, main.rs:83-98; a per-voice
+        # lock keeps other voices servable meanwhile)
+        with self._lock:
+            existing = self._voices.get(vid)
+            if existing is None:
+                load_lock = self._loading.setdefault(vid, threading.Lock())
+        if existing is not None:  # idempotent per path (main.rs:96-98)
+            return self._voice_info(existing)
+        with load_lock:
+            with self._lock:
+                existing = self._voices.get(vid)
+            if existing is not None:
+                return self._voice_info(existing)
+            try:
+                voice = from_config_path(request.config_path, seed=self._seed,
+                                         mesh=self._mesh)
+            except SonataError as e:
+                context.abort(_status_for(e), str(e))
+            v = _Voice(voice, request.config_path, vid)
+            with self._lock:
+                self._voices[vid] = v
+                self._loading.pop(vid, None)
+        log.info("loaded voice %s from %s", vid, request.config_path)
+        return self._voice_info(v)
+
+    def GetVoiceInfo(self, request: pb.VoiceIdentifier, context) -> pb.VoiceInfo:
+        return self._voice_info(self._get(request.voice_id, context))
+
+    def GetSynthesisOptions(self, request: pb.VoiceIdentifier,
+                            context) -> pb.SynthesisOptions:
+        v = self._get(request.voice_id, context)
+        return self._voice_info(v).synth_options
+
+    def SetSynthesisOptions(self, request: pb.VoiceSynthesisOptions,
+                            context) -> pb.SynthesisOptions:
+        # main.rs:211-255
+        v = self._get(request.voice_id, context)
+        opts = request.synthesis_options
+        sc = v.voice.get_fallback_synthesis_config()
+        if opts is not None:
+            if opts.speaker is not None:
+                sid = v.voice.speaker_name_to_id(opts.speaker)
+                if sid is None and opts.speaker.isdigit():
+                    sid = int(opts.speaker)
+                if sid is None:
+                    context.abort(grpc.StatusCode.NOT_FOUND,
+                                  f"unknown speaker {opts.speaker!r}")
+                sc.speaker = (opts.speaker, sid)
+            if opts.length_scale is not None:
+                sc.length_scale = opts.length_scale
+            if opts.noise_scale is not None:
+                sc.noise_scale = opts.noise_scale
+            if opts.noise_w is not None:
+                sc.noise_w = opts.noise_w
+        v.voice.set_fallback_synthesis_config(sc)
+        return self._voice_info(v).synth_options
+
+    # -- streaming RPCs --------------------------------------------------------
+    @staticmethod
+    def _speech_args_config(args: Optional[pb.SpeechArgs]):
+        if args is None:
+            return None
+        if all(x is None for x in (args.rate, args.volume, args.pitch,
+                                   args.appended_silence_ms)):
+            return None
+        return AudioOutputConfig(rate=args.rate, volume=args.volume,
+                                 pitch=args.pitch,
+                                 appended_silence_ms=args.appended_silence_ms)
+
+    def SynthesizeUtterance(self, request: pb.Utterance,
+                            context) -> Iterator[pb.SynthesisResult]:
+        v = self._get(request.voice_id, context)
+        cfg = self._speech_args_config(request.speech_args)
+        try:
+            if request.synthesis_mode in (pb.SynthesisMode.PARALLEL,
+                                          pb.SynthesisMode.BATCHED):
+                stream = v.synth.synthesize_parallel(request.text, cfg)
+            else:
+                stream = v.synth.synthesize_lazy(request.text, cfg)
+            for audio in stream:
+                yield pb.SynthesisResult(
+                    wav_samples=audio.as_wave_bytes(),
+                    rtf=audio.real_time_factor())  # main.rs:345-348
+        except SonataError as e:
+            context.abort(_status_for(e), str(e))
+
+    def SynthesizeUtteranceRealtime(self, request: pb.Utterance,
+                                    context) -> Iterator[pb.WaveSamples]:
+        v = self._get(request.voice_id, context)
+        cfg = self._speech_args_config(request.speech_args)
+        try:
+            stream = v.synth.synthesize_streamed(
+                request.text, cfg, chunk_size=55, chunk_padding=3)  # :383
+            for chunk in stream:
+                yield pb.WaveSamples(wav_samples=chunk.as_wave_bytes())
+        except SonataError as e:
+            context.abort(_status_for(e), str(e))
+
+
+# method name → (request type, response type, is_server_streaming)
+_METHODS = {
+    "GetSonataVersion": (pb.Empty, pb.Version, False),
+    "LoadVoice": (pb.VoicePath, pb.VoiceInfo, False),
+    "GetVoiceInfo": (pb.VoiceIdentifier, pb.VoiceInfo, False),
+    "GetSynthesisOptions": (pb.VoiceIdentifier, pb.SynthesisOptions, False),
+    "SetSynthesisOptions": (pb.VoiceSynthesisOptions, pb.SynthesisOptions,
+                            False),
+    "SynthesizeUtterance": (pb.Utterance, pb.SynthesisResult, True),
+    "SynthesizeUtteranceRealtime": (pb.Utterance, pb.WaveSamples, True),
+}
+
+
+class _Handler(grpc.GenericRpcHandler):
+    def __init__(self, service: SonataGrpcService):
+        self._service = service
+
+    def service(self, handler_call_details):
+        path = handler_call_details.method  # "/sonata_grpc.sonata_grpc/X"
+        prefix = f"/{_SERVICE_PATH}/"
+        if not path.startswith(prefix):
+            return None
+        name = path[len(prefix):]
+        entry = _METHODS.get(name)
+        if entry is None:
+            return None
+        req_cls, resp_cls, streaming = entry
+        method = getattr(self._service, name)
+        deserialize = req_cls.decode
+        serialize = lambda m: m.encode()  # noqa: E731
+        if streaming:
+            return grpc.unary_stream_rpc_method_handler(
+                method, request_deserializer=deserialize,
+                response_serializer=serialize)
+        return grpc.unary_unary_rpc_method_handler(
+            method, request_deserializer=deserialize,
+            response_serializer=serialize)
+
+
+def create_server(port: Optional[int] = None, *, mesh=None, seed: int = 0,
+                  max_workers: int = 16,
+                  host: str = "127.0.0.1") -> tuple[grpc.Server, int]:
+    from concurrent.futures import ThreadPoolExecutor
+
+    port = port if port is not None else int(
+        os.environ.get("SONATA_GRPC_SERVER_PORT", DEFAULT_PORT))
+    service = SonataGrpcService(mesh=mesh, seed=seed)
+    server = grpc.server(ThreadPoolExecutor(max_workers=max_workers,
+                                            thread_name_prefix="sonata_grpc"))
+    server.add_generic_rpc_handlers((_Handler(service),))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        raise OperationError(f"cannot bind {host}:{port}")
+    return server, bound
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=os.environ.get("SONATA_GRPC", "INFO").upper(),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="sonata-tpu-grpc")
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--voice", action="append", default=[],
+                    help="preload a voice config at startup (repeatable)")
+    args = ap.parse_args(argv)
+
+    server, port = create_server(args.port, host=args.host)
+    server.start()
+    log.info("sonata-tpu gRPC server v%s listening on %s:%d",
+             __version__, args.host, port)
+    if args.voice:
+        # preload through the public RPC path for identical semantics
+        channel = grpc.insecure_channel(f"{args.host}:{port}")
+        stub = channel.unary_unary(
+            f"/{_SERVICE_PATH}/LoadVoice",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=pb.VoiceInfo.decode)
+        for cfg in args.voice:
+            info = stub(pb.VoicePath(config_path=cfg))
+            log.info("preloaded voice %s", info.voice_id)
+    try:
+        server.wait_for_termination()
+    except KeyboardInterrupt:
+        server.stop(grace=2.0)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

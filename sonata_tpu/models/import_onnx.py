@@ -19,67 +19,52 @@ from __future__ import annotations
 
 import struct
 from pathlib import Path
-from typing import Iterator, Union
+from typing import Union
 
 import numpy as np
 
 from ..core import FailedToLoadResource
+from ..utils.protowire import (
+    WIRE_LEN as _WIRE_LEN,
+    WIRE_VARINT as _WIRE_VARINT,
+    WireError,
+    iter_fields as _iter_fields,
+    read_varint as _read_varint,
+)
 from .config import VitsHyperParams
 
-_WIRE_VARINT = 0
-_WIRE_64BIT = 1
-_WIRE_LEN = 2
-_WIRE_32BIT = 5
 
-
-def _read_varint(buf: memoryview, pos: int) -> tuple[int, int]:
-    result = 0
-    shift = 0
-    while True:
-        if pos >= len(buf):
-            raise FailedToLoadResource("truncated protobuf varint")
-        b = buf[pos]
-        pos += 1
-        result |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return result, pos
-        shift += 7
-        if shift > 70:
-            raise FailedToLoadResource("malformed protobuf varint")
-
-
-def iter_fields(buf: memoryview) -> Iterator[tuple[int, int, object]]:
-    """Yield (field_number, wire_type, value) over a protobuf message."""
-    pos = 0
-    while pos < len(buf):
-        key, pos = _read_varint(buf, pos)
-        field, wire = key >> 3, key & 0x7
-        if wire == _WIRE_VARINT:
-            value, pos = _read_varint(buf, pos)
-        elif wire == _WIRE_64BIT:
-            value = buf[pos:pos + 8]
-            pos += 8
-        elif wire == _WIRE_LEN:
-            n, pos = _read_varint(buf, pos)
-            value = buf[pos:pos + n]
-            pos += n
-        elif wire == _WIRE_32BIT:
-            value = buf[pos:pos + 4]
-            pos += 4
-        else:
-            raise FailedToLoadResource(f"unsupported protobuf wire type {wire}")
-        yield field, wire, value
+def iter_fields(buf):
+    """protowire field iterator with errors mapped to resource failures."""
+    try:
+        yield from _iter_fields(buf)
+    except WireError as e:
+        raise FailedToLoadResource(f"malformed protobuf: {e}") from e
 
 
 _DTYPE = {1: np.float32, 7: np.int64, 10: np.float16, 11: np.float64,
           6: np.int32, 9: np.bool_}
 
 
-def _decode_tensor(buf: memoryview) -> tuple[str, np.ndarray]:
+def _varints(value) -> list[int]:
+    """Decode a packed-varint payload, mapping wire errors to load errors."""
+    out: list[int] = []
+    pos = 0
+    mv = memoryview(value)
+    try:
+        while pos < len(mv):
+            v, pos = _read_varint(mv, pos)
+            out.append(v)
+    except WireError as e:
+        raise FailedToLoadResource(f"malformed packed varints: {e}") from e
+    return out
+
+
+def _decode_tensor(buf) -> tuple[str, np.ndarray]:
     dims: list[int] = []
     data_type = 1
     name = ""
-    raw = None
+    raw = None  # memoryview into the file buffer — zero-copy until np
     float_data: list[float] = []
     int64_data: list[int] = []
     for field, wire, value in iter_fields(buf):
@@ -87,30 +72,22 @@ def _decode_tensor(buf: memoryview) -> tuple[str, np.ndarray]:
             if wire == _WIRE_VARINT:
                 dims.append(int(value))
             else:  # packed
-                pos = 0
-                mv = memoryview(value)
-                while pos < len(mv):
-                    v, pos = _read_varint(mv, pos)
-                    dims.append(v)
+                dims.extend(_varints(value))
         elif field == 2 and wire == _WIRE_VARINT:
             data_type = int(value)
         elif field == 8:
             name = bytes(value).decode("utf-8", errors="replace")
         elif field == 9:
-            raw = bytes(value)
+            raw = value
         elif field == 4:  # float_data (packed or repeated)
             if wire == _WIRE_LEN:
                 float_data.extend(
-                    struct.unpack(f"<{len(value) // 4}f", bytes(value)))
+                    struct.unpack(f"<{len(value) // 4}f", value))
             else:
-                float_data.append(struct.unpack("<f", bytes(value))[0])
+                float_data.append(struct.unpack("<f", value)[0])
         elif field == 7:  # int64_data
             if wire == _WIRE_LEN:
-                pos = 0
-                mv = memoryview(value)
-                while pos < len(mv):
-                    v, pos = _read_varint(mv, pos)
-                    int64_data.append(v)
+                int64_data.extend(_varints(value))
             else:
                 int64_data.append(int(value))
     dtype = _DTYPE.get(data_type)

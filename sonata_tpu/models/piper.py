@@ -58,10 +58,11 @@ class PiperVoice(BaseModel):
     """A loaded Piper voice: config + params + compiled-executable caches."""
 
     def __init__(self, config: ModelConfig, params, *, seed: int = 0,
-                 tashkeel: Optional[TashkeelEngine] = None):
+                 tashkeel: Optional[TashkeelEngine] = None, mesh=None):
         self.config = config
         self.hp = config.hyper
         self.params = params
+        self.mesh = mesh  # jax.sharding.Mesh → batch rides the data axis
         self.multi_speaker = config.num_speakers > 1
         self._synth_lock = threading.RLock()
         self._synth_config = config.inference.copy()
@@ -238,6 +239,28 @@ class PiperVoice(BaseModel):
                 f"(voice has {self.config.num_speakers} speakers)")
         return jnp.full((batch,), sid, dtype=jnp.int32)
 
+    def _jit(self, run, batch_args: tuple[int, ...]):
+        """jit, adding mesh shardings when a mesh is attached.
+
+        ``batch_args``: positional indices of [B, ...]-shaped arguments
+        (sharded on the data axis).  Params, RNG keys, and scalars are
+        replicated; every output is batch-major and data-sharded.  XLA then
+        runs the whole stage SPMD across chips with no code changes — this
+        is the TPU counterpart of the reference's rayon fan-out
+        (``synth/src/lib.rs:316-320``).
+        """
+        if self.mesh is None:
+            return jax.jit(run)
+        import inspect
+
+        from ..parallel.mesh import data_sharding, replicated
+
+        ds, rep = data_sharding(self.mesh), replicated(self.mesh)
+        n_args = len(inspect.signature(run).parameters)
+        in_shardings = tuple(ds if i in batch_args else rep
+                             for i in range(n_args))
+        return jax.jit(run, in_shardings=in_shardings, out_shardings=ds)
+
     def _encode_fn(self, b: int, t: int):
         """Jitted stage 1 for batch/text bucket (b, t)."""
         key = (b, t)
@@ -259,7 +282,8 @@ class PiperVoice(BaseModel):
                             length_scale=length_scale)
                         return m_p, logs_p, w_ceil, x_mask
 
-                fn = jax.jit(run)
+                batch = (1, 2, 6) if self.multi_speaker else (1, 2)
+                fn = self._jit(run, batch)
                 self._enc_cache[key] = fn
         return fn
 
@@ -275,19 +299,49 @@ class PiperVoice(BaseModel):
                 hp = self.hp
                 max_frames = f
 
-                def run(params, m_p, logs_p, w_ceil, x_mask, rng, noise_scale,
-                        sid=None):
-                    g = (params["emb_g"][sid][:, None, :]
-                         if sid is not None else None)
+                def body(params, m_p, logs_p, w_ceil, x_mask, rng,
+                         noise_scale, g):
                     z, y_mask, y_lengths = vits.acoustics(
                         params, hp, m_p, logs_p, w_ceil, x_mask, rng,
                         noise_scale=noise_scale, max_frames=max_frames, g=g)
                     if with_decode:
                         wav = vits.decode(params, hp, z, g=g)
-                        return wav, y_lengths * hp.hop_length
+                        wav_lengths = y_lengths * hp.hop_length
+                        # i16 quantization on device: 4x less host transfer,
+                        # which dominates when the chip sits behind a network
+                        # tunnel.  The per-row peak ships back too so the
+                        # host can restore original amplitudes — relative
+                        # loudness across sentences is preserved, and the
+                        # final WAV write still applies the reference's
+                        # single global normalization (samples.rs:51-75).
+                        valid = (jnp.arange(wav.shape[1])[None, :]
+                                 < wav_lengths[:, None])
+                        peak = jnp.max(jnp.abs(wav) * valid, axis=1,
+                                       keepdims=True)
+                        scale = 32767.0 / jnp.maximum(peak, 0.01)
+                        wav_i16 = jnp.clip(wav * scale, -32768.0,
+                                           32767.0).astype(jnp.int16)
+                        return wav_i16, wav_lengths, peak[:, 0]
                     return z, y_lengths
 
-                fn = jax.jit(run)
+                # signature arity must match the call exactly so that mesh
+                # in_shardings line up positionally
+                if self.multi_speaker:
+                    def run(params, m_p, logs_p, w_ceil, x_mask, rng,
+                            noise_scale, sid):
+                        g = params["emb_g"][sid][:, None, :]
+                        return body(params, m_p, logs_p, w_ceil, x_mask, rng,
+                                    noise_scale, g)
+
+                    batch = (1, 2, 3, 4, 7)
+                else:
+                    def run(params, m_p, logs_p, w_ceil, x_mask, rng,
+                            noise_scale):
+                        return body(params, m_p, logs_p, w_ceil, x_mask, rng,
+                                    noise_scale, None)
+
+                    batch = (1, 2, 3, 4)
+                fn = self._jit(run, batch)
                 cache[f] = fn
         return fn
 
@@ -327,6 +381,13 @@ class PiperVoice(BaseModel):
         """
         n_real = len(ids_list)
         b = bucket_for(n_real, BATCH_BUCKETS)
+        if self.mesh is not None:
+            from ..parallel.mesh import DATA_AXIS
+
+            # round up to a multiple of the data-axis size so the batch
+            # shards evenly on any mesh (including non-power-of-two)
+            d = self.mesh.shape[DATA_AXIS]
+            b = ((max(b, d) + d - 1) // d) * d
         t = bucket_for(max(len(i) for i in ids_list), TEXT_BUCKETS)
         padded = ids_list + [[0]] * (b - n_real)
         ids = jnp.asarray([pad_to(i, t) for i in padded], dtype=jnp.int32)
@@ -351,8 +412,11 @@ class PiperVoice(BaseModel):
                 jnp.float32(sc.noise_scale)]
         if sid is not None:
             args.append(sid)
-        wav, wav_lengths = syn(*args)
-        wav = np.asarray(jax.block_until_ready(wav))[:n_real]
+        wav_i16, wav_lengths, peaks = syn(*args)
+        wav_i16 = np.asarray(jax.block_until_ready(wav_i16))[:n_real]
+        peaks = np.maximum(np.asarray(peaks)[:n_real, None], 0.01)
+        # dequantize back to the model's original amplitudes
+        wav = wav_i16.astype(np.float32) * (peaks / 32767.0)
         return wav, np.asarray(wav_lengths)[:n_real]
 
     # ------------------------------------------------------------------
@@ -368,7 +432,9 @@ class PiperVoice(BaseModel):
 
         t_enc0 = time.perf_counter()
         m_p, logs_p, w_ceil, x_mask, sid, b, t = self._run_encode([ids], sc)
-        total_frames = int(jnp.sum(w_ceil))
+        # row 0 only: with a mesh attached the batch is padded with dummy
+        # rows whose frames must not count
+        total_frames = int(jnp.sum(w_ceil[:1]))
         f = bucket_for(max(total_frames, 1), FRAME_BUCKETS)
         aco = self._acoustics_fn(b, t, f)
         args = [self.params, m_p, logs_p, w_ceil, x_mask, self._next_rng(),

@@ -1,0 +1,172 @@
+"""Tashkeel: Arabic diacritization as a JAX character tagger.
+
+The reference delegates this to the ``libtashkeel`` Rust crate, which runs
+its own bundled ONNX seq-tagging model whenever a voice's eSpeak language is
+``ar`` (``crates/sonata/models/piper/src/lib.rs:63-77,270-281``).  Per the
+survey's plan (SURVEY §2.2), the model itself moves on-device: a character
+embedding → transformer encoder → per-character diacritic classifier,
+reusing the same JAX blocks as the VITS text encoder, jitted with the same
+text buckets.
+
+The tagger predicts one of 16 diacritic combinations (haraka ± shadda,
+tanwin forms, sukun, or none) to insert after each base character.
+
+File format: ``.npz`` of the flat param pytree plus a ``__meta__`` JSON
+blob (vocab + hyperparams), produced by :meth:`TashkeelModel.save`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import FailedToLoadResource
+from ..utils.buckets import bucket_for, pad_to
+from . import modules as m
+from .serialization import flatten_params, unflatten_params
+
+# diacritic classes: index 0 = none; combinations a trained model can emit
+DIACRITICS = [
+    "",        # none
+    "َ",  # fatha
+    "ُ",  # damma
+    "ِ",  # kasra
+    "ْ",  # sukun
+    "ً",  # fathatan
+    "ٌ",  # dammatan
+    "ٍ",  # kasratan
+    "ّ",          # shadda
+    "َّ",    # shadda + fatha
+    "ُّ",    # shadda + damma
+    "ِّ",    # shadda + kasra
+    "ًّ",    # shadda + fathatan
+    "ٌّ",    # shadda + dammatan
+    "ٍّ",    # shadda + kasratan
+    "ـ",  # tatweel (rare; kept for class-count parity)
+]
+_DIACRITIC_CHARS = set("".join(DIACRITICS))
+
+_DEFAULT_VOCAB = list(
+    " !\"#$%&'()*+,-./0123456789:;<=>?@[]^_`{|}~"
+    "ءآأؤإئابةتثجحخدذرزسشصضطظعغفقكلمنهوىي"
+    "،؛؟"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TashkeelHyperParams:
+    hidden: int = 128
+    filter: int = 512
+    n_heads: int = 4
+    n_layers: int = 3
+    kernel: int = 3
+    window: int = 16
+
+
+def init_tashkeel(rng, hp: TashkeelHyperParams, n_vocab: int) -> dict:
+    r_emb, r_enc, r_proj = jax.random.split(rng, 3)
+    return {
+        "emb": jax.random.normal(r_emb, (n_vocab, hp.hidden)) * 0.02,
+        "encoder": m.init_transformer(
+            r_enc, channels=hp.hidden, filter_channels=hp.filter,
+            n_heads=hp.n_heads, n_layers=hp.n_layers, kernel=hp.kernel,
+            window=hp.window),
+        "proj": m._conv_init(r_proj, 1, hp.hidden, len(DIACRITICS)),
+    }
+
+
+def apply_tashkeel(params: dict, hp: TashkeelHyperParams, ids, lengths):
+    """ids [B, T] → diacritic class logits [B, T, n_classes]."""
+    from .vits import sequence_mask
+
+    mask = sequence_mask(lengths, ids.shape[1])
+    x = params["emb"][ids]
+    x = m.transformer(x, mask, params["encoder"], n_heads=hp.n_heads,
+                      window=hp.window)
+    return m.conv1d(x, params["proj"]) * mask
+
+
+def strip_diacritics(text: str) -> str:
+    return "".join(ch for ch in text if ch not in _DIACRITIC_CHARS)
+
+
+class TashkeelModel:
+    """Inference wrapper with text bucketing and a jit cache."""
+
+    def __init__(self, params: dict, hp: TashkeelHyperParams,
+                 vocab: Optional[list[str]] = None):
+        self.params = params
+        self.hp = hp
+        self.vocab = vocab or list(_DEFAULT_VOCAB)
+        self._char_to_id = {c: i + 1 for i, c in enumerate(self.vocab)}  # 0=pad
+        self._jit_cache: dict[int, object] = {}
+
+    @property
+    def n_vocab(self) -> int:
+        return len(self.vocab) + 1
+
+    @classmethod
+    def random(cls, hp: Optional[TashkeelHyperParams] = None,
+               seed: int = 0) -> "TashkeelModel":
+        hp = hp or TashkeelHyperParams()
+        vocab = list(_DEFAULT_VOCAB)
+        params = init_tashkeel(jax.random.PRNGKey(seed), hp, len(vocab) + 1)
+        return cls(params, hp, vocab)
+
+    @classmethod
+    def from_path(cls, path: Union[str, Path]) -> "TashkeelModel":
+        try:
+            with np.load(Path(path), allow_pickle=False) as data:
+                flat = {k: data[k] for k in data.files if k != "__meta__"}
+                meta = json.loads(bytes(data["__meta__"]).decode("utf-8"))
+        except (OSError, KeyError, ValueError) as e:
+            raise FailedToLoadResource(
+                f"cannot load tashkeel model {path}: {e}") from e
+        hp = TashkeelHyperParams(**meta.get("hyper", {}))
+        return cls(unflatten_params(flat), hp, meta.get("vocab"))
+
+    def save(self, path: Union[str, Path]) -> None:
+        flat = flatten_params(self.params)
+        meta = json.dumps({
+            "hyper": dataclasses.asdict(self.hp),
+            "vocab": self.vocab,
+        }).encode("utf-8")
+        np.savez(Path(path), __meta__=np.frombuffer(meta, dtype=np.uint8),
+                 **flat)
+
+    def _fn(self, t: int):
+        fn = self._jit_cache.get(t)
+        if fn is None:
+            hp = self.hp
+
+            def run(params, ids, lengths):
+                return apply_tashkeel(params, hp, ids, lengths)
+
+            fn = jax.jit(run)
+            self._jit_cache[t] = fn
+        return fn
+
+    def diacritize(self, text: str) -> str:
+        """Insert predicted diacritics after each Arabic character."""
+        base = strip_diacritics(text)
+        if not base:
+            return text
+        ids = [self._char_to_id.get(ch, 0) for ch in base]
+        t = bucket_for(len(ids))
+        ids_arr = jnp.asarray([pad_to(ids, t)], dtype=jnp.int32)
+        lengths = jnp.asarray([len(ids)], dtype=jnp.int32)
+        logits = self._fn(t)(self.params, ids_arr, lengths)
+        classes = np.asarray(jnp.argmax(logits, axis=-1))[0, :len(ids)]
+        out = []
+        for ch, cls in zip(base, classes):
+            out.append(ch)
+            # only Arabic letters take diacritics
+            if "ء" <= ch <= "ي":
+                out.append(DIACRITICS[int(cls)])
+        return "".join(out)

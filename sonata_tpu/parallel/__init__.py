@@ -1,0 +1,24 @@
+"""Device-mesh parallelism: data-parallel batch sharding, sequence-parallel
+ring attention, multi-host initialization (analogue of — and upgrade over —
+the reference's rayon thread fan-out, SURVEY §2.4/§5)."""
+
+from .mesh import (
+    DATA_AXIS,
+    SEQ_AXIS,
+    data_sharding,
+    initialize_distributed,
+    make_mesh,
+    replicated,
+)
+from .ring import ring_attention, ring_attention_sharded
+
+__all__ = [
+    "DATA_AXIS",
+    "SEQ_AXIS",
+    "data_sharding",
+    "initialize_distributed",
+    "make_mesh",
+    "replicated",
+    "ring_attention",
+    "ring_attention_sharded",
+]

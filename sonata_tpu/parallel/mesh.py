@@ -1,0 +1,78 @@
+"""Device mesh construction and sharding helpers.
+
+The reference's entire parallelism story is host threads (rayon fan-out over
+sentences, SURVEY §2.4); its distributed story is "none" (§5).  Here the
+equivalent axes are real hardware axes:
+
+- ``data`` — sentence batches sharded across chips over ICI (the TPU
+  counterpart of the rayon ``par_iter``),
+- ``seq``  — sequence (context) parallelism for long inputs via ring
+  attention (:mod:`.ring`).
+
+Multi-host: ``initialize_distributed`` wraps ``jax.distributed.initialize``
+so a pod slice forms one mesh; batches ride ICI inside a slice and DCN
+across slices (the XLA-collectives replacement for the NCCL/MPI backends a
+GPU framework would carry).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+log = logging.getLogger("sonata.parallel")
+
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+
+
+def make_mesh(n_devices: Optional[int] = None, *,
+              seq_parallel: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a ``(data, seq)`` mesh over the first ``n_devices`` devices.
+
+    ``seq_parallel`` splits the device pool between batch parallelism and
+    sequence parallelism; 1 means a pure data mesh.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    if n % seq_parallel != 0:
+        raise ValueError(
+            f"{n} devices not divisible by seq_parallel={seq_parallel}")
+    grid = np.array(devs).reshape(n // seq_parallel, seq_parallel)
+    return Mesh(grid, (DATA_AXIS, SEQ_AXIS))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-axis sharding for [B, ...] tensors."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Join a multi-host JAX runtime (no-op when single-process).
+
+    On TPU pods the defaults are discovered from the environment; arguments
+    exist for explicit DCN setups.
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+        log.info("distributed runtime: process %d/%d, %d local devices",
+                 jax.process_index(), jax.process_count(),
+                 jax.local_device_count())
+    except (RuntimeError, ValueError) as e:
+        log.debug("distributed init skipped: %s", e)

@@ -1,0 +1,222 @@
+"""Minimal protobuf wire-format codec.
+
+The environment has grpcio but no protoc Python plugin or ``onnx``/
+``protobuf`` runtime, so the framework carries its own ~200-line wire codec:
+enough of proto3 (varint / 64-bit / length-delimited / 32-bit fields,
+packed repeats, maps-as-entry-messages) for the gRPC message surface
+(:mod:`sonata_tpu.frontends.grpc_messages`) and the ONNX weight reader
+(:mod:`sonata_tpu.models.import_onnx`).
+
+Declarative usage::
+
+    class Version(Message):
+        FIELDS = {"version": Field(1, "string")}
+
+    data = Version(version="1.0").encode()
+    msg  = Version.decode(data)
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Any, Iterator, Optional
+
+WIRE_VARINT = 0
+WIRE_64BIT = 1
+WIRE_LEN = 2
+WIRE_32BIT = 5
+
+_KIND_WIRE = {
+    "string": WIRE_LEN, "bytes": WIRE_LEN, "message": WIRE_LEN,
+    "map_int64_string": WIRE_LEN,
+    "uint32": WIRE_VARINT, "uint64": WIRE_VARINT, "int64": WIRE_VARINT,
+    "int32": WIRE_VARINT, "bool": WIRE_VARINT, "enum": WIRE_VARINT,
+    "float": WIRE_32BIT, "double": WIRE_64BIT,
+}
+
+
+class WireError(ValueError):
+    pass
+
+
+def read_varint(buf, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(buf):
+            raise WireError("truncated varint")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise WireError("malformed varint")
+
+
+def write_varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def iter_fields(buf) -> Iterator[tuple[int, int, Any]]:
+    """Yield (field_number, wire_type, raw value) over a message buffer.
+
+    Length-delimited values are yielded as zero-copy memoryview slices —
+    important for the ONNX reader, where a voice file is ~100 MB and copies
+    per tensor would spike memory at load.
+    """
+    pos = 0
+    mv = memoryview(buf)
+    while pos < len(mv):
+        key, pos = read_varint(mv, pos)
+        field, wire = key >> 3, key & 0x7
+        if wire == WIRE_VARINT:
+            value, pos = read_varint(mv, pos)
+        elif wire == WIRE_64BIT:
+            value = mv[pos:pos + 8]
+            pos += 8
+        elif wire == WIRE_LEN:
+            n, pos = read_varint(mv, pos)
+            if pos + n > len(mv):
+                raise WireError("truncated length-delimited field")
+            value = mv[pos:pos + n]
+            pos += n
+        elif wire == WIRE_32BIT:
+            value = mv[pos:pos + 4]
+            pos += 4
+        else:
+            raise WireError(f"unsupported wire type {wire}")
+        yield field, wire, value
+
+
+def _encode_value(num: int, kind: str, value, submsg) -> bytes:
+    key = write_varint((num << 3) | _KIND_WIRE[kind])
+    if kind == "string":
+        payload = value.encode("utf-8")
+        return key + write_varint(len(payload)) + payload
+    if kind == "bytes":
+        return key + write_varint(len(value)) + value
+    if kind == "message":
+        payload = value.encode()
+        return key + write_varint(len(payload)) + payload
+    if kind in ("uint32", "uint64", "int64", "int32", "enum"):
+        return key + write_varint(int(value) & 0xFFFFFFFFFFFFFFFF)
+    if kind == "bool":
+        return key + write_varint(1 if value else 0)
+    if kind == "float":
+        return key + struct.pack("<f", float(value))
+    if kind == "double":
+        return key + struct.pack("<d", float(value))
+    if kind == "map_int64_string":
+        out = b""
+        for k, v in value.items():
+            entry = (write_varint((1 << 3) | WIRE_VARINT) + write_varint(int(k))
+                     + write_varint((2 << 3) | WIRE_LEN)
+                     + write_varint(len(v.encode())) + v.encode())
+            out += key + write_varint(len(entry)) + entry
+        return out
+    raise WireError(f"unknown kind {kind}")
+
+
+def _decode_value(kind: str, wire: int, raw, submsg):
+    if kind == "string":
+        return bytes(raw).decode("utf-8", errors="replace")
+    if kind == "bytes":
+        return bytes(raw)
+    if kind == "message":
+        return submsg.decode(raw)
+    if kind in ("uint32", "uint64", "int64", "int32", "enum"):
+        return int(raw)
+    if kind == "bool":
+        return bool(raw)
+    if kind == "float":
+        return struct.unpack("<f", raw)[0]
+    if kind == "double":
+        return struct.unpack("<d", raw)[0]
+    if kind == "map_int64_string":
+        k = v = None
+        for f, w, val in iter_fields(raw):
+            if f == 1:
+                k = int(val)
+            elif f == 2:
+                v = bytes(val).decode("utf-8", errors="replace")
+        return (k, v)
+    raise WireError(f"unknown kind {kind}")
+
+
+@dataclass(frozen=True)
+class Field:
+    num: int
+    kind: str
+    message: Optional[type] = None  # for kind == "message"
+    repeated: bool = False
+
+
+class Message:
+    """Base for declarative wire messages: subclass and define ``FIELDS``."""
+
+    FIELDS: dict[str, Field] = {}
+
+    def __init__(self, **kwargs):
+        for name in self.FIELDS:
+            f = self.FIELDS[name]
+            default = [] if f.repeated else ({} if f.kind ==
+                                             "map_int64_string" else None)
+            setattr(self, name, kwargs.pop(name, default))
+        if kwargs:
+            raise TypeError(f"unknown fields: {sorted(kwargs)}")
+
+    def encode(self) -> bytes:
+        out = b""
+        for name, f in self.FIELDS.items():
+            value = getattr(self, name)
+            if value is None:
+                continue
+            if f.repeated:
+                for item in value:
+                    out += _encode_value(f.num, f.kind, item, f.message)
+            elif f.kind == "map_int64_string":
+                if value:
+                    out += _encode_value(f.num, f.kind, value, f.message)
+            else:
+                out += _encode_value(f.num, f.kind, value, f.message)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Message":
+        by_num = {f.num: (name, f) for name, f in cls.FIELDS.items()}
+        msg = cls()
+        for num, wire, raw in iter_fields(data):
+            entry = by_num.get(num)
+            if entry is None:
+                continue  # unknown field: skip (proto3 semantics)
+            name, f = entry
+            value = _decode_value(f.kind, wire, raw, f.message)
+            if f.repeated:
+                getattr(msg, name).append(value)
+            elif f.kind == "map_int64_string":
+                k, v = value
+                getattr(msg, name)[k] = v
+            else:
+                setattr(msg, name, value)
+        return msg
+
+    def __repr__(self):
+        fields = ", ".join(f"{n}={getattr(self, n)!r}" for n in self.FIELDS
+                           if getattr(self, n) not in (None, [], {}))
+        return f"{type(self).__name__}({fields})"
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and all(getattr(self, n) == getattr(other, n)
+                        for n in self.FIELDS))

@@ -1,0 +1,227 @@
+"""gRPC frontend tests: a real in-process server driven through a real
+grpcio channel (the reference has no network-less gRPC test — SURVEY §4
+"no mocks or fake backends exist anywhere").
+
+Covers the full RPC surface (``grpc/src/main.rs``): version, idempotent
+voice load, info, options get/set, both streaming synthesis RPCs, and error
+mapping.
+"""
+
+import numpy as np
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from sonata_tpu.frontends import grpc_messages as pb
+from sonata_tpu.frontends.grpc_server import create_server, voice_id_for
+from sonata_tpu.utils.protowire import Field, Message
+
+from voices import write_tiny_voice
+
+
+@pytest.fixture(scope="module")
+def server_and_voice(tmp_path_factory):
+    config_path = write_tiny_voice(tmp_path_factory.mktemp("grpc_voice"))
+    server, port = create_server(0)  # ephemeral port
+    server.start()
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    yield channel, str(config_path)
+    server.stop(grace=None)
+
+
+def _unary(channel, name, req, resp_cls):
+    fn = channel.unary_unary(f"/sonata_grpc.sonata_grpc/{name}",
+                             request_serializer=lambda m: m.encode(),
+                             response_deserializer=resp_cls.decode)
+    return fn(req)
+
+
+def _stream(channel, name, req, resp_cls):
+    fn = channel.unary_stream(f"/sonata_grpc.sonata_grpc/{name}",
+                              request_serializer=lambda m: m.encode(),
+                              response_deserializer=resp_cls.decode)
+    return list(fn(req))
+
+
+def test_version(server_and_voice):
+    channel, _ = server_and_voice
+    v = _unary(channel, "GetSonataVersion", pb.Empty(), pb.Version)
+    assert v.version
+
+
+def test_load_voice_idempotent(server_and_voice):
+    channel, cfg = server_and_voice
+    info1 = _unary(channel, "LoadVoice", pb.VoicePath(config_path=cfg),
+                   pb.VoiceInfo)
+    info2 = _unary(channel, "LoadVoice", pb.VoicePath(config_path=cfg),
+                   pb.VoiceInfo)
+    assert info1.voice_id == info2.voice_id == voice_id_for(cfg)
+    assert info1.audio.sample_rate == 16000
+    assert info1.supports_streaming_output is True
+    assert info1.synth_options.length_scale == pytest.approx(1.0)
+
+
+def test_get_voice_info_unknown_is_not_found(server_and_voice):
+    channel, _ = server_and_voice
+    with pytest.raises(grpc.RpcError) as e:
+        _unary(channel, "GetVoiceInfo", pb.VoiceIdentifier(voice_id="999"),
+               pb.VoiceInfo)
+    assert e.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_load_missing_voice_is_not_found(server_and_voice):
+    channel, _ = server_and_voice
+    with pytest.raises(grpc.RpcError) as e:
+        _unary(channel, "LoadVoice",
+               pb.VoicePath(config_path="/nope/missing.json"), pb.VoiceInfo)
+    assert e.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_set_synthesis_options(server_and_voice):
+    channel, cfg = server_and_voice
+    vid = _unary(channel, "LoadVoice", pb.VoicePath(config_path=cfg),
+                 pb.VoiceInfo).voice_id
+    out = _unary(channel, "SetSynthesisOptions", pb.VoiceSynthesisOptions(
+        voice_id=vid,
+        synthesis_options=pb.SynthesisOptions(length_scale=1.4)),
+        pb.SynthesisOptions)
+    assert out.length_scale == pytest.approx(1.4)
+    got = _unary(channel, "GetSynthesisOptions",
+                 pb.VoiceIdentifier(voice_id=vid), pb.SynthesisOptions)
+    assert got.length_scale == pytest.approx(1.4)
+    # restore
+    _unary(channel, "SetSynthesisOptions", pb.VoiceSynthesisOptions(
+        voice_id=vid,
+        synthesis_options=pb.SynthesisOptions(length_scale=1.0)),
+        pb.SynthesisOptions)
+
+
+def test_synthesize_utterance_streams_sentences(server_and_voice):
+    channel, cfg = server_and_voice
+    vid = _unary(channel, "LoadVoice", pb.VoicePath(config_path=cfg),
+                 pb.VoiceInfo).voice_id
+    results = _stream(channel, "SynthesizeUtterance",
+                      pb.Utterance(voice_id=vid,
+                                   text="Hello there. Second sentence."),
+                      pb.SynthesisResult)
+    assert len(results) == 2
+    for r in results:
+        assert len(r.wav_samples) > 0 and len(r.wav_samples) % 2 == 0
+        assert r.rtf > 0
+
+
+def test_synthesize_batched_mode(server_and_voice):
+    channel, cfg = server_and_voice
+    vid = _unary(channel, "LoadVoice", pb.VoicePath(config_path=cfg),
+                 pb.VoiceInfo).voice_id
+    results = _stream(channel, "SynthesizeUtterance",
+                      pb.Utterance(voice_id=vid, text="One. Two. Three.",
+                                   synthesis_mode=pb.SynthesisMode.BATCHED),
+                      pb.SynthesisResult)
+    assert len(results) == 3
+
+
+def test_synthesize_realtime_streams_chunks(server_and_voice):
+    channel, cfg = server_and_voice
+    vid = _unary(channel, "LoadVoice", pb.VoicePath(config_path=cfg),
+                 pb.VoiceInfo).voice_id
+    chunks = _stream(channel, "SynthesizeUtteranceRealtime",
+                     pb.Utterance(voice_id=vid,
+                                  text="A longer sentence with many words "
+                                       "to force several chunks out."),
+                     pb.WaveSamples)
+    assert len(chunks) >= 1
+    assert all(len(c.wav_samples) > 0 for c in chunks)
+
+
+def test_speech_args_rate(server_and_voice):
+    channel, cfg = server_and_voice
+    vid = _unary(channel, "LoadVoice", pb.VoicePath(config_path=cfg),
+                 pb.VoiceInfo).voice_id
+
+    def total(mode_args):
+        rs = _stream(channel, "SynthesizeUtterance",
+                     pb.Utterance(voice_id=vid, text="Rate check sentence.",
+                                  speech_args=mode_args),
+                     pb.SynthesisResult)
+        return sum(len(r.wav_samples) for r in rs)
+
+    neutral = total(pb.SpeechArgs(rate=10))   # percent 10 → 1.0x
+    fast = total(pb.SpeechArgs(rate=30))      # percent 30 → 2.0x
+    assert neutral > fast * 1.5
+
+
+# ---------------------------------------------------------------------------
+# wire codec
+# ---------------------------------------------------------------------------
+
+def test_protowire_roundtrip_all_kinds():
+    class Inner(Message):
+        FIELDS = {"x": Field(1, "uint32")}
+
+    class M(Message):
+        FIELDS = {
+            "s": Field(1, "string"),
+            "b": Field(2, "bytes"),
+            "u": Field(3, "uint32"),
+            "f": Field(4, "float"),
+            "flag": Field(5, "bool"),
+            "sub": Field(6, "message", Inner),
+            "m": Field(7, "map_int64_string"),
+            "reps": Field(8, "string", repeated=True),
+        }
+
+    m = M(s="héllo", b=b"\x00\x01", u=7, f=1.5, flag=True,
+          sub=Inner(x=42), m={3: "three", 9: "nine"}, reps=["a", "b"])
+    back = M.decode(m.encode())
+    assert back == m
+    assert back.sub.x == 42 and back.m == {3: "three", 9: "nine"}
+
+
+def test_protowire_skips_unknown_fields():
+    class V1(Message):
+        FIELDS = {"a": Field(1, "uint32"), "z": Field(9, "string")}
+
+    class V0(Message):
+        FIELDS = {"a": Field(1, "uint32")}
+
+    data = V1(a=5, z="future").encode()
+    old = V0.decode(data)
+    assert old.a == 5
+
+
+def test_concurrent_load_voice_loads_once(tmp_path_factory, monkeypatch):
+    import threading
+
+    from sonata_tpu.frontends import grpc_server as srv
+
+    cfg = str(write_tiny_voice(tmp_path_factory.mktemp("ccload")))
+    calls = []
+    real = srv.from_config_path
+
+    def counting(path, **kw):
+        calls.append(path)
+        return real(path, **kw)
+
+    monkeypatch.setattr(srv, "from_config_path", counting)
+    service = srv.SonataGrpcService()
+
+    class Ctx:
+        def abort(self, code, msg):
+            raise AssertionError(f"abort: {code} {msg}")
+
+    results = []
+
+    def load():
+        results.append(service.LoadVoice(
+            __import__("sonata_tpu.frontends.grpc_messages",
+                       fromlist=["VoicePath"]).VoicePath(config_path=cfg),
+            Ctx()))
+
+    threads = [threading.Thread(target=load) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(calls) == 1  # one real load despite 4 concurrent requests
+    assert len({r.voice_id for r in results}) == 1

@@ -1,0 +1,78 @@
+"""pysonata API-surface tests (reference ``crates/frontends/python``)."""
+
+import pytest
+
+from sonata_tpu import pysonata
+
+from voices import write_tiny_voice
+
+
+@pytest.fixture(scope="module")
+def model(tmp_path_factory):
+    cfg = write_tiny_voice(tmp_path_factory.mktemp("pyvoice"))
+    return pysonata.PiperModel(cfg)
+
+
+@pytest.fixture(scope="module")
+def tts(model):
+    return pysonata.Sonata.with_piper(model)
+
+
+def test_model_properties(model):
+    assert model.sample_rate == 16000
+    assert model.supports_streaming_output is True
+    assert model.language == "en-us"
+    assert model.speakers is None
+
+
+def test_scales_roundtrip(model):
+    scales = model.get_scales()
+    assert scales.length_scale == pytest.approx(1.0)
+    model.set_scales(pysonata.PiperScales(1.3, 0.5, 0.6))
+    back = model.get_scales()
+    assert back.length_scale == pytest.approx(1.3)
+    assert back.noise_scale == pytest.approx(0.5)
+    model.set_scales(pysonata.PiperScales(1.0, 0.667, 0.8))
+
+
+def test_synthesize_is_lazy_alias(tts):
+    assert pysonata.Sonata.synthesize is pysonata.Sonata.synthesize_lazy
+    waves = list(tts.synthesize("Hello world."))
+    assert len(waves) == 1
+    w = waves[0]
+    assert w.sample_rate == 16000
+    assert w.duration_ms > 0
+    assert w.real_time_factor > 0
+    assert len(w.get_wave_bytes()) > 0
+
+
+def test_parallel_and_streamed(tts):
+    par = list(tts.synthesize_parallel("One. Two."))
+    assert len(par) == 2
+    rt = list(tts.synthesize_streamed("A sentence long enough to chunk "
+                                      "into several pieces here.",
+                                      chunk_size=15, chunk_padding=2))
+    assert len(rt) >= 1
+    assert all(isinstance(c, pysonata.WaveSamples) for c in rt)
+
+
+def test_save_to_file(tts, tmp_path):
+    wave = next(iter(tts.synthesize("Save me.")))
+    p = tmp_path / "w.wav"
+    wave.save_to_file(p)
+    from sonata_tpu.audio import read_wave_file
+
+    assert read_wave_file(p)[0].size > 0
+
+
+def test_unknown_speaker_raises(model):
+    with pytest.raises(pysonata.SonataError):
+        model.set_speaker("nobody")
+
+
+def test_free_phonemize_text():
+    sents = pysonata.phonemize_text("Hello world. Again?")
+    assert len(sents) == 2
+    with_sep = pysonata.phonemize_text("chez", language="en",
+                                       separator="|")
+    assert "|" in with_sep[0]

@@ -1,0 +1,86 @@
+"""Arabic diacritization chain tests.
+
+Reference behavior: tashkeel auto-enabled when ``espeak.voice == "ar"``
+(``piper/src/lib.rs:63-77``), diacritization runs before phonemization
+(``:253-258``).  A trained model isn't shipped here; mechanics are tested
+with a random tagger (class insertion, stripping, round trip, save/load)
+plus the end-to-end Arabic voice path with the identity engine.
+"""
+
+import numpy as np
+import pytest
+
+from sonata_tpu.models.tashkeel import (
+    DIACRITICS,
+    TashkeelModel,
+    strip_diacritics,
+)
+from sonata_tpu.text.tashkeel import TashkeelEngine
+
+from voices import tiny_voice
+
+
+@pytest.fixture(scope="module")
+def model():
+    return TashkeelModel.random(seed=1)
+
+
+def test_strip_diacritics():
+    assert strip_diacritics("مَرْحَبًا") == "مرحبا"
+    assert strip_diacritics("hello") == "hello"
+
+
+def test_diacritize_inserts_only_valid_marks(model):
+    out = model.diacritize("مرحبا بالعالم")
+    assert strip_diacritics(out) == "مرحبا بالعالم"
+    extras = [c for c in out if c not in "مرحبا بالعالم"]
+    valid = set("".join(DIACRITICS))
+    assert all(c in valid for c in extras)
+
+
+def test_diacritize_deterministic(model):
+    a = model.diacritize("السلام عليكم")
+    b = model.diacritize("السلام عليكم")
+    assert a == b
+
+
+def test_diacritize_skips_non_arabic(model):
+    out = model.diacritize("abc 123")
+    assert out == "abc 123"
+
+
+def test_save_load_roundtrip(tmp_path, model):
+    p = tmp_path / "tashkeel.npz"
+    model.save(p)
+    back = TashkeelModel.from_path(p)
+    assert back.vocab == model.vocab
+    assert back.diacritize("مرحبا") == model.diacritize("مرحبا")
+
+
+def test_engine_identity_fallback():
+    eng = TashkeelEngine()
+    assert not eng.has_model
+    assert eng.diacritize("مرحبا") == "مرحبا"
+
+
+def test_arabic_voice_uses_tashkeel_hook():
+    calls = []
+
+    class Spy:
+        def diacritize(self, text):
+            calls.append(text)
+            return text
+
+    v = tiny_voice(espeak={"voice": "ar"})
+    v._tashkeel = Spy()
+    ph = v.phonemize_text("مرحبا بالعالم")
+    assert calls == ["مرحبا بالعالم"]
+    assert len(ph) == 1 and len(ph[0]) > 0
+
+
+def test_arabic_end_to_end_synthesis():
+    v = tiny_voice(espeak={"voice": "ar"})
+    audios = v.speak_batch(list(v.phonemize_text("مرحبا بالعالم.")))
+    assert len(audios) == 1
+    assert len(audios[0].samples) > 0
+    assert np.isfinite(audios[0].samples.data).all()

@@ -223,3 +223,11 @@ def test_batch_is_bucketed(voice):
     assert len(audios) == 3
     key_batches = {k[0] for k in voice._enc_cache}
     assert 3 not in key_batches and 4 in key_batches
+
+
+def test_batch_preserves_relative_loudness(voice):
+    # device-side i16 quantization must not flatten per-sentence amplitude
+    audios = voice.speak_batch(["ə.", "loʊd ʃaʊt wɜːdz hɪɹ naʊ."])
+    peaks = [float(np.max(np.abs(a.samples.data))) for a in audios]
+    assert all(p > 0 for p in peaks)
+    assert abs(peaks[0] - peaks[1]) > 1e-5  # not both pinned to one scale
