@@ -68,9 +68,16 @@ class PiperVoice(BaseModel):
         self._synth_config = config.inference.copy()
         self._jit_lock = threading.Lock()
         self._enc_cache: dict = {}
-        self._syn_cache: dict = {}
+        self._full_cache: dict = {}
         self._aco_cache: dict = {}
         self._dec_cache: dict = {}
+        # adaptive frame-budget estimator for the single-dispatch path:
+        # running upper bound of frames per input id per unit length_scale.
+        # Start optimistic — an underestimate costs one overflow retry on
+        # the first batch, while an overestimate inflates every transfer
+        # (the wav buffer scales with the frame bucket).
+        self._frames_per_id = 2.5
+        self._fpi_lock = threading.Lock()
         self._rng_lock = threading.Lock()
         self._rng_counter = 0
         self._seed = seed
@@ -287,14 +294,31 @@ class PiperVoice(BaseModel):
                 self._enc_cache[key] = fn
         return fn
 
-    def _acoustic_stage_fn(self, cache: dict, f: int, *, with_decode: bool):
-        """Shared builder for stage 2 (+ optional stage 3) jitted fns.
+    @staticmethod
+    def _decode_quantize(params, hp, z, y_lengths, g):
+        """HiFi-GAN decode + on-device peak-scaled i16 quantization.
 
-        Batch and streaming paths must stay in lockstep on conditioning and
-        acoustics plumbing, so there is exactly one definition of both.
+        i16 quarters the host transfer, which dominates when the chip sits
+        behind a network link.  The per-row peak ships back too so the host
+        restores original amplitudes — relative loudness across sentences is
+        preserved, and the final WAV write still applies the reference's
+        single global normalization (samples.rs:51-75).
+
+        The single definition of the quantization contract — every path that
+        decodes a full batch goes through here.
         """
+        wav = vits.decode(params, hp, z, g=g)
+        wav_lengths = y_lengths * hp.hop_length
+        valid = (jnp.arange(wav.shape[1])[None, :] < wav_lengths[:, None])
+        peak = jnp.max(jnp.abs(wav) * valid, axis=1, keepdims=True)
+        scale = 32767.0 / jnp.maximum(peak, 0.01)
+        wav_i16 = jnp.clip(wav * scale, -32768.0, 32767.0).astype(jnp.int16)
+        return wav_i16, wav_lengths, peak[:, 0]
+
+    def _acoustics_fn(self, b: int, t: int, f: int):
+        """Jitted stage 2 alone (streaming path: keep z on device)."""
         with self._jit_lock:
-            fn = cache.get(f)
+            fn = self._aco_cache.get(f)
             if fn is None:
                 hp = self.hp
                 max_frames = f
@@ -304,24 +328,6 @@ class PiperVoice(BaseModel):
                     z, y_mask, y_lengths = vits.acoustics(
                         params, hp, m_p, logs_p, w_ceil, x_mask, rng,
                         noise_scale=noise_scale, max_frames=max_frames, g=g)
-                    if with_decode:
-                        wav = vits.decode(params, hp, z, g=g)
-                        wav_lengths = y_lengths * hp.hop_length
-                        # i16 quantization on device: 4x less host transfer,
-                        # which dominates when the chip sits behind a network
-                        # tunnel.  The per-row peak ships back too so the
-                        # host can restore original amplitudes — relative
-                        # loudness across sentences is preserved, and the
-                        # final WAV write still applies the reference's
-                        # single global normalization (samples.rs:51-75).
-                        valid = (jnp.arange(wav.shape[1])[None, :]
-                                 < wav_lengths[:, None])
-                        peak = jnp.max(jnp.abs(wav) * valid, axis=1,
-                                       keepdims=True)
-                        scale = 32767.0 / jnp.maximum(peak, 0.01)
-                        wav_i16 = jnp.clip(wav * scale, -32768.0,
-                                           32767.0).astype(jnp.int16)
-                        return wav_i16, wav_lengths, peak[:, 0]
                     return z, y_lengths
 
                 # signature arity must match the call exactly so that mesh
@@ -342,16 +348,58 @@ class PiperVoice(BaseModel):
 
                     batch = (1, 2, 3, 4)
                 fn = self._jit(run, batch)
-                cache[f] = fn
+                self._aco_cache[f] = fn
         return fn
 
-    def _synth_fn(self, b: int, t: int, f: int):
-        """Jitted stage 2+3 fused (acoustics + decode) for non-streaming."""
-        return self._acoustic_stage_fn(self._syn_cache, f, with_decode=True)
+    def _full_fn(self, b: int, t: int, f: int):
+        """Single-dispatch batch pipeline: ids → int16 audio.
 
-    def _acoustics_fn(self, b: int, t: int, f: int):
-        """Jitted stage 2 alone (streaming path: keep z on device)."""
-        return self._acoustic_stage_fn(self._aco_cache, f, with_decode=False)
+        The compute for a whole batch is well under a millisecond on a TPU
+        chip; batched latency is round trips.  This path does encode +
+        acoustics + decode + quantization in ONE device program with a
+        *statically estimated* frame budget, so a batch costs exactly one
+        dispatch and one result transfer — no frame-count host sync.  The
+        caller checks the returned per-row frame requirement and retries
+        with a bigger bucket on (rare) overflow.
+        """
+        key = (b, t, f)
+        with self._jit_lock:
+            fn = self._full_cache.get(key)
+            if fn is None:
+                hp = self.hp
+                max_frames = f
+
+                def body(params, ids, lens, rng, noise_w, length_scale,
+                         noise_scale, sid):
+                    rng_dur, rng_noise = jax.random.split(rng)
+                    m_p, logs_p, w_ceil, x_mask, g = vits.encode_text(
+                        params, hp, ids, lens, rng_dur, noise_w=noise_w,
+                        length_scale=length_scale, sid=sid)
+                    frames_needed = jnp.sum(w_ceil, axis=1).astype(jnp.int32)
+                    z, y_mask, y_lengths = vits.acoustics(
+                        params, hp, m_p, logs_p, w_ceil, x_mask, rng_noise,
+                        noise_scale=noise_scale, max_frames=max_frames, g=g)
+                    wav_i16, wav_lengths, peaks = self._decode_quantize(
+                        params, hp, z, y_lengths, g)
+                    return wav_i16, wav_lengths, peaks, frames_needed
+
+                if self.multi_speaker:
+                    def run(params, ids, lens, rng, noise_w, length_scale,
+                            noise_scale, sid):
+                        return body(params, ids, lens, rng, noise_w,
+                                    length_scale, noise_scale, sid)
+
+                    batch = (1, 2, 7)
+                else:
+                    def run(params, ids, lens, rng, noise_w, length_scale,
+                            noise_scale):
+                        return body(params, ids, lens, rng, noise_w,
+                                    length_scale, noise_scale, None)
+
+                    batch = (1, 2)
+                fn = self._jit(run, batch)
+                self._full_cache[key] = fn
+        return fn
 
     def _decode_window_fn(self, width: int):
         """Jitted chunk decoder: z window of static ``width`` → samples."""
@@ -372,20 +420,20 @@ class PiperVoice(BaseModel):
                 self._dec_cache[key] = fn
         return fn
 
-    def _run_encode(self, ids_list: list[list[int]], sc: SynthesisConfig):
-        """Pad to (batch, text) buckets and run stage 1.
+    def _pad_batch(self, ids_list: list[list[int]]):
+        """Pad a sentence batch to (batch, text) buckets.
 
         Both axes are bucketed so the number of compiled executables stays
         bounded under arbitrary workloads; dummy rows are masked out by
-        their length-0 semantics and dropped by callers.
+        their length-1 semantics and dropped by callers.  With a mesh
+        attached, the batch rounds up to a multiple of the data-axis size
+        so it shards evenly on any mesh (including non-power-of-two).
         """
         n_real = len(ids_list)
         b = bucket_for(n_real, BATCH_BUCKETS)
         if self.mesh is not None:
             from ..parallel.mesh import DATA_AXIS
 
-            # round up to a multiple of the data-axis size so the batch
-            # shards evenly on any mesh (including non-power-of-two)
             d = self.mesh.shape[DATA_AXIS]
             b = ((max(b, d) + d - 1) // d) * d
         t = bucket_for(max(len(i) for i in ids_list), TEXT_BUCKETS)
@@ -393,6 +441,11 @@ class PiperVoice(BaseModel):
         ids = jnp.asarray([pad_to(i, t) for i in padded], dtype=jnp.int32)
         lens = jnp.asarray([len(i) for i in ids_list] + [1] * (b - n_real),
                            dtype=jnp.int32)
+        return ids, lens, b, t
+
+    def _run_encode(self, ids_list: list[list[int]], sc: SynthesisConfig):
+        """Run stage 1 on a padded batch (streaming path)."""
+        ids, lens, b, t = self._pad_batch(ids_list)
         sid = self._sid_array(sc, b)
         args = [self.params, ids, lens, self._next_rng(),
                 jnp.float32(sc.noise_w), jnp.float32(sc.length_scale)]
@@ -401,23 +454,60 @@ class PiperVoice(BaseModel):
         m_p, logs_p, w_ceil, x_mask = self._encode_fn(b, t)(*args)
         return m_p, logs_p, w_ceil, x_mask, sid, b, t
 
+    def _estimate_frame_bucket(self, max_ids: int, length_scale: float) -> int:
+        with self._fpi_lock:
+            fpi = self._frames_per_id
+        est = max_ids * fpi * max(length_scale, 0.05) * 1.25
+        return bucket_for(max(int(est), 1), FRAME_BUCKETS)
+
+    def _observe_frames(self, max_ids: int, length_scale: float,
+                        frames: int) -> None:
+        ratio = frames / max(max_ids * max(length_scale, 0.05), 1.0)
+        with self._fpi_lock:
+            # decaying upper bound: shrinks slowly, jumps up immediately
+            self._frames_per_id = max(self._frames_per_id * 0.995, ratio)
+
     def _infer_batch(self, ids_list: list[list[int]], sc: SynthesisConfig):
+        """Batch ids → audio in ONE device round trip (estimate + retry).
+
+        The frame budget comes from the adaptive estimator rather than a
+        device sync: the whole batch is a single dispatch whose result
+        transfer also carries the true per-row frame requirements.  If the
+        estimate was too small (rare; the estimator tracks an upper bound)
+        the batch reruns once with a bucket that is known to fit.
+        """
         n_real = len(ids_list)
-        m_p, logs_p, w_ceil, x_mask, sid, b, t = self._run_encode(ids_list, sc)
-        # host sync on [B] ints only; dummy rows excluded from the bucket pick
-        frames = int(jnp.sum(w_ceil[:n_real], axis=1).max())
-        f = bucket_for(max(frames, 1), FRAME_BUCKETS)
-        syn = self._synth_fn(b, t, f)
-        args = [self.params, m_p, logs_p, w_ceil, x_mask, self._next_rng(),
-                jnp.float32(sc.noise_scale)]
-        if sid is not None:
-            args.append(sid)
-        wav_i16, wav_lengths, peaks = syn(*args)
-        wav_i16 = np.asarray(jax.block_until_ready(wav_i16))[:n_real]
-        peaks = np.maximum(np.asarray(peaks)[:n_real, None], 0.01)
+        max_ids = max(len(i) for i in ids_list)
+        ids, lens, b, t = self._pad_batch(ids_list)
+        sid = self._sid_array(sc, b)
+        # one key for both dispatches: the overflow retry must reproduce the
+        # exact duration draw it measured, or the bigger bucket could clip
+        # a fresh, longer draw
+        rng = self._next_rng()
+
+        def dispatch(f: int):
+            args = [self.params, ids, lens, rng,
+                    jnp.float32(sc.noise_w), jnp.float32(sc.length_scale),
+                    jnp.float32(sc.noise_scale)]
+            if sid is not None:
+                args.append(sid)
+            out = self._full_fn(b, t, f)(*args)
+            # one batched fetch: per-array round trips through a remote
+            # PJRT link cost ~70 ms each; device_get coalesces them
+            return jax.device_get(out)
+
+        f = self._estimate_frame_bucket(max_ids, sc.length_scale)
+        wav_i16, wav_lengths, peaks, frames_needed = dispatch(f)
+        actual = int(frames_needed[:n_real].max())
+        self._observe_frames(max_ids, sc.length_scale, actual)
+        if actual > f:  # overflow: audio was clipped; rerun with room
+            f = bucket_for(actual, FRAME_BUCKETS)
+            wav_i16, wav_lengths, peaks, frames_needed = dispatch(f)
+        wav_i16 = wav_i16[:n_real]
+        peaks = np.maximum(peaks[:n_real, None], 0.01)
         # dequantize back to the model's original amplitudes
         wav = wav_i16.astype(np.float32) * (peaks / 32767.0)
-        return wav, np.asarray(wav_lengths)[:n_real]
+        return wav, wav_lengths[:n_real]
 
     # ------------------------------------------------------------------
     # streaming (reference stream_synthesis, piper/src/lib.rs:652-668)

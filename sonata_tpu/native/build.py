@@ -27,15 +27,37 @@ def native_dir() -> Path:
     return _DIR
 
 
-def _build(name: str) -> Optional[Path]:
+def _python_flags() -> tuple[list[str], list[str]]:
+    """(cflags, ldflags) for embedding CPython."""
+    import sysconfig
+
+    include = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    version = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_python_version()
+    cflags = [f"-I{include}"]
+    ldflags = [f"-L{libdir}", f"-lpython{version}"] if libdir else []
+    return cflags, ldflags
+
+
+def _build(name: str, *, embed_python: bool = False) -> Optional[Path]:
     src = _DIR / "src" / f"{name}.cpp"
     lib = _DIR / f"lib{name}.so"
     if not src.exists():
         return None
-    if lib.exists() and lib.stat().st_mtime >= src.stat().st_mtime:
+    # staleness check includes headers: an ABI struct edit in include/
+    # must trigger a rebuild even if the .cpp is untouched
+    dep_mtime = src.stat().st_mtime
+    for header in (_DIR / "include").glob("*.h"):
+        dep_mtime = max(dep_mtime, header.stat().st_mtime)
+    if lib.exists() and lib.stat().st_mtime >= dep_mtime:
         return lib
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-           "-o", str(lib), str(src)]
+    extra_c: list[str] = []
+    extra_ld: list[str] = []
+    if embed_python:
+        extra_c, extra_ld = _python_flags()
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", *extra_c,
+           "-o", str(lib), str(src), *extra_ld]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired) as e:
@@ -47,15 +69,18 @@ def _build(name: str) -> Optional[Path]:
     return lib
 
 
-def _load(name: str) -> Optional[ctypes.CDLL]:
+def _load(name: str, *, embed_python: bool = False) -> Optional[ctypes.CDLL]:
     with _LOCK:
         if name in _CACHE:
             return _CACHE[name]
-        lib_path = _build(name)
+        lib_path = _build(name, embed_python=embed_python)
         handle = None
         if lib_path is not None:
+            # only the python-embedding library needs process-global
+            # symbol visibility (to resolve libpython symbols)
+            mode = ctypes.RTLD_GLOBAL if embed_python else ctypes.DEFAULT_MODE
             try:
-                handle = ctypes.CDLL(str(lib_path))
+                handle = ctypes.CDLL(str(lib_path), mode=mode)
             except OSError as e:
                 log.warning("cannot load %s: %s", lib_path, e)
         _CACHE[name] = handle
@@ -78,3 +103,8 @@ def load_dsp_library() -> Optional[ctypes.CDLL]:
         lib.sonata_dsp_version.restype = ctypes.c_char_p
         lib._sonata_configured = True
     return lib
+
+
+def load_capi_library() -> Optional[ctypes.CDLL]:
+    """The C ABI frontend (libsonata_tpu-equivalent), or None."""
+    return _load("sonata_capi", embed_python=True)

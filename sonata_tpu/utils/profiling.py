@@ -1,0 +1,85 @@
+"""Observability: RTF counters and profiler trace capture.
+
+The reference's entire tracing story is a wall-clock around each ORT run
+surfaced as ``real_time_factor`` (SURVEY §5).  We keep that (every
+``Audio`` carries ``inference_ms``) and add the TPU-native pieces the
+survey calls for: aggregate RTF counters and ``jax.profiler`` trace
+capture for Tensorboard/XProf.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+
+@dataclass
+class RtfStats:
+    utterances: int = 0
+    audio_ms: float = 0.0
+    inference_ms: float = 0.0
+
+    @property
+    def rtf(self) -> float:
+        return self.inference_ms / self.audio_ms if self.audio_ms else 0.0
+
+    @property
+    def audio_seconds_per_second(self) -> float:
+        return 1.0 / self.rtf if self.rtf else 0.0
+
+
+class RtfCounter:
+    """Thread-safe aggregate RTF accounting (e.g. one per gRPC server)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats = RtfStats()
+
+    def record(self, audio) -> None:
+        """Record one synthesized :class:`~sonata_tpu.audio.Audio`."""
+        with self._lock:
+            self._stats.utterances += 1
+            self._stats.audio_ms += audio.duration_ms()
+            self._stats.inference_ms += audio.inference_ms
+
+    def snapshot(self) -> RtfStats:
+        with self._lock:
+            return RtfStats(self._stats.utterances, self._stats.audio_ms,
+                            self._stats.inference_ms)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats = RtfStats()
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler device trace into ``log_dir`` (view with
+    Tensorboard/XProf)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def timed(label: str, sink: Optional[list] = None) -> Iterator[None]:
+    """Wall-clock a block; append ``(label, seconds)`` to ``sink`` or log."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        if sink is not None:
+            sink.append((label, dt))
+        else:
+            import logging
+
+            logging.getLogger("sonata.profiling").debug(
+                "%s: %.1f ms", label, dt * 1e3)

@@ -1,0 +1,203 @@
+"""C ABI frontend tests, driven through ctypes against the compiled
+``libsonata_capi.so`` (reference: ``crates/frontends/capi`` — its
+callback/event/cancel contract, SURVEY §2.1 capi row).
+
+The library joins the running interpreter (PyGILState), exactly as it would
+join an embedding C application.
+"""
+
+import ctypes
+
+import numpy as np
+import pytest
+
+from sonata_tpu.native.build import load_capi_library
+
+from voices import write_tiny_voice
+
+
+class Event(ctypes.Structure):
+    _fields_ = [
+        ("event_type", ctypes.c_int32),
+        ("error", ctypes.c_char_p),
+        ("len", ctypes.c_uint64),
+        ("data", ctypes.POINTER(ctypes.c_int16)),
+    ]
+
+
+CALLBACK = ctypes.CFUNCTYPE(ctypes.c_int32, ctypes.POINTER(Event),
+                            ctypes.c_void_p)
+
+
+class Params(ctypes.Structure):
+    _fields_ = [
+        ("mode", ctypes.c_int32),
+        ("rate", ctypes.c_uint8),
+        ("volume", ctypes.c_uint8),
+        ("pitch", ctypes.c_uint8),
+        ("appended_silence_ms", ctypes.c_uint32),
+        ("callback", CALLBACK),
+        ("user_data", ctypes.c_void_p),
+        ("nonblocking", ctypes.c_int32),
+    ]
+
+
+class AudioInfo(ctypes.Structure):
+    _fields_ = [
+        ("sample_rate", ctypes.c_uint32),
+        ("num_channels", ctypes.c_uint32),
+        ("sample_width", ctypes.c_uint32),
+    ]
+
+
+class SynthConfig(ctypes.Structure):
+    _fields_ = [
+        ("length_scale", ctypes.c_float),
+        ("noise_scale", ctypes.c_float),
+        ("noise_w", ctypes.c_float),
+        ("speaker_id", ctypes.c_int64),
+    ]
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = load_capi_library()
+    assert lib is not None, "C ABI library failed to build"
+    lib.libsonataLoadVoiceFromConfigPath.restype = ctypes.c_int64
+    lib.libsonataLoadVoiceFromConfigPath.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p)]
+    lib.libsonataSpeak.argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                                   ctypes.POINTER(Params)]
+    lib.libsonataSpeakToFile.argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                                         ctypes.c_char_p,
+                                         ctypes.POINTER(Params)]
+    lib.libsonataGetVersion.restype = ctypes.c_char_p
+    return lib
+
+
+@pytest.fixture(scope="module")
+def voice(lib, tmp_path_factory):
+    cfg = write_tiny_voice(tmp_path_factory.mktemp("capi_voice"))
+    err = ctypes.c_char_p()
+    handle = lib.libsonataLoadVoiceFromConfigPath(
+        str(cfg).encode(), ctypes.byref(err))
+    assert handle > 0, err.value
+    return handle
+
+
+def _params(callback, mode=0, nonblocking=0, **kw):
+    return Params(mode=mode, rate=kw.get("rate", 255),
+                  volume=kw.get("volume", 255), pitch=kw.get("pitch", 255),
+                  appended_silence_ms=kw.get("silence", 0),
+                  callback=CALLBACK(callback), user_data=None,
+                  nonblocking=nonblocking)
+
+
+def test_version(lib):
+    assert lib.libsonataGetVersion().decode().startswith("0.")
+
+
+def test_load_error_reports_message(lib):
+    err = ctypes.c_char_p()
+    rc = lib.libsonataLoadVoiceFromConfigPath(b"/nope.json",
+                                              ctypes.byref(err))
+    assert rc < 0
+    assert b"nope" in err.value
+    lib.libsonataFreeString(err)
+
+
+def test_audio_info(lib, voice):
+    info = AudioInfo()
+    assert lib.libsonataGetAudioInfo(voice, ctypes.byref(info)) == 0
+    assert info.sample_rate == 16000
+    assert info.num_channels == 1 and info.sample_width == 2
+
+
+def test_synth_config_roundtrip(lib, voice):
+    cfg = SynthConfig()
+    assert lib.libsonataGetPiperDefaultSynthConfig(voice,
+                                                   ctypes.byref(cfg)) == 0
+    assert cfg.length_scale == pytest.approx(1.0)
+    cfg.length_scale = 1.25
+    assert lib.libsonataSetPiperSynthConfig(voice, ctypes.byref(cfg)) == 0
+    cfg2 = SynthConfig()
+    lib.libsonataGetPiperDefaultSynthConfig(voice, ctypes.byref(cfg2))
+    assert cfg2.length_scale == pytest.approx(1.25)
+    cfg.length_scale = 1.0
+    lib.libsonataSetPiperSynthConfig(voice, ctypes.byref(cfg))
+
+
+def test_speak_callback_events(lib, voice):
+    events = []
+
+    def on_event(ev_ptr, user):
+        ev = ev_ptr.contents
+        if ev.event_type == 0:  # SPEECH
+            samples = np.ctypeslib.as_array(ev.data, shape=(ev.len,)).copy()
+            events.append(("speech", samples))
+        else:
+            events.append(("finished" if ev.event_type == 1 else "error",
+                           None))
+        return 0
+
+    p = _params(on_event)
+    rc = lib.libsonataSpeak(voice, "Hello from native code. Second sentence.".encode(),
+                            ctypes.byref(p))
+    assert rc == 0
+    kinds = [k for k, _ in events]
+    assert kinds.count("speech") == 2
+    assert kinds[-1] == "finished"
+    assert all(s.size > 0 for k, s in events if k == "speech")
+
+
+def test_speak_cancellation(lib, voice):
+    seen = []
+
+    def cancel_after_first(ev_ptr, user):
+        ev = ev_ptr.contents
+        seen.append(ev.event_type)
+        return 1 if ev.event_type == 0 else 0
+
+    p = _params(cancel_after_first)
+    rc = lib.libsonataSpeak(voice, "One. Two. Three. Four.".encode(),
+                            ctypes.byref(p))
+    assert rc == 21  # SONATA_ERR_CANCELLED
+    assert seen.count(0) == 1  # exactly one speech event delivered
+
+
+def test_speak_error_event_for_bad_handle(lib):
+    got = []
+
+    def on_event(ev_ptr, user):
+        ev = ev_ptr.contents
+        got.append((ev.event_type, ev.error))
+        return 0
+
+    p = _params(on_event)
+    rc = lib.libsonataSpeak(99999, b"hi", ctypes.byref(p))
+    assert rc == 18  # SYNTHESIS_FAILED
+    assert got and got[0][0] == 2  # ERROR event
+    assert b"99999" in got[0][1]
+
+
+def test_speak_to_file(lib, voice, tmp_path):
+    out = tmp_path / "c.wav"
+    rc = lib.libsonataSpeakToFile(voice, b"Write me to a file.",
+                                  str(out).encode(), None)
+    assert rc == 0
+    from sonata_tpu.audio import read_wave_file
+
+    samples, sr, _ = read_wave_file(out)
+    assert sr == 16000 and samples.size > 0
+
+
+def test_unload_and_invalid_handle(lib, tmp_path_factory):
+    cfg = write_tiny_voice(tmp_path_factory.mktemp("capi_unload"), seed=4)
+    err = ctypes.c_char_p()
+    h = lib.libsonataLoadVoiceFromConfigPath(str(cfg).encode(),
+                                             ctypes.byref(err))
+    assert h > 0
+    assert lib.libsonataUnloadSonataVoice(h) == 0
+    assert lib.libsonataUnloadSonataVoice(h) == 17  # INVALID_HANDLE
+    info = AudioInfo()
+    assert lib.libsonataGetAudioInfo(h, ctypes.byref(info)) == 17

@@ -49,7 +49,7 @@ def test_sharded_batch_covers_data_axis():
     audios = vm.speak_batch(["tɛst."])  # 1 sentence → padded to 8 rows
     assert len(audios) == 1
     assert len(audios[0].samples) > 0
-    assert {k[0] for k in vm._enc_cache} == {8}
+    assert {k[0] for k in vm._full_cache} == {8}
 
 
 def _exact_attention(q, k, v, kv_valid):
