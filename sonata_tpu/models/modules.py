@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.gate import fused_gate as gate_op
+
 Params = dict
 
 LRELU_SLOPE = 0.1
@@ -255,20 +257,12 @@ def init_wn(rng, *, hidden, kernel, dilation_rate, n_layers, gin_channels=0):
     return p
 
 
-def fused_gate(a, b):
-    """tanh/sigmoid gated activation: tanh(x+g_a) * sigmoid(y+g_b).
-
-    The WaveNet hot op; kept as a seam for a Pallas fused kernel
-    (:mod:`sonata_tpu.ops.gate`) — XLA already fuses this well, so the
-    default path is plain jnp.
-    """
-    return jnp.tanh(a) * jax.nn.sigmoid(b)
-
-
 def wn(x, mask, p, *, kernel, dilation_rate, n_layers, g=None):
     """Non-causal WaveNet: dilated convs, gated tanh units, residual+skip.
 
     ``x: [B, T, H]``; ``g: [B, 1, gin]`` speaker conditioning or None.
+    The gate runs through :func:`sonata_tpu.ops.gate.fused_gate` — a Pallas
+    kernel on TPU, plain jnp elsewhere.
     """
     hidden = x.shape[-1]
     output = jnp.zeros_like(x)
@@ -276,10 +270,10 @@ def wn(x, mask, p, *, kernel, dilation_rate, n_layers, g=None):
         g_all = conv1d(g, p["cond"])  # [B, 1, 2*H*n_layers]
     for i in range(n_layers):
         x_in = conv1d(x, p["in"][i], dilation=dilation_rate ** i)
+        g_l = None
         if g is not None and "cond" in p:
             g_l = lax.dynamic_slice_in_dim(g_all, i * 2 * hidden, 2 * hidden, axis=2)
-            x_in = x_in + g_l
-        acts = fused_gate(x_in[..., :hidden], x_in[..., hidden:])
+        acts = gate_op(x_in, g_l)
         rs = conv1d(acts, p["res_skip"][i])
         if i < n_layers - 1:
             x = (x + rs[..., :hidden]) * mask

@@ -1,0 +1,6 @@
+"""Hand-written TPU kernels and numerical ops (Pallas where it pays,
+jnp fallbacks everywhere)."""
+
+from .gate import fused_gate, fused_gate_pallas, fused_gate_reference
+
+__all__ = ["fused_gate", "fused_gate_pallas", "fused_gate_reference"]
