@@ -56,11 +56,17 @@ def voice_id_for(config_path: str) -> str:
 
 
 class _Voice:
-    def __init__(self, voice: PiperVoice, config_path: str, voice_id: str):
+    def __init__(self, voice: PiperVoice, config_path: str, voice_id: str,
+                 continuous_batching: bool = False):
         self.voice = voice
         self.synth = SpeechSynthesizer(voice)
         self.config_path = config_path
         self.voice_id = voice_id
+        self.scheduler = None
+        if continuous_batching:
+            from ..synth.scheduler import BatchScheduler
+
+            self.scheduler = BatchScheduler(voice)
 
 
 def _status_for(e: SonataError) -> grpc.StatusCode:
@@ -76,12 +82,14 @@ class SonataGrpcService:
     """RPC implementations over a lock-protected voice registry
     (``main.rs:76``)."""
 
-    def __init__(self, mesh=None, seed: int = 0):
+    def __init__(self, mesh=None, seed: int = 0,
+                 continuous_batching: bool = False):
         self._voices: dict[str, _Voice] = {}
         self._lock = threading.RLock()
         self._loading: dict[str, threading.Lock] = {}
         self._mesh = mesh
         self._seed = seed
+        self._continuous_batching = continuous_batching
 
     # -- helpers -------------------------------------------------------------
     def _get(self, voice_id: str, context) -> _Voice:
@@ -139,7 +147,8 @@ class SonataGrpcService:
                                          mesh=self._mesh)
             except SonataError as e:
                 context.abort(_status_for(e), str(e))
-            v = _Voice(voice, request.config_path, vid)
+            v = _Voice(voice, request.config_path, vid,
+                       continuous_batching=self._continuous_batching)
             with self._lock:
                 self._voices[vid] = v
                 self._loading.pop(vid, None)
@@ -195,6 +204,18 @@ class SonataGrpcService:
         v = self._get(request.voice_id, context)
         cfg = self._speech_args_config(request.speech_args)
         try:
+            if v.scheduler is not None and cfg is None:
+                # continuous batching: submit every sentence up front so a
+                # request coalesces with itself AND with concurrent
+                # requests, then stream results in order
+                futures = [v.scheduler.submit(sentence)
+                           for sentence in v.synth.phonemize_text(request.text)]
+                for fut in futures:
+                    audio = fut.result()
+                    yield pb.SynthesisResult(
+                        wav_samples=audio.as_wave_bytes(),
+                        rtf=audio.real_time_factor())
+                return
             if request.synthesis_mode in (pb.SynthesisMode.PARALLEL,
                                           pb.SynthesisMode.BATCHED):
                 stream = v.synth.synthesize_parallel(request.text, cfg)
@@ -260,13 +281,14 @@ class _Handler(grpc.GenericRpcHandler):
 
 
 def create_server(port: Optional[int] = None, *, mesh=None, seed: int = 0,
-                  max_workers: int = 16,
+                  max_workers: int = 16, continuous_batching: bool = False,
                   host: str = "127.0.0.1") -> tuple[grpc.Server, int]:
     from concurrent.futures import ThreadPoolExecutor
 
     port = port if port is not None else int(
         os.environ.get("SONATA_GRPC_SERVER_PORT", DEFAULT_PORT))
-    service = SonataGrpcService(mesh=mesh, seed=seed)
+    service = SonataGrpcService(mesh=mesh, seed=seed,
+                                continuous_batching=continuous_batching)
     server = grpc.server(ThreadPoolExecutor(max_workers=max_workers,
                                             thread_name_prefix="sonata_grpc"))
     server.add_generic_rpc_handlers((_Handler(service),))
@@ -287,9 +309,13 @@ def main(argv=None) -> int:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--voice", action="append", default=[],
                     help="preload a voice config at startup (repeatable)")
+    ap.add_argument("--continuous-batching", action="store_true",
+                    help="coalesce concurrent requests into shared device "
+                         "dispatches")
     args = ap.parse_args(argv)
 
-    server, port = create_server(args.port, host=args.host)
+    server, port = create_server(args.port, host=args.host,
+                                 continuous_batching=args.continuous_batching)
     server.start()
     log.info("sonata-tpu gRPC server v%s listening on %s:%d",
              __version__, args.host, port)

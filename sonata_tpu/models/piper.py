@@ -206,23 +206,51 @@ class PiperVoice(BaseModel):
     def speak_one_sentence(self, phonemes: str) -> Audio:
         return self.speak_batch([phonemes])[0]
 
+    # Cap on rows per device dispatch: beyond this, padding waste and
+    # compile sizes grow without amortizing any more fixed latency.
+    MAX_DISPATCH_BATCH = 64
+
     def speak_batch(self, phoneme_batches: list[str]) -> list[Audio]:
-        """True batched synthesis: one padded device program per batch."""
+        """True batched synthesis on the device.
+
+        Large corpora are partitioned by text-length bucket (so a 1k-line
+        corpus doesn't pad every sentence to the longest one) and chunked
+        to :data:`MAX_DISPATCH_BATCH` rows per dispatch; results reassemble
+        in input order.
+        """
         if not phoneme_batches:
             return []
         sc = self.get_fallback_synthesis_config()
         ids_list = [self.config.phonemes_to_ids(p) for p in phoneme_batches]
-        t0 = time.perf_counter()
-        wavs, wav_lengths = self._infer_batch(ids_list, sc)
-        elapsed_ms = (time.perf_counter() - t0) * 1000.0
-        per_sentence_ms = elapsed_ms / len(ids_list)
+        n = len(ids_list)
+
+        # partition indices by text bucket, preserving order within groups
+        groups: dict[int, list[int]] = {}
+        for i, ids in enumerate(ids_list):
+            groups.setdefault(bucket_for(len(ids), TEXT_BUCKETS), []).append(i)
+
+        wavs: list[Optional[np.ndarray]] = [None] * n
+        lengths = [0] * n
+        total_ms = 0.0
+        for _, indices in sorted(groups.items()):
+            for chunk_start in range(0, len(indices),
+                                     self.MAX_DISPATCH_BATCH):
+                chunk = indices[chunk_start:chunk_start
+                                + self.MAX_DISPATCH_BATCH]
+                t0 = time.perf_counter()
+                w, wl = self._infer_batch([ids_list[i] for i in chunk], sc)
+                total_ms += (time.perf_counter() - t0) * 1000.0
+                for row, i in enumerate(chunk):
+                    wavs[i] = w[row]
+                    lengths[i] = int(wl[row])
+
+        per_sentence_ms = total_ms / n
         info = self.audio_output_info()
-        out = []
-        for i in range(len(ids_list)):
-            n = int(wav_lengths[i])
-            out.append(Audio(AudioSamples(np.asarray(wavs[i, :n])), info,
-                             inference_ms=per_sentence_ms))
-        return out
+        return [
+            Audio(AudioSamples(np.asarray(wavs[i][: lengths[i]])), info,
+                  inference_ms=per_sentence_ms)
+            for i in range(n)
+        ]
 
     # ------------------------------------------------------------------
     # staged inference

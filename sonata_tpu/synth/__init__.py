@@ -1,6 +1,7 @@
 """Synthesis orchestration (analogue of ``crates/sonata/synth``)."""
 
 from .output import AudioOutputConfig, percent_to_param, process_prosody
+from .scheduler import BatchScheduler
 from .synthesizer import (
     RealtimeSpeechStream,
     SpeechStreamBatched,
@@ -13,6 +14,7 @@ __all__ = [
     "AudioOutputConfig",
     "percent_to_param",
     "process_prosody",
+    "BatchScheduler",
     "RealtimeSpeechStream",
     "SpeechStreamBatched",
     "SpeechStreamLazy",

@@ -1,0 +1,116 @@
+"""Continuous batching: coalesce concurrent synthesis requests into shared
+device dispatches.
+
+The reference serves concurrent gRPC requests by giving each its own
+blocking thread (``grpc/src/main.rs:381-409``) — each utterance runs its
+own ONNX session call.  On TPU that wastes the device: a single dispatch
+for 16 sentences costs nearly the same wall time as for one (latency-bound;
+see SURVEY §7 step 5 "continuous batching across concurrent requests").
+
+:class:`BatchScheduler` keeps a queue of (sentence, future) pairs; a worker
+collects up to ``max_batch`` sentences — waiting at most ``max_wait_ms``
+after the first — and issues one ``speak_batch``.  Under load, throughput
+approaches full-batch efficiency; idle, a lone request pays only the wait
+window.
+
+Per-request synthesis scales are not supported inside one coalesced batch
+(requests share the voice's current config); callers needing custom scales
+bypass the scheduler.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional
+
+from ..audio import Audio
+from ..core import Model, OperationError
+
+
+class BatchScheduler:
+    def __init__(self, model: Model, *, max_batch: int = 16,
+                 max_wait_ms: float = 5.0):
+        self._model = model
+        self._max_batch = max_batch
+        self._max_wait = max_wait_ms / 1000.0
+        self._queue: "queue.Queue" = queue.Queue()
+        self._closed = threading.Event()
+        self._worker = threading.Thread(target=self._run,
+                                        name="sonata_batcher", daemon=True)
+        self._worker.start()
+
+    # -- public API ----------------------------------------------------------
+    def submit(self, phonemes: str) -> "Future[Audio]":
+        if self._closed.is_set():
+            raise OperationError("scheduler is shut down")
+        fut: "Future[Audio]" = Future()
+        self._queue.put((phonemes, fut))
+        return fut
+
+    def speak(self, phonemes: str, timeout: Optional[float] = None) -> Audio:
+        return self.submit(phonemes).result(timeout)
+
+    def shutdown(self) -> None:
+        self._closed.set()
+        self._queue.put(None)  # wake the worker
+        self._worker.join(timeout=5.0)
+        # fail anything still enqueued so no caller blocks forever
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                _, fut = item
+                _try_set_exception(fut, OperationError("scheduler shut down"))
+
+    # -- worker --------------------------------------------------------------
+    def _run(self) -> None:
+        while not self._closed.is_set():
+            item = self._queue.get()
+            if item is None:
+                continue
+            batch = [item]
+            deadline = time.monotonic() + self._max_wait
+            while len(batch) < self._max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    break
+                batch.append(nxt)
+            self._dispatch(batch)
+
+    def _dispatch(self, batch) -> None:
+        sentences = [phonemes for phonemes, _ in batch]
+        try:
+            audios = self._model.speak_batch(sentences)
+        except Exception as e:
+            for _, fut in batch:
+                _try_set_exception(fut, e)
+            return
+        for (_, fut), audio in zip(batch, audios):
+            _try_set_result(fut, audio)
+
+
+def _try_set_result(fut: Future, value) -> None:
+    """Resolve a future, tolerating a concurrent cancel (a cancelled-then-set
+    InvalidStateError must never kill the worker thread)."""
+    try:
+        fut.set_result(value)
+    except Exception:
+        pass
+
+
+def _try_set_exception(fut: Future, exc: Exception) -> None:
+    try:
+        fut.set_exception(exc)
+    except Exception:
+        pass

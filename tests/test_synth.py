@@ -159,3 +159,101 @@ def test_noop_config_is_identity():
     x = _tone()
     out = AudioOutputConfig().apply(AudioSamples(x), 16000)
     np.testing.assert_array_equal(out.data, x)
+
+
+def test_batch_scheduler_coalesces_concurrent_requests():
+    import concurrent.futures as cf
+
+    from sonata_tpu.synth import BatchScheduler
+
+    voice = tiny_voice(seed=9)
+    dispatches = []
+    real = voice.speak_batch
+
+    def counting(sentences):
+        dispatches.append(len(sentences))
+        return real(sentences)
+
+    voice.speak_batch = counting
+    sched = BatchScheduler(voice, max_batch=8, max_wait_ms=200.0)
+    try:
+        # warm the jit caches so the first dispatch doesn't hog the worker
+        real(["wɔːm ʌp."])
+        with cf.ThreadPoolExecutor(8) as ex:
+            audios = list(ex.map(
+                lambda i: sched.speak(f"tɛst nʌmbɚ {i}."), range(8)))
+        assert all(len(a.samples) > 0 for a in audios)
+        # 8 concurrent requests must land in far fewer dispatches
+        assert len(dispatches) < 8
+        assert sum(dispatches) == 8
+    finally:
+        sched.shutdown()
+
+
+def test_batch_scheduler_propagates_errors():
+    from sonata_tpu.core import OperationError
+    from sonata_tpu.synth import BatchScheduler
+
+    class Bad:
+        def speak_batch(self, sentences):
+            raise OperationError("device on fire")
+
+    sched = BatchScheduler(Bad(), max_wait_ms=1.0)
+    try:
+        with pytest.raises(OperationError, match="device on fire"):
+            sched.speak("x")
+    finally:
+        sched.shutdown()
+
+
+def test_batch_scheduler_rejects_after_shutdown():
+    from sonata_tpu.core import OperationError
+    from sonata_tpu.synth import BatchScheduler
+
+    voice = tiny_voice(seed=9)
+    sched = BatchScheduler(voice)
+    sched.shutdown()
+    with pytest.raises(OperationError):
+        sched.submit("x")
+
+
+def test_batch_scheduler_shutdown_fails_pending():
+    from sonata_tpu.core import OperationError
+    from sonata_tpu.synth import BatchScheduler
+
+    import threading
+
+    release = threading.Event()
+
+    class Slow:
+        def speak_batch(self, sentences):
+            release.wait(5.0)
+            raise OperationError("never mind")
+
+    sched = BatchScheduler(Slow(), max_wait_ms=1.0)
+    first = sched.submit("occupies the worker")
+    import time
+
+    time.sleep(0.05)
+    pending = sched.submit("stuck in queue")
+    release.set()
+    sched.shutdown()
+    with pytest.raises(OperationError):
+        pending.result(timeout=5.0)
+    with pytest.raises(OperationError):
+        first.result(timeout=5.0)
+
+
+def test_batch_scheduler_survives_cancelled_future():
+    from sonata_tpu.synth import BatchScheduler
+
+    voice = tiny_voice(seed=9)
+    voice.speak_batch(["wɔːm."])  # warm jit
+    sched = BatchScheduler(voice, max_wait_ms=1.0)
+    try:
+        fut = sched.submit("tɛst wʌn.")
+        fut.cancel()  # may race the worker; must not kill it
+        ok = sched.speak("tɛst tuː.", timeout=30.0)
+        assert len(ok.samples) > 0  # worker still alive
+    finally:
+        sched.shutdown()

@@ -244,3 +244,16 @@ def test_overflow_retry_reproduces_exact_durations():
     b = vb.speak_one_sentence("ə lɑːŋɚ tɛst sɛntəns wɪð mɔːɹ wɜːdz.")
     assert len(a.samples) == len(b.samples)
     np.testing.assert_allclose(a.samples.data, b.samples.data, atol=1e-4)
+
+
+def test_speak_batch_partitions_by_text_bucket(voice):
+    # short + long sentences: groups dispatch separately but results come
+    # back in input order with correct relative durations
+    short = "aɪ."
+    long = ("ðɪs ɪz ə mʌtʃ lɑːŋɚ sɛntəns wɪð mɛni mɔːɹ wɜːdz ænd saʊndz "
+            "tuː meɪk ɪt pæs ðə fɜːst tɛkst bʌkɪt baʊndɚɹi ʃʊɹli.")
+    audios = voice.speak_batch([long, short, long, short])
+    assert len(audios) == 4
+    assert len(audios[0].samples) > len(audios[1].samples)
+    assert len(audios[2].samples) > len(audios[3].samples)
+    assert len(audios[1].samples) > 0
