@@ -224,25 +224,34 @@ class PiperVoice(BaseModel):
         ids_list = [self.config.phonemes_to_ids(p) for p in phoneme_batches]
         n = len(ids_list)
 
-        # partition indices by text bucket, preserving order within groups
-        groups: dict[int, list[int]] = {}
-        for i, ids in enumerate(ids_list):
-            groups.setdefault(bucket_for(len(ids), TEXT_BUCKETS), []).append(i)
+        # sort by length and pack consecutive sentences into dispatch
+        # chunks: similar lengths share a chunk (tight text bucket, minimal
+        # padding).  A chunk also breaks when the text bucket grows past 2x
+        # the chunk's first bucket, so one long outlier doesn't inflate the
+        # frame budget — and transfer size — of many short rows; adjacent
+        # buckets still share a dispatch (splitting them doubles fixed
+        # dispatch latency for little padding saved).
+        order = sorted(range(n), key=lambda i: len(ids_list[i]))
+        chunks: list[list[int]] = []
+        for i in order:
+            bucket = bucket_for(len(ids_list[i]), TEXT_BUCKETS)
+            if (chunks and len(chunks[-1]) < self.MAX_DISPATCH_BATCH
+                    and bucket <= 2 * bucket_for(
+                        len(ids_list[chunks[-1][0]]), TEXT_BUCKETS)):
+                chunks[-1].append(i)
+            else:
+                chunks.append([i])
 
         wavs: list[Optional[np.ndarray]] = [None] * n
         lengths = [0] * n
         total_ms = 0.0
-        for _, indices in sorted(groups.items()):
-            for chunk_start in range(0, len(indices),
-                                     self.MAX_DISPATCH_BATCH):
-                chunk = indices[chunk_start:chunk_start
-                                + self.MAX_DISPATCH_BATCH]
-                t0 = time.perf_counter()
-                w, wl = self._infer_batch([ids_list[i] for i in chunk], sc)
-                total_ms += (time.perf_counter() - t0) * 1000.0
-                for row, i in enumerate(chunk):
-                    wavs[i] = w[row]
-                    lengths[i] = int(wl[row])
+        for chunk in chunks:
+            t0 = time.perf_counter()
+            w, wl = self._infer_batch([ids_list[i] for i in chunk], sc)
+            total_ms += (time.perf_counter() - t0) * 1000.0
+            for row, i in enumerate(chunk):
+                wavs[i] = w[row]
+                lengths[i] = int(wl[row])
 
         per_sentence_ms = total_ms / n
         info = self.audio_output_info()
