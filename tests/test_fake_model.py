@@ -1,0 +1,90 @@
+"""FakeModel-based orchestration tests: millisecond-fast, exact golden
+metrics (the hermetic seam the reference never built — SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from sonata_tpu.synth import AudioOutputConfig, BatchScheduler, SpeechSynthesizer
+from sonata_tpu.testing import FakeModel
+
+
+@pytest.fixture()
+def synth():
+    return SpeechSynthesizer(FakeModel())
+
+
+def test_fake_model_deterministic():
+    a = FakeModel().speak_one_sentence("tɛst.")
+    b = FakeModel().speak_one_sentence("tɛst.")
+    np.testing.assert_array_equal(a.samples.data, b.samples.data)
+    assert a.inference_ms == 1.0
+
+
+def test_duration_scales_with_phonemes_and_length_scale():
+    m = FakeModel()
+    short = m.speak_one_sentence("ab")
+    long = m.speak_one_sentence("abcdefgh")
+    assert len(long.samples) == 4 * len(short.samples)
+    sc = m.get_fallback_synthesis_config()
+    sc.length_scale = 2.0
+    m.set_fallback_synthesis_config(sc)
+    stretched = m.speak_one_sentence("ab")
+    assert len(stretched.samples) == 2 * len(short.samples)
+
+
+def test_streams_golden_metrics(synth):
+    text = "One two three. Four five."
+    lazy = list(synth.synthesize_lazy(text))
+    batched = list(synth.synthesize_parallel(text))
+    assert len(lazy) == len(batched) == 2
+    for a, b in zip(lazy, batched):
+        np.testing.assert_array_equal(a.samples.data, b.samples.data)
+    rt = list(synth.synthesize_streamed(text, chunk_size=4))
+    total_rt = sum(len(c.samples) for c in rt)
+    assert total_rt == sum(len(a.samples) for a in lazy)
+
+
+def test_output_config_applies_to_fake(synth):
+    cfg = AudioOutputConfig(volume=50)  # 0.5 gain
+    out = list(synth.synthesize_parallel("Loud words here.", cfg))
+    peak = max(np.max(np.abs(a.samples.data)) for a in out)
+    assert peak == pytest.approx(0.25, rel=0.05)  # 0.5 sine * 0.5 gain
+
+
+def test_scheduler_with_fake_model():
+    m = FakeModel()
+    sched = BatchScheduler(m, max_batch=4, max_wait_ms=20.0)
+    try:
+        futs = [sched.submit(f"sentence {i}") for i in range(4)]
+        audios = [f.result(timeout=5.0) for f in futs]
+        assert all(len(a.samples) > 0 for a in audios)
+        batch_calls = [c for c in m.calls if c[0] == "speak_batch"]
+        assert sum(len(c[1]) for c in batch_calls) == 4
+        assert len(batch_calls) < 4  # coalesced
+    finally:
+        sched.shutdown()
+
+
+def test_fake_model_call_log(synth):
+    model = synth.model
+    list(synth.synthesize_lazy("Alpha. Beta."))
+    kinds = [c[0] for c in model.calls]
+    assert kinds == ["speak_one_sentence", "speak_one_sentence"]
+
+
+def test_rtf_counter():
+    from sonata_tpu.utils.profiling import RtfCounter
+
+    m = FakeModel()
+    counter = RtfCounter()
+    for _ in range(4):
+        counter.record(m.speak_one_sentence("abcd"))
+    stats = counter.snapshot()
+    assert stats.utterances == 4
+    assert stats.inference_ms == pytest.approx(4.0)
+    # 4 phonemes * 160 spp / 16 kHz = 40 ms per utterance
+    assert stats.audio_ms == pytest.approx(160.0)
+    assert stats.rtf == pytest.approx(4.0 / 160.0)
+    assert stats.audio_seconds_per_second == pytest.approx(40.0)
+    counter.reset()
+    assert counter.snapshot().utterances == 0
