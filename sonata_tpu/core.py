@@ -113,10 +113,15 @@ class Model(Protocol):
     def phonemize_text(self, text: str) -> Phonemes:  # core/src/lib.rs:84
         ...
 
-    def speak_batch(self, phoneme_batches: list[str]) -> list["Audio"]:
+    def speak_batch(self, phoneme_batches: list[str],
+                    speakers: Optional[list[Optional[int]]] = None
+                    ) -> list["Audio"]:
         # core/src/lib.rs:85 — but unlike the reference's speak_batch
         # (piper/src/lib.rs:425-437, a sequential loop), implementations
-        # should run a true padded batch on device.
+        # should run a true padded batch on device.  ``speakers`` carries
+        # optional per-sentence speaker ids (None = the model's configured
+        # speaker); implementations without speakers must reject non-None
+        # entries they cannot honor.
         ...
 
     def speak_one_sentence(self, phonemes: str) -> "Audio":  # core/src/lib.rs:86

@@ -210,19 +210,29 @@ class PiperVoice(BaseModel):
     # compile sizes grow without amortizing any more fixed latency.
     MAX_DISPATCH_BATCH = 64
 
-    def speak_batch(self, phoneme_batches: list[str]) -> list[Audio]:
+    def speak_batch(self, phoneme_batches: list[str],
+                    speakers: Optional[list[Optional[int]]] = None
+                    ) -> list[Audio]:
         """True batched synthesis on the device.
 
         Large corpora are partitioned by text-length bucket (so a 1k-line
         corpus doesn't pad every sentence to the longest one) and chunked
         to :data:`MAX_DISPATCH_BATCH` rows per dispatch; results reassemble
         in input order.
+
+        ``speakers``: optional per-sentence speaker ids (None entries fall
+        back to the config speaker) — different speakers can share one
+        device dispatch, which is what lets the continuous-batching
+        scheduler coalesce requests from different voices' speakers.
         """
         if not phoneme_batches:
             return []
         sc = self.get_fallback_synthesis_config()
         ids_list = [self.config.phonemes_to_ids(p) for p in phoneme_batches]
         n = len(ids_list)
+        if speakers is not None and len(speakers) != n:
+            raise OperationError(
+                f"speakers list has {len(speakers)} entries for {n} sentences")
 
         # sort by length and pack consecutive sentences into dispatch
         # chunks: similar lengths share a chunk (tight text bucket, minimal
@@ -247,7 +257,10 @@ class PiperVoice(BaseModel):
         total_ms = 0.0
         for chunk in chunks:
             t0 = time.perf_counter()
-            w, wl = self._infer_batch([ids_list[i] for i in chunk], sc)
+            chunk_speakers = ([speakers[i] for i in chunk]
+                              if speakers is not None else None)
+            w, wl = self._infer_batch([ids_list[i] for i in chunk], sc,
+                                      speakers=chunk_speakers)
             total_ms += (time.perf_counter() - t0) * 1000.0
             for row, i in enumerate(chunk):
                 wavs[i] = w[row]
@@ -272,16 +285,29 @@ class PiperVoice(BaseModel):
         mixed = (self._seed * 0x9E3779B1 + counter) & 0xFFFFFFFF
         return jax.random.PRNGKey(np.uint32(mixed))
 
-    def _sid_array(self, sc: SynthesisConfig, batch: int):
+    def _sid_array(self, sc: SynthesisConfig, batch: int,
+                   speakers: Optional[list[Optional[int]]] = None):
         if not self.multi_speaker:
+            # single-speaker voice: only speaker 0 (or None) is honorable —
+            # silently producing default-voice audio for another id would
+            # hide a caller bug
+            for sid in speakers or []:
+                if sid not in (None, 0):
+                    raise OperationError(
+                        f"speaker id {sid} requested on a single-speaker "
+                        "voice")
             return None
-        sid = sc.speaker[1] if sc.speaker else 0
-        if not 0 <= sid < self.config.num_speakers:
-            # JAX gather would silently clamp an out-of-range id; surface it
-            raise OperationError(
-                f"speaker id {sid} out of range "
-                f"(voice has {self.config.num_speakers} speakers)")
-        return jnp.full((batch,), sid, dtype=jnp.int32)
+        default = sc.speaker[1] if sc.speaker else 0
+        rows = [default if s is None else s
+                for s in (speakers or [])] or [default]
+        rows = rows + [default] * (batch - len(rows))
+        for sid in rows:
+            if not 0 <= sid < self.config.num_speakers:
+                # JAX gather would silently clamp an out-of-range id
+                raise OperationError(
+                    f"speaker id {sid} out of range "
+                    f"(voice has {self.config.num_speakers} speakers)")
+        return jnp.asarray(rows[:batch], dtype=jnp.int32)
 
     def _jit(self, run, batch_args: tuple[int, ...]):
         """jit, adding mesh shardings when a mesh is attached.
@@ -504,7 +530,8 @@ class PiperVoice(BaseModel):
             # decaying upper bound: shrinks slowly, jumps up immediately
             self._frames_per_id = max(self._frames_per_id * 0.995, ratio)
 
-    def _infer_batch(self, ids_list: list[list[int]], sc: SynthesisConfig):
+    def _infer_batch(self, ids_list: list[list[int]], sc: SynthesisConfig,
+                     speakers: Optional[list[Optional[int]]] = None):
         """Batch ids → audio in ONE device round trip (estimate + retry).
 
         The frame budget comes from the adaptive estimator rather than a
@@ -516,7 +543,7 @@ class PiperVoice(BaseModel):
         n_real = len(ids_list)
         max_ids = max(len(i) for i in ids_list)
         ids, lens, b, t = self._pad_batch(ids_list)
-        sid = self._sid_array(sc, b)
+        sid = self._sid_array(sc, b, speakers)
         # one key for both dispatches: the overflow retry must reproduce the
         # exact duration draw it measured, or the bigger bucket could clip
         # a fresh, longer draw

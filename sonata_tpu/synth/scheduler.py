@@ -7,11 +7,11 @@ own ONNX session call.  On TPU that wastes the device: a single dispatch
 for 16 sentences costs nearly the same wall time as for one (latency-bound;
 see SURVEY §7 step 5 "continuous batching across concurrent requests").
 
-:class:`BatchScheduler` keeps a queue of (sentence, future) pairs; a worker
-collects up to ``max_batch`` sentences — waiting at most ``max_wait_ms``
-after the first — and issues one ``speak_batch``.  Under load, throughput
-approaches full-batch efficiency; idle, a lone request pays only the wait
-window.
+:class:`BatchScheduler` keeps a queue of (sentence, speaker, future)
+triples; a worker collects up to ``max_batch`` sentences — waiting at most
+``max_wait_ms`` after the first — and issues one ``speak_batch`` with the
+per-row speakers.  Under load, throughput approaches full-batch efficiency;
+idle, a lone request pays only the wait window.
 
 Per-request synthesis scales are not supported inside one coalesced batch
 (requests share the voice's current config); callers needing custom scales
@@ -43,15 +43,17 @@ class BatchScheduler:
         self._worker.start()
 
     # -- public API ----------------------------------------------------------
-    def submit(self, phonemes: str) -> "Future[Audio]":
+    def submit(self, phonemes: str,
+               speaker: Optional[int] = None) -> "Future[Audio]":
         if self._closed.is_set():
             raise OperationError("scheduler is shut down")
         fut: "Future[Audio]" = Future()
-        self._queue.put((phonemes, fut))
+        self._queue.put((phonemes, speaker, fut))
         return fut
 
-    def speak(self, phonemes: str, timeout: Optional[float] = None) -> Audio:
-        return self.submit(phonemes).result(timeout)
+    def speak(self, phonemes: str, timeout: Optional[float] = None,
+              speaker: Optional[int] = None) -> Audio:
+        return self.submit(phonemes, speaker=speaker).result(timeout)
 
     def shutdown(self) -> None:
         self._closed.set()
@@ -64,8 +66,8 @@ class BatchScheduler:
             except queue.Empty:
                 break
             if item is not None:
-                _, fut = item
-                _try_set_exception(fut, OperationError("scheduler shut down"))
+                _try_set_exception(item[-1],
+                                   OperationError("scheduler shut down"))
 
     # -- worker --------------------------------------------------------------
     def _run(self) -> None:
@@ -89,14 +91,16 @@ class BatchScheduler:
             self._dispatch(batch)
 
     def _dispatch(self, batch) -> None:
-        sentences = [phonemes for phonemes, _ in batch]
+        sentences = [phonemes for phonemes, _, _ in batch]
+        speakers = [speaker for _, speaker, _ in batch]
         try:
-            audios = self._model.speak_batch(sentences)
+            # speakers is part of the Model protocol (core.Model.speak_batch)
+            audios = self._model.speak_batch(sentences, speakers=speakers)
         except Exception as e:
-            for _, fut in batch:
+            for _, _, fut in batch:
                 _try_set_exception(fut, e)
             return
-        for (_, fut), audio in zip(batch, audios):
+        for (_, _, fut), audio in zip(batch, audios):
             _try_set_result(fut, audio)
 
 

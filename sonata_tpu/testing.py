@@ -82,8 +82,9 @@ class FakeModel(BaseModel):
         self.calls.append(("speak_one_sentence", phonemes))
         return self._synthesize(phonemes)
 
-    def speak_batch(self, phoneme_batches: list) -> list[Audio]:
-        self.calls.append(("speak_batch", list(phoneme_batches)))
+    def speak_batch(self, phoneme_batches: list,
+                    speakers=None) -> list[Audio]:
+        self.calls.append(("speak_batch", list(phoneme_batches), speakers))
         return [self._synthesize(p) for p in phoneme_batches]
 
     def supports_streaming_output(self) -> bool:

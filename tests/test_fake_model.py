@@ -88,3 +88,16 @@ def test_rtf_counter():
     assert stats.audio_seconds_per_second == pytest.approx(40.0)
     counter.reset()
     assert counter.snapshot().utterances == 0
+
+
+def test_scheduler_per_request_speakers():
+    m = FakeModel(speakers={0: "a", 1: "b"})
+    sched = BatchScheduler(m, max_batch=4, max_wait_ms=20.0)
+    try:
+        futs = [sched.submit("tɛst.", speaker=i % 2) for i in range(4)]
+        [f.result(timeout=5.0) for f in futs]
+        batch_calls = [c for c in m.calls if c[0] == "speak_batch"]
+        assert any(c[2] and any(s is not None for s in c[2])
+                   for c in batch_calls)
+    finally:
+        sched.shutdown()

@@ -257,3 +257,26 @@ def test_speak_batch_partitions_by_text_bucket(voice):
     assert len(audios[0].samples) > len(audios[1].samples)
     assert len(audios[2].samples) > len(audios[3].samples)
     assert len(audios[1].samples) > 0
+
+
+def test_per_row_speakers_in_one_batch():
+    v = tiny_multispeaker_voice()
+    ph = "seɪm wɜːdz hɪɹ."
+    audios = v.speak_batch([ph, ph, ph], speakers=[0, 3, None])
+    assert len(audios) == 3
+    # different speaker embeddings → different waveforms for identical text
+    assert not np.array_equal(audios[0].samples.data, audios[1].samples.data)
+    with pytest.raises(Exception):
+        v.speak_batch([ph], speakers=[99])
+    with pytest.raises(Exception):
+        v.speak_batch([ph, ph], speakers=[0])  # length mismatch
+
+
+def test_single_speaker_voice_rejects_other_speakers(voice):
+    from sonata_tpu.core import OperationError
+
+    with pytest.raises(OperationError):
+        voice.speak_batch(["tɛst."], speakers=[2])
+    # speaker 0 / None are fine on a single-speaker voice
+    ok = voice.speak_batch(["tɛst.", "tɛst."], speakers=[0, None])
+    assert len(ok) == 2
