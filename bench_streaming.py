@@ -1,0 +1,77 @@
+"""Secondary benchmark: streaming time-to-first-byte and concurrent load.
+
+The driver's headline metric comes from ``bench.py`` (batched RTF); this
+script measures the other BASELINE.md configs: realtime-stream TTFB (first
+audio chunk latency, gRPC default chunk 55/pad 3) and aggregate
+audio-seconds/second under concurrent streaming load.  Prints one JSON line
+per metric.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+SENTENCE = ("Streaming synthesis should deliver the first chunk quickly "
+            "while the rest of the utterance is still being decoded.")
+
+
+def main() -> None:
+    from sonata_tpu.models import PiperVoice
+    from sonata_tpu.synth import SpeechSynthesizer
+
+    voice = PiperVoice.random(seed=0, audio={"sample_rate": 22050,
+                                             "quality": "high"})
+    synth = SpeechSynthesizer(voice)
+
+    # warmup: compile encode/acoustics/window-decode executables
+    for _ in range(2):
+        for _chunk in synth.synthesize_streamed(SENTENCE, chunk_size=55,
+                                                chunk_padding=3):
+            pass
+
+    ttfbs = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        stream = synth.synthesize_streamed(SENTENCE, chunk_size=55,
+                                           chunk_padding=3)
+        next(iter(stream))
+        ttfbs.append(time.perf_counter() - t0)
+        for _chunk in stream:  # drain
+            pass
+    p50 = statistics.median(ttfbs)
+    print(json.dumps({
+        "metric": "streaming_ttfb_p50",
+        "value": round(p50 * 1000.0, 2),
+        "unit": "ms",
+        "vs_baseline": None,  # the reference publishes no TTFB numbers
+    }))
+
+    # concurrent streaming load: N clients, aggregate audio throughput
+    import concurrent.futures
+
+    n_clients = 4
+
+    def run_stream(i: int) -> float:
+        total = 0
+        for chunk in synth.synthesize_streamed(SENTENCE, chunk_size=55,
+                                               chunk_padding=3):
+            total += len(chunk.samples)
+        return total / synth.audio_output_info().sample_rate
+
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(n_clients) as ex:
+        seconds = list(ex.map(run_stream, range(n_clients)))
+    elapsed = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "concurrent_streaming_audio_s_per_s",
+        "value": round(sum(seconds) / elapsed, 2),
+        "unit": "audio_seconds_per_second",
+        "vs_baseline": None,
+    }), file=sys.stdout)
+
+
+if __name__ == "__main__":
+    main()

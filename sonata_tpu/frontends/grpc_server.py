@@ -207,8 +207,13 @@ class SonataGrpcService:
             if v.scheduler is not None and cfg is None:
                 # continuous batching: submit every sentence up front so a
                 # request coalesces with itself AND with concurrent
-                # requests, then stream results in order
-                futures = [v.scheduler.submit(sentence)
+                # requests, then stream results in order.  The speaker is
+                # snapshotted per request — concurrent clients that set
+                # different speakers via SetSynthesisOptions each keep
+                # their own voice inside a shared dispatch.
+                sc = v.voice.get_fallback_synthesis_config()
+                sid = sc.speaker[1] if sc.speaker else None
+                futures = [v.scheduler.submit(sentence, speaker=sid)
                            for sentence in v.synth.phonemize_text(request.text)]
                 for fut in futures:
                     audio = fut.result()

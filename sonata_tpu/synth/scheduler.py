@@ -47,6 +47,17 @@ class BatchScheduler:
                speaker: Optional[int] = None) -> "Future[Audio]":
         if self._closed.is_set():
             raise OperationError("scheduler is shut down")
+        if speaker is not None:
+            # validate here, per request: a bad speaker id inside a
+            # coalesced dispatch would otherwise fail every request in
+            # the batch
+            speakers = self._model.get_speakers()
+            if speakers is None:
+                if speaker != 0:
+                    raise OperationError(
+                        f"speaker id {speaker} on a single-speaker voice")
+            elif speaker not in speakers:
+                raise OperationError(f"unknown speaker id {speaker}")
         fut: "Future[Audio]" = Future()
         self._queue.put((phonemes, speaker, fut))
         return fut

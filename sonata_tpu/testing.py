@@ -84,6 +84,17 @@ class FakeModel(BaseModel):
 
     def speak_batch(self, phoneme_batches: list,
                     speakers=None) -> list[Audio]:
+        # honor the protocol contract: reject speaker ids this model
+        # cannot represent (core.Model.speak_batch docstring)
+        for sid in speakers or []:
+            if sid is None:
+                continue
+            if self._speakers is None:
+                if sid != 0:
+                    raise OperationError(
+                        f"speaker id {sid} on a single-speaker fake")
+            elif sid not in self._speakers:
+                raise OperationError(f"unknown speaker id {sid}")
         self.calls.append(("speak_batch", list(phoneme_batches), speakers))
         return [self._synthesize(p) for p in phoneme_batches]
 

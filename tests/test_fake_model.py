@@ -101,3 +101,26 @@ def test_scheduler_per_request_speakers():
                    for c in batch_calls)
     finally:
         sched.shutdown()
+
+
+def test_scheduler_validates_speaker_at_submit():
+    from sonata_tpu.core import OperationError
+
+    m = FakeModel(speakers={0: "a", 1: "b"})
+    sched = BatchScheduler(m, max_batch=4, max_wait_ms=10.0)
+    try:
+        with pytest.raises(OperationError):
+            sched.submit("x", speaker=7)  # fails alone, instantly
+        ok = sched.speak("fine.", timeout=5.0, speaker=1)
+        assert len(ok.samples) > 0
+    finally:
+        sched.shutdown()
+
+
+def test_fake_model_rejects_unknown_speakers():
+    from sonata_tpu.core import OperationError
+
+    with pytest.raises(OperationError):
+        FakeModel().speak_batch(["x"], speakers=[3])
+    with pytest.raises(OperationError):
+        FakeModel(speakers={0: "a"}).speak_batch(["x"], speakers=[5])

@@ -261,9 +261,19 @@ def test_speak_batch_partitions_by_text_bucket(voice):
 
 def test_per_row_speakers_in_one_batch():
     v = tiny_multispeaker_voice()
+    # deterministic synthesis (no noise): any waveform difference can only
+    # come from the speaker conditioning, so dropped sid plumbing would
+    # make this fail
+    sc = v.get_fallback_synthesis_config()
+    sc.noise_scale = 0.0
+    sc.noise_w = 0.0
+    v.set_fallback_synthesis_config(sc)
     ph = "seɪm wɜːdz hɪɹ."
     audios = v.speak_batch([ph, ph, ph], speakers=[0, 3, None])
     assert len(audios) == 3
+    # None falls back to the config speaker (0) → identical to row 0
+    np.testing.assert_array_equal(audios[0].samples.data,
+                                  audios[2].samples.data)
     # different speaker embeddings → different waveforms for identical text
     assert not np.array_equal(audios[0].samples.data, audios[1].samples.data)
     with pytest.raises(Exception):
