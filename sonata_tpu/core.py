@@ -114,14 +114,15 @@ class Model(Protocol):
         ...
 
     def speak_batch(self, phoneme_batches: list[str],
-                    speakers: Optional[list[Optional[int]]] = None
-                    ) -> list["Audio"]:
+                    speakers: Optional[list[Optional[int]]] = None,
+                    scales: Optional[list[Any]] = None) -> list["Audio"]:
         # core/src/lib.rs:85 — but unlike the reference's speak_batch
         # (piper/src/lib.rs:425-437, a sequential loop), implementations
         # should run a true padded batch on device.  ``speakers`` carries
-        # optional per-sentence speaker ids (None = the model's configured
-        # speaker); implementations without speakers must reject non-None
-        # entries they cannot honor.
+        # optional per-sentence speaker ids and ``scales`` optional
+        # per-sentence synthesis configs (None entries = the model's
+        # configured values); implementations must reject non-None entries
+        # they cannot honor.
         ...
 
     def speak_one_sentence(self, phonemes: str) -> "Audio":  # core/src/lib.rs:86

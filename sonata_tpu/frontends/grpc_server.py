@@ -213,7 +213,8 @@ class SonataGrpcService:
                 # their own voice inside a shared dispatch.
                 sc = v.voice.get_fallback_synthesis_config()
                 sid = sc.speaker[1] if sc.speaker else None
-                futures = [v.scheduler.submit(sentence, speaker=sid)
+                futures = [v.scheduler.submit(sentence, speaker=sid,
+                                              scales=sc)
                            for sentence in v.synth.phonemize_text(request.text)]
                 for fut in futures:
                     audio = fut.result()

@@ -211,8 +211,9 @@ class PiperVoice(BaseModel):
     MAX_DISPATCH_BATCH = 64
 
     def speak_batch(self, phoneme_batches: list[str],
-                    speakers: Optional[list[Optional[int]]] = None
-                    ) -> list[Audio]:
+                    speakers: Optional[list[Optional[int]]] = None,
+                    scales: "Optional[list[Optional[SynthesisConfig]]]"
+                    = None) -> list[Audio]:
         """True batched synthesis on the device.
 
         Large corpora are partitioned by text-length bucket (so a 1k-line
@@ -233,6 +234,9 @@ class PiperVoice(BaseModel):
         if speakers is not None and len(speakers) != n:
             raise OperationError(
                 f"speakers list has {len(speakers)} entries for {n} sentences")
+        if scales is not None and len(scales) != n:
+            raise OperationError(
+                f"scales list has {len(scales)} entries for {n} sentences")
 
         # sort by length and pack consecutive sentences into dispatch
         # chunks: similar lengths share a chunk (tight text bucket, minimal
@@ -259,8 +263,11 @@ class PiperVoice(BaseModel):
             t0 = time.perf_counter()
             chunk_speakers = ([speakers[i] for i in chunk]
                               if speakers is not None else None)
+            chunk_scales = ([scales[i] for i in chunk]
+                            if scales is not None else None)
             w, wl = self._infer_batch([ids_list[i] for i in chunk], sc,
-                                      speakers=chunk_speakers)
+                                      speakers=chunk_speakers,
+                                      scales=chunk_scales)
             total_ms += (time.perf_counter() - t0) * 1000.0
             for row, i in enumerate(chunk):
                 wavs[i] = w[row]
@@ -284,6 +291,24 @@ class PiperVoice(BaseModel):
             counter = self._rng_counter
         mixed = (self._seed * 0x9E3779B1 + counter) & 0xFFFFFFFF
         return jax.random.PRNGKey(np.uint32(mixed))
+
+    def _scale_arrays(self, sc: SynthesisConfig, batch: int,
+                      scales: "Optional[list[Optional[SynthesisConfig]]]"
+                      = None):
+        """Per-row (noise_w, length_scale, noise_scale) [B] arrays.
+
+        ``scales`` entries override the shared config row-wise, letting a
+        coalesced batch carry each request's own synthesis scales."""
+        def row(i, attr):
+            if scales is not None and i < len(scales) and scales[i] is not None:
+                return float(getattr(scales[i], attr))
+            return float(getattr(sc, attr))
+
+        nw = [row(i, "noise_w") for i in range(batch)]
+        ls = [row(i, "length_scale") for i in range(batch)]
+        ns = [row(i, "noise_scale") for i in range(batch)]
+        return (jnp.asarray(nw, jnp.float32), jnp.asarray(ls, jnp.float32),
+                jnp.asarray(ns, jnp.float32))
 
     def _sid_array(self, sc: SynthesisConfig, batch: int,
                    speakers: Optional[list[Optional[int]]] = None):
@@ -352,7 +377,8 @@ class PiperVoice(BaseModel):
                             length_scale=length_scale)
                         return m_p, logs_p, w_ceil, x_mask
 
-                batch = (1, 2, 6) if self.multi_speaker else (1, 2)
+                batch = ((1, 2, 4, 5, 6) if self.multi_speaker
+                         else (1, 2, 4, 5))
                 fn = self._jit(run, batch)
                 self._enc_cache[key] = fn
         return fn
@@ -402,14 +428,14 @@ class PiperVoice(BaseModel):
                         return body(params, m_p, logs_p, w_ceil, x_mask, rng,
                                     noise_scale, g)
 
-                    batch = (1, 2, 3, 4, 7)
+                    batch = (1, 2, 3, 4, 6, 7)
                 else:
                     def run(params, m_p, logs_p, w_ceil, x_mask, rng,
                             noise_scale):
                         return body(params, m_p, logs_p, w_ceil, x_mask, rng,
                                     noise_scale, None)
 
-                    batch = (1, 2, 3, 4)
+                    batch = (1, 2, 3, 4, 6)
                 fn = self._jit(run, batch)
                 self._aco_cache[f] = fn
         return fn
@@ -452,14 +478,14 @@ class PiperVoice(BaseModel):
                         return body(params, ids, lens, rng, noise_w,
                                     length_scale, noise_scale, sid)
 
-                    batch = (1, 2, 7)
+                    batch = (1, 2, 4, 5, 6, 7)
                 else:
                     def run(params, ids, lens, rng, noise_w, length_scale,
                             noise_scale):
                         return body(params, ids, lens, rng, noise_w,
                                     length_scale, noise_scale, None)
 
-                    batch = (1, 2)
+                    batch = (1, 2, 4, 5, 6)
                 fn = self._jit(run, batch)
                 self._full_cache[key] = fn
         return fn
@@ -510,28 +536,32 @@ class PiperVoice(BaseModel):
         """Run stage 1 on a padded batch (streaming path)."""
         ids, lens, b, t = self._pad_batch(ids_list)
         sid = self._sid_array(sc, b)
-        args = [self.params, ids, lens, self._next_rng(),
-                jnp.float32(sc.noise_w), jnp.float32(sc.length_scale)]
+        nw, ls, _ = self._scale_arrays(sc, b)
+        args = [self.params, ids, lens, self._next_rng(), nw, ls]
         if sid is not None:
             args.append(sid)
         m_p, logs_p, w_ceil, x_mask = self._encode_fn(b, t)(*args)
         return m_p, logs_p, w_ceil, x_mask, sid, b, t
 
-    def _estimate_frame_bucket(self, max_ids: int, length_scale: float) -> int:
+    def _estimate_frame_bucket(self, weighted_ids: float) -> int:
+        """``weighted_ids``: max over rows of ``len(ids) * length_scale`` —
+        the true per-row frame driver (a batch mixing a long 1x row with a
+        short 3x row must not be budgeted as long × 3x)."""
         with self._fpi_lock:
             fpi = self._frames_per_id
-        est = max_ids * fpi * max(length_scale, 0.05) * 1.25
+        est = weighted_ids * fpi * 1.25
         return bucket_for(max(int(est), 1), FRAME_BUCKETS)
 
-    def _observe_frames(self, max_ids: int, length_scale: float,
-                        frames: int) -> None:
-        ratio = frames / max(max_ids * max(length_scale, 0.05), 1.0)
+    def _observe_frames(self, weighted_ids: float, frames: int) -> None:
+        ratio = frames / max(weighted_ids, 1.0)
         with self._fpi_lock:
             # decaying upper bound: shrinks slowly, jumps up immediately
             self._frames_per_id = max(self._frames_per_id * 0.995, ratio)
 
     def _infer_batch(self, ids_list: list[list[int]], sc: SynthesisConfig,
-                     speakers: Optional[list[Optional[int]]] = None):
+                     speakers: Optional[list[Optional[int]]] = None,
+                     scales: "Optional[list[Optional[SynthesisConfig]]]"
+                     = None):
         """Batch ids → audio in ONE device round trip (estimate + retry).
 
         The frame budget comes from the adaptive estimator rather than a
@@ -541,18 +571,20 @@ class PiperVoice(BaseModel):
         the batch reruns once with a bucket that is known to fit.
         """
         n_real = len(ids_list)
-        max_ids = max(len(i) for i in ids_list)
         ids, lens, b, t = self._pad_batch(ids_list)
         sid = self._sid_array(sc, b, speakers)
+        nw, ls, ns = self._scale_arrays(sc, b, scales)
+        ls_rows = np.asarray(ls)[:n_real]
+        weighted_ids = float(max(
+            len(row) * max(float(ls_rows[i]), 0.05)
+            for i, row in enumerate(ids_list)))
         # one key for both dispatches: the overflow retry must reproduce the
         # exact duration draw it measured, or the bigger bucket could clip
         # a fresh, longer draw
         rng = self._next_rng()
 
         def dispatch(f: int):
-            args = [self.params, ids, lens, rng,
-                    jnp.float32(sc.noise_w), jnp.float32(sc.length_scale),
-                    jnp.float32(sc.noise_scale)]
+            args = [self.params, ids, lens, rng, nw, ls, ns]
             if sid is not None:
                 args.append(sid)
             out = self._full_fn(b, t, f)(*args)
@@ -560,10 +592,10 @@ class PiperVoice(BaseModel):
             # PJRT link cost ~70 ms each; device_get coalesces them
             return jax.device_get(out)
 
-        f = self._estimate_frame_bucket(max_ids, sc.length_scale)
+        f = self._estimate_frame_bucket(weighted_ids)
         wav_i16, wav_lengths, peaks, frames_needed = dispatch(f)
         actual = int(frames_needed[:n_real].max())
-        self._observe_frames(max_ids, sc.length_scale, actual)
+        self._observe_frames(weighted_ids, actual)
         if actual > f:  # overflow: audio was clipped; rerun with room
             f = bucket_for(actual, FRAME_BUCKETS)
             wav_i16, wav_lengths, peaks, frames_needed = dispatch(f)
@@ -591,8 +623,9 @@ class PiperVoice(BaseModel):
         total_frames = int(jnp.sum(w_ceil[:1]))
         f = bucket_for(max(total_frames, 1), FRAME_BUCKETS)
         aco = self._acoustics_fn(b, t, f)
+        _, _, ns = self._scale_arrays(sc, b)
         args = [self.params, m_p, logs_p, w_ceil, x_mask, self._next_rng(),
-                jnp.float32(sc.noise_scale)]
+                ns]
         if sid is not None:
             args.append(sid)
         z, y_lengths = aco(*args)

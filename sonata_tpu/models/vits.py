@@ -180,6 +180,9 @@ def duration_predictor_reverse(p: Params, hp: VitsHyperParams, x, x_mask,
     h = m.conv1d(h, p["proj"]) * x_mask
 
     b, t, _ = x.shape
+    # noise_w may be a scalar or a per-row [B] vector (coalesced batches
+    # carry per-request scales)
+    noise_w = jnp.reshape(jnp.asarray(noise_w, jnp.float32), (-1, 1, 1))
     z = jax.random.normal(rng, (b, t, 2)) * noise_w * x_mask
 
     # reversed flow stack: Flip/ConvFlow pairs (skipping ConvFlow #0), then
@@ -225,6 +228,8 @@ def encode_text(p: Params, hp: VitsHyperParams, ids, x_lengths, rng, *,
     x, m_p, logs_p = text_encoder(p["enc_p"], hp, ids, x_mask)
     logw = duration_predictor_reverse(p["dp"], hp, x, x_mask, rng,
                                       noise_w, g=g)
+    length_scale = jnp.reshape(jnp.asarray(length_scale, jnp.float32),
+                               (-1, 1, 1))  # scalar or per-row [B]
     w = jnp.exp(logw) * x_mask * length_scale
     w_ceil = jnp.ceil(w)[..., 0]  # [B, T]
     return m_p, logs_p, w_ceil, x_mask, g
@@ -260,6 +265,8 @@ def acoustics(p: Params, hp: VitsHyperParams, m_p, logs_p, w_ceil, x_mask,
     m_p_f = jnp.einsum("btf,btc->bfc", path, m_p)
     logs_p_f = jnp.einsum("btf,btc->bfc", path, logs_p)
     noise = jax.random.normal(rng, m_p_f.shape)
+    noise_scale = jnp.reshape(jnp.asarray(noise_scale, jnp.float32),
+                              (-1, 1, 1))  # scalar or per-row [B]
     z_p = m_p_f + noise * jnp.exp(logs_p_f) * noise_scale
     z = flow_reverse(p["flow"], hp, z_p, y_mask, g=g)
     return z * y_mask, y_mask, y_lengths

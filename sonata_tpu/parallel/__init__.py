@@ -2,6 +2,7 @@
 ring attention, multi-host initialization (analogue of — and upgrade over —
 the reference's rayon thread fan-out, SURVEY §2.4/§5)."""
 
+from . import checkpoint
 from .mesh import (
     DATA_AXIS,
     SEQ_AXIS,
@@ -13,6 +14,7 @@ from .mesh import (
 from .ring import ring_attention, ring_attention_sharded
 
 __all__ = [
+    "checkpoint",
     "DATA_AXIS",
     "SEQ_AXIS",
     "data_sharding",

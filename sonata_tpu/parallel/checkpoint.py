@@ -1,0 +1,71 @@
+"""Sharded checkpoint save/restore via Orbax.
+
+The single-voice format is a flat ``.npz``
+(:mod:`sonata_tpu.models.serialization`) — right for one host loading one
+file.  On a pod, every host re-reading the full archive and re-sharding
+wastes startup time and HBM staging; Orbax writes/reads each param shard
+from the process that owns it, so multi-host restore is parallel and
+arrives already laid out for the mesh.
+
+Usage::
+
+    from sonata_tpu.parallel import make_mesh, checkpoint
+
+    mesh = make_mesh()
+    checkpoint.save("/ckpt/voice1", voice.params)
+    params = checkpoint.restore("/ckpt/voice1", like=voice.params)
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Optional, Union
+
+from ..core import FailedToLoadResource
+
+
+def _checkpointer():
+    try:
+        import orbax.checkpoint as ocp
+    except ImportError as e:  # pragma: no cover
+        raise FailedToLoadResource(
+            "orbax is required for sharded checkpoints") from e
+    return ocp
+
+
+def save(path: Union[str, Path], params: Any, *, force: bool = True) -> None:
+    """Write a sharded checkpoint of a param pytree."""
+    ocp = _checkpointer()
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(Path(path).resolve(), params, force=force)
+
+
+def restore(path: Union[str, Path], *, like: Optional[Any] = None) -> Any:
+    """Restore a param pytree.
+
+    ``like``: an abstract or concrete pytree (e.g. freshly-initialized
+    params, possibly already sharded over a mesh) giving the target
+    structure, dtypes, and shardings; restoring without it yields
+    host-local arrays.
+    """
+    ocp = _checkpointer()
+    p = Path(path).resolve()
+    if not p.exists():
+        raise FailedToLoadResource(f"checkpoint not found: {p}")
+    try:
+        with ocp.StandardCheckpointer() as ckptr:
+            if like is not None:
+                import jax
+
+                abstract = jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(
+                        x.shape, x.dtype,
+                        sharding=getattr(x, "sharding", None)),
+                    like)
+                return ckptr.restore(p, abstract)
+            return ckptr.restore(p)
+    except FailedToLoadResource:
+        raise
+    except Exception as e:  # corrupt/partial checkpoint: orbax internals
+        raise FailedToLoadResource(
+            f"cannot restore checkpoint {p}: {e}") from e

@@ -7,15 +7,14 @@ own ONNX session call.  On TPU that wastes the device: a single dispatch
 for 16 sentences costs nearly the same wall time as for one (latency-bound;
 see SURVEY §7 step 5 "continuous batching across concurrent requests").
 
-:class:`BatchScheduler` keeps a queue of (sentence, speaker, future)
-triples; a worker collects up to ``max_batch`` sentences — waiting at most
-``max_wait_ms`` after the first — and issues one ``speak_batch`` with the
-per-row speakers.  Under load, throughput approaches full-batch efficiency;
+:class:`BatchScheduler` keeps a queue of (sentence, speaker, scales,
+future) tuples; a worker collects up to ``max_batch`` sentences — waiting
+at most ``max_wait_ms`` after the first — and issues one ``speak_batch``
+with the per-row speakers and scales.  Under load, throughput approaches full-batch efficiency;
 idle, a lone request pays only the wait window.
 
-Per-request synthesis scales are not supported inside one coalesced batch
-(requests share the voice's current config); callers needing custom scales
-bypass the scheduler.
+Requests may carry their own speaker id and synthesis scales; the batch
+forwards both per row, so coalescing never flattens per-request settings.
 """
 
 from __future__ import annotations
@@ -44,7 +43,8 @@ class BatchScheduler:
 
     # -- public API ----------------------------------------------------------
     def submit(self, phonemes: str,
-               speaker: Optional[int] = None) -> "Future[Audio]":
+               speaker: Optional[int] = None,
+               scales=None) -> "Future[Audio]":
         if self._closed.is_set():
             raise OperationError("scheduler is shut down")
         if speaker is not None:
@@ -58,13 +58,22 @@ class BatchScheduler:
                         f"speaker id {speaker} on a single-speaker voice")
             elif speaker not in speakers:
                 raise OperationError(f"unknown speaker id {speaker}")
+        if scales is not None:
+            # same rationale: a malformed scales object must fail THIS
+            # request at submit time, not the whole coalesced dispatch
+            for attr in ("noise_w", "length_scale", "noise_scale"):
+                value = getattr(scales, attr, None)
+                if not isinstance(value, (int, float)):
+                    raise OperationError(
+                        f"scales.{attr} missing or non-numeric")
         fut: "Future[Audio]" = Future()
-        self._queue.put((phonemes, speaker, fut))
+        self._queue.put((phonemes, speaker, scales, fut))
         return fut
 
     def speak(self, phonemes: str, timeout: Optional[float] = None,
-              speaker: Optional[int] = None) -> Audio:
-        return self.submit(phonemes, speaker=speaker).result(timeout)
+              speaker: Optional[int] = None, scales=None) -> Audio:
+        return self.submit(phonemes, speaker=speaker,
+                           scales=scales).result(timeout)
 
     def shutdown(self) -> None:
         self._closed.set()
@@ -102,16 +111,16 @@ class BatchScheduler:
             self._dispatch(batch)
 
     def _dispatch(self, batch) -> None:
-        sentences = [phonemes for phonemes, _, _ in batch]
-        speakers = [speaker for _, speaker, _ in batch]
+        sentences, speakers, scales, futures = (list(x) for x in zip(*batch))
         try:
-            # speakers is part of the Model protocol (core.Model.speak_batch)
-            audios = self._model.speak_batch(sentences, speakers=speakers)
+            # speakers/scales are part of the Model protocol
+            audios = self._model.speak_batch(sentences, speakers=speakers,
+                                             scales=scales)
         except Exception as e:
-            for _, _, fut in batch:
+            for fut in futures:
                 _try_set_exception(fut, e)
             return
-        for (_, _, fut), audio in zip(batch, audios):
+        for fut, audio in zip(futures, audios):
             _try_set_result(fut, audio)
 
 

@@ -69,9 +69,11 @@ class FakeModel(BaseModel):
     def phonemize_text(self, text: str) -> Phonemes:
         return text_to_phonemes(text, voice=self._language)
 
-    def _synthesize(self, phonemes: str) -> Audio:
-        n = max(int(len(phonemes) * self._spp * self._config.length_scale),
-                self._spp)
+    def _synthesize(self, phonemes: str,
+                    length_scale: Optional[float] = None) -> Audio:
+        ls = (length_scale if length_scale is not None
+              else self._config.length_scale)
+        n = max(int(len(phonemes) * self._spp * ls), self._spp)
         digest = hashlib.blake2b(phonemes.encode(), digest_size=2).digest()
         freq = 110.0 + (digest[0] % 64) * 10.0
         t = np.arange(n, dtype=np.float32) / self._info.sample_rate
@@ -83,7 +85,7 @@ class FakeModel(BaseModel):
         return self._synthesize(phonemes)
 
     def speak_batch(self, phoneme_batches: list,
-                    speakers=None) -> list[Audio]:
+                    speakers=None, scales=None) -> list[Audio]:
         # honor the protocol contract: reject speaker ids this model
         # cannot represent (core.Model.speak_batch docstring)
         for sid in speakers or []:
@@ -95,8 +97,14 @@ class FakeModel(BaseModel):
                         f"speaker id {sid} on a single-speaker fake")
             elif sid not in self._speakers:
                 raise OperationError(f"unknown speaker id {sid}")
-        self.calls.append(("speak_batch", list(phoneme_batches), speakers))
-        return [self._synthesize(p) for p in phoneme_batches]
+        self.calls.append(("speak_batch", list(phoneme_batches), speakers,
+                           scales))
+        out = []
+        for i, p in enumerate(phoneme_batches):
+            sc = scales[i] if scales and i < len(scales) and scales[i] else None
+            out.append(self._synthesize(p, length_scale=(
+                sc.length_scale if sc else None)))
+        return out
 
     def supports_streaming_output(self) -> bool:
         return True

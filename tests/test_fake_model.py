@@ -124,3 +124,32 @@ def test_fake_model_rejects_unknown_speakers():
         FakeModel().speak_batch(["x"], speakers=[3])
     with pytest.raises(OperationError):
         FakeModel(speakers={0: "a"}).speak_batch(["x"], speakers=[5])
+
+
+def test_scheduler_per_request_scales():
+    from sonata_tpu.models.config import SynthesisConfig
+
+    m = FakeModel()
+    sched = BatchScheduler(m, max_batch=4, max_wait_ms=20.0)
+    try:
+        slow = SynthesisConfig(length_scale=2.0)
+        a = sched.submit("abcd")
+        b = sched.submit("abcd", scales=slow)
+        ra, rb = a.result(5.0), b.result(5.0)
+        assert len(rb.samples) == 2 * len(ra.samples)
+    finally:
+        sched.shutdown()
+
+
+def test_scheduler_rejects_malformed_scales_at_submit():
+    from sonata_tpu.core import OperationError
+
+    m = FakeModel()
+    sched = BatchScheduler(m, max_wait_ms=10.0)
+    try:
+        with pytest.raises(OperationError):
+            sched.submit("x", scales={"length_scale": 2})  # dict, not config
+        ok = sched.speak("fine.", timeout=5.0)
+        assert len(ok.samples) > 0  # worker unaffected
+    finally:
+        sched.shutdown()

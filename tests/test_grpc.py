@@ -225,3 +225,24 @@ def test_concurrent_load_voice_loads_once(tmp_path_factory, monkeypatch):
         t.join()
     assert len(calls) == 1  # one real load despite 4 concurrent requests
     assert len({r.voice_id for r in results}) == 1
+
+
+def test_continuous_batching_speaker_snapshot(tmp_path_factory):
+    from sonata_tpu.frontends import grpc_server as srv
+
+    cfg = str(write_tiny_voice(
+        tmp_path_factory.mktemp("cbspk"), num_speakers=4,
+        speaker_id_map={f"spk{i}": i for i in range(4)}))
+    service = srv.SonataGrpcService(continuous_batching=True)
+
+    class Ctx:
+        def abort(self, code, msg):
+            raise AssertionError(f"{code}: {msg}")
+
+    info = service.LoadVoice(pb.VoicePath(config_path=cfg), Ctx())
+    service.SetSynthesisOptions(pb.VoiceSynthesisOptions(
+        voice_id=info.voice_id,
+        synthesis_options=pb.SynthesisOptions(speaker="spk2")), Ctx())
+    results = list(service.SynthesizeUtterance(
+        pb.Utterance(voice_id=info.voice_id, text="Snapshot check."), Ctx()))
+    assert len(results) == 1 and len(results[0].wav_samples) > 0

@@ -119,3 +119,26 @@ def test_ring_attention_custom_axis():
     np.testing.assert_allclose(np.asarray(out),
                                np.asarray(_exact_attention(q, q, q, kv_valid)),
                                atol=2e-5)
+
+
+def test_orbax_sharded_checkpoint_roundtrip(tmp_path):
+    from sonata_tpu.parallel import checkpoint
+
+    v = tiny_voice(seed=17)
+    path = tmp_path / "ckpt"
+    checkpoint.save(path, v.params)
+    back = checkpoint.restore(path, like=v.params)
+    from sonata_tpu.models.serialization import flatten_params
+
+    fa, fb = flatten_params(v.params), flatten_params(back)
+    assert fa.keys() == fb.keys()
+    for k in fa:
+        np.testing.assert_array_equal(fa[k], fb[k])
+
+
+def test_orbax_restore_missing_path(tmp_path):
+    from sonata_tpu.core import FailedToLoadResource
+    from sonata_tpu.parallel import checkpoint
+
+    with pytest.raises(FailedToLoadResource):
+        checkpoint.restore(tmp_path / "nope")

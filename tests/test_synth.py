@@ -170,9 +170,9 @@ def test_batch_scheduler_coalesces_concurrent_requests():
     dispatches = []
     real = voice.speak_batch
 
-    def counting(sentences, speakers=None):
+    def counting(sentences, speakers=None, scales=None):
         dispatches.append(len(sentences))
-        return real(sentences, speakers=speakers)
+        return real(sentences, speakers=speakers, scales=scales)
 
     voice.speak_batch = counting
     sched = BatchScheduler(voice, max_batch=8, max_wait_ms=200.0)
@@ -195,7 +195,7 @@ def test_batch_scheduler_propagates_errors():
     from sonata_tpu.synth import BatchScheduler
 
     class Bad:
-        def speak_batch(self, sentences, speakers=None):
+        def speak_batch(self, sentences, speakers=None, scales=None):
             raise OperationError("device on fire")
 
     sched = BatchScheduler(Bad(), max_wait_ms=1.0)
@@ -226,7 +226,7 @@ def test_batch_scheduler_shutdown_fails_pending():
     release = threading.Event()
 
     class Slow:
-        def speak_batch(self, sentences, speakers=None):
+        def speak_batch(self, sentences, speakers=None, scales=None):
             release.wait(5.0)
             raise OperationError("never mind")
 

@@ -290,3 +290,30 @@ def test_single_speaker_voice_rejects_other_speakers(voice):
     # speaker 0 / None are fine on a single-speaker voice
     ok = voice.speak_batch(["tɛst.", "tɛst."], speakers=[0, None])
     assert len(ok) == 2
+
+
+def test_quality_preset_x_low():
+    # x_low preset: slim dims (96 channels, 256 decoder base)
+    from sonata_tpu.models.config import ModelConfig
+
+    mc = ModelConfig.from_dict({
+        "audio": {"sample_rate": 16000, "quality": "x_low"},
+        "num_symbols": 5,
+        "phoneme_id_map": {"_": [0], "^": [1], "$": [2], "a": [3]},
+    })
+    assert mc.hyper.hidden_channels == 96
+    assert mc.hyper.upsample_initial_channel == 256
+    assert mc.hyper.hop_length == 256
+
+
+def test_per_row_scales_in_one_batch(voice):
+    # per-request length_scale inside one dispatch: row 1 at 3x must be
+    # about 3x longer than row 0 at 1x for identical text
+    long_cfg = SynthesisConfig(length_scale=3.0, noise_scale=0.0, noise_w=0.0)
+    base_cfg = SynthesisConfig(length_scale=1.0, noise_scale=0.0, noise_w=0.0)
+    ph = "seɪm wɜːdz hɪɹ tʊdeɪ."
+    audios = voice.speak_batch([ph, ph], scales=[base_cfg, long_cfg])
+    n0, n1 = len(audios[0].samples), len(audios[1].samples)
+    assert n1 > 2.3 * n0
+    with pytest.raises(Exception):
+        voice.speak_batch([ph], scales=[base_cfg, long_cfg])  # len mismatch
