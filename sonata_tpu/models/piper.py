@@ -307,8 +307,10 @@ class PiperVoice(BaseModel):
         nw = [row(i, "noise_w") for i in range(batch)]
         ls = [row(i, "length_scale") for i in range(batch)]
         ns = [row(i, "noise_scale") for i in range(batch)]
+        # host lists returned alongside the device arrays so callers can do
+        # host-side math (frame estimation) without a device round trip
         return (jnp.asarray(nw, jnp.float32), jnp.asarray(ls, jnp.float32),
-                jnp.asarray(ns, jnp.float32))
+                jnp.asarray(ns, jnp.float32), ls)
 
     def _sid_array(self, sc: SynthesisConfig, batch: int,
                    speakers: Optional[list[Optional[int]]] = None):
@@ -536,7 +538,7 @@ class PiperVoice(BaseModel):
         """Run stage 1 on a padded batch (streaming path)."""
         ids, lens, b, t = self._pad_batch(ids_list)
         sid = self._sid_array(sc, b)
-        nw, ls, _ = self._scale_arrays(sc, b)
+        nw, ls, _, _ = self._scale_arrays(sc, b)
         args = [self.params, ids, lens, self._next_rng(), nw, ls]
         if sid is not None:
             args.append(sid)
@@ -573,10 +575,9 @@ class PiperVoice(BaseModel):
         n_real = len(ids_list)
         ids, lens, b, t = self._pad_batch(ids_list)
         sid = self._sid_array(sc, b, speakers)
-        nw, ls, ns = self._scale_arrays(sc, b, scales)
-        ls_rows = np.asarray(ls)[:n_real]
+        nw, ls, ns, ls_host = self._scale_arrays(sc, b, scales)
         weighted_ids = float(max(
-            len(row) * max(float(ls_rows[i]), 0.05)
+            len(row) * max(ls_host[i], 0.05)
             for i, row in enumerate(ids_list)))
         # one key for both dispatches: the overflow retry must reproduce the
         # exact duration draw it measured, or the bigger bucket could clip
@@ -623,7 +624,7 @@ class PiperVoice(BaseModel):
         total_frames = int(jnp.sum(w_ceil[:1]))
         f = bucket_for(max(total_frames, 1), FRAME_BUCKETS)
         aco = self._acoustics_fn(b, t, f)
-        _, _, ns = self._scale_arrays(sc, b)
+        _, _, ns, _ = self._scale_arrays(sc, b)
         args = [self.params, m_p, logs_p, w_ceil, x_mask, self._next_rng(),
                 ns]
         if sid is not None:

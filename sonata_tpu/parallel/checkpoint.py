@@ -33,8 +33,12 @@ def _checkpointer():
     return ocp
 
 
-def save(path: Union[str, Path], params: Any, *, force: bool = True) -> None:
-    """Write a sharded checkpoint of a param pytree."""
+def save(path: Union[str, Path], params: Any, *,
+         force: bool = False) -> None:
+    """Write a sharded checkpoint of a param pytree.
+
+    ``force=False`` (the default, matching Orbax) refuses to overwrite an
+    existing checkpoint; pass ``force=True`` to replace it."""
     ocp = _checkpointer()
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(Path(path).resolve(), params, force=force)

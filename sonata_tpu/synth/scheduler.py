@@ -61,9 +61,11 @@ class BatchScheduler:
         if scales is not None:
             # same rationale: a malformed scales object must fail THIS
             # request at submit time, not the whole coalesced dispatch
+            import numbers
+
             for attr in ("noise_w", "length_scale", "noise_scale"):
                 value = getattr(scales, attr, None)
-                if not isinstance(value, (int, float)):
+                if not isinstance(value, numbers.Real):
                     raise OperationError(
                         f"scales.{attr} missing or non-numeric")
         fut: "Future[Audio]" = Future()

@@ -86,8 +86,13 @@ class FakeModel(BaseModel):
 
     def speak_batch(self, phoneme_batches: list,
                     speakers=None, scales=None) -> list[Audio]:
-        # honor the protocol contract: reject speaker ids this model
-        # cannot represent (core.Model.speak_batch docstring)
+        # honor the protocol contract: reject what this model cannot
+        # represent, and misaligned lists (core.Model.speak_batch docstring)
+        for name, lst in (("speakers", speakers), ("scales", scales)):
+            if lst is not None and len(lst) != len(phoneme_batches):
+                raise OperationError(
+                    f"{name} list has {len(lst)} entries for "
+                    f"{len(phoneme_batches)} sentences")
         for sid in speakers or []:
             if sid is None:
                 continue

@@ -1,0 +1,169 @@
+"""Independent numerical checks of the hard model math (SURVEY §7 "hard
+parts": duration-flow numerics, alignment, attention).
+
+Each test reimplements the operation brute-force from its mathematical
+definition — per-position loops, no shared helper code with the vectorized
+JAX implementations — so an indexing mistake in the fast path cannot
+self-validate.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sonata_tpu.models import modules as m
+from sonata_tpu.models import vits
+
+
+# ---------------------------------------------------------------------------
+# windowed relative-position attention vs per-position brute force
+# ---------------------------------------------------------------------------
+
+def _brute_force_rel_attention(x, mask, p, n_heads, window):
+    """logits[i,j] = q_i·k_j/√d + q_i·emb_k[j-i]/√d for |j-i| ≤ window;
+    out_i = Σ_j w_ij (v_j) + Σ_j w_ij emb_v[j-i]."""
+    def conv1x1(x, pp):
+        return x @ np.asarray(pp["w"])[0] + np.asarray(pp["b"])
+
+    b, t, c = x.shape
+    head = c // n_heads
+    q = conv1x1(x, p["q"]).reshape(b, t, n_heads, head)
+    k = conv1x1(x, p["k"]).reshape(b, t, n_heads, head)
+    v = conv1x1(x, p["v"]).reshape(b, t, n_heads, head)
+    emb_k = np.asarray(p["emb_rel_k"])[0]  # [2w+1, head]
+    emb_v = np.asarray(p["emb_rel_v"])[0]
+    out = np.zeros_like(q)
+    scale = head ** -0.5
+    for bi in range(b):
+        for h in range(n_heads):
+            logits = np.full((t, t), -1e4)
+            for i in range(t):
+                if mask[bi, i, 0] == 0:
+                    continue
+                for j in range(t):
+                    if mask[bi, j, 0] == 0:
+                        continue
+                    s = float(q[bi, i, h] @ k[bi, j, h]) * scale
+                    rel = j - i
+                    if -window <= rel <= window:
+                        s += float(q[bi, i, h] @ emb_k[rel + window]) * scale
+                    logits[i, j] = s
+            w = np.exp(logits - logits.max(axis=1, keepdims=True))
+            w /= w.sum(axis=1, keepdims=True)
+            for i in range(t):
+                acc = np.zeros(head)
+                for j in range(t):
+                    acc += w[i, j] * v[bi, j, h]
+                    rel = j - i
+                    if -window <= rel <= window:
+                        acc += w[i, j] * emb_v[rel + window]
+                out[bi, i, h] = acc
+    out = out.reshape(b, t, c)
+    return (conv1x1(out, p["o"]) * mask).astype(np.float32)
+
+
+@pytest.mark.parametrize("t,window", [(6, 4), (12, 4), (9, 2)])
+def test_rel_attention_matches_brute_force(t, window):
+    rng = jax.random.PRNGKey(0)
+    c, n_heads = 8, 2
+    p = m.init_rel_attention(rng, c, n_heads, window)
+    x = np.asarray(jax.random.normal(jax.random.PRNGKey(1), (2, t, c)))
+    lengths = np.array([t, max(t - 3, 1)])
+    mask = (np.arange(t)[None, :] < lengths[:, None]).astype(np.float32)[..., None]
+
+    fast = np.asarray(m.rel_attention(jnp.asarray(x), jnp.asarray(mask), p,
+                                      n_heads=n_heads, window=window))
+    slow = _brute_force_rel_attention(x, mask, p, n_heads, window)
+    np.testing.assert_allclose(fast * mask, slow * mask, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# rational-quadratic spline: inverse ∘ forward == identity
+# ---------------------------------------------------------------------------
+
+def _forward_spline_scalar(x, uw, uh, ud, tail_bound):
+    """Forward RQS from Durkan et al. eqs (brute force, scalar)."""
+    nb = len(uw)
+    if not (-tail_bound <= x <= tail_bound):
+        return x
+    w = np.exp(uw - uw.max())
+    w = w / w.sum()
+    w = 1e-3 + (1 - 1e-3 * nb) * w
+    cw = np.concatenate([[0.0], np.cumsum(w)]) * 2 * tail_bound - tail_bound
+    widths = np.diff(cw)
+    h = np.exp(uh - uh.max())
+    h = h / h.sum()
+    h = 1e-3 + (1 - 1e-3 * nb) * h
+    ch = np.concatenate([[0.0], np.cumsum(h)]) * 2 * tail_bound - tail_bound
+    heights = np.diff(ch)
+    pad = math.log(math.exp(1 - 1e-3) - 1)
+    d = 1e-3 + np.log1p(np.exp(np.concatenate([[pad], ud, [pad]])))
+
+    k = int(np.searchsorted(cw[1:-1], x, side="right"))
+    xi = (x - cw[k]) / widths[k]
+    delta = heights[k] / widths[k]
+    num = heights[k] * (delta * xi**2 + d[k] * xi * (1 - xi))
+    den = delta + (d[k] + d[k + 1] - 2 * delta) * xi * (1 - xi)
+    return ch[k] + num / den
+
+
+def test_spline_inverse_of_forward_is_identity():
+    rng = np.random.default_rng(3)
+    nb, tail = 10, 5.0
+    uw = rng.normal(size=nb).astype(np.float32)
+    uh = rng.normal(size=nb).astype(np.float32)
+    ud = rng.normal(size=nb - 1).astype(np.float32)
+    xs = np.linspace(-6.0, 6.0, 41).astype(np.float32)  # includes tails
+    ys = np.array([_forward_spline_scalar(float(x), uw, uh, ud, tail)
+                   for x in xs], dtype=np.float32)
+
+    x_back, _ = m.rational_quadratic_spline_inverse(
+        jnp.asarray(ys),
+        jnp.broadcast_to(jnp.asarray(uw), (41, nb)),
+        jnp.broadcast_to(jnp.asarray(uh), (41, nb)),
+        jnp.broadcast_to(jnp.asarray(ud), (41, nb - 1)),
+        tail_bound=tail)
+    np.testing.assert_allclose(np.asarray(x_back), xs, atol=2e-4)
+
+
+def test_spline_forward_is_monotonic():
+    rng = np.random.default_rng(7)
+    uw = rng.normal(size=10)
+    uh = rng.normal(size=10)
+    ud = rng.normal(size=9)
+    xs = np.linspace(-5.0, 5.0, 200)
+    ys = [_forward_spline_scalar(x, uw, uh, ud, 5.0) for x in xs]
+    assert all(b > a for a, b in zip(ys, ys[1:]))
+
+
+# ---------------------------------------------------------------------------
+# monotonic alignment path vs per-frame loop
+# ---------------------------------------------------------------------------
+
+def test_generate_path_matches_loop():
+    w_ceil = jnp.asarray([[2.0, 3.0, 1.0, 0.0], [1.0, 1.0, 0.0, 0.0]])
+    x_mask = jnp.asarray([[1.0, 1, 1, 0], [1, 1, 0, 0]])[..., None]
+    max_frames = 8
+    fast = np.asarray(vits.generate_path(w_ceil, x_mask, max_frames))
+
+    slow = np.zeros_like(fast)
+    for b in range(2):
+        f = 0
+        for t in range(4):
+            dur = int(w_ceil[b, t] * x_mask[b, t, 0])
+            for _ in range(dur):
+                if f < max_frames:
+                    slow[b, t, f] = 1.0
+                f += 1
+    np.testing.assert_array_equal(fast, slow)
+    # each frame belongs to at most one phoneme
+    assert fast.sum(axis=1).max() <= 1.0
+
+
+def test_sequence_mask():
+    mk = np.asarray(vits.sequence_mask(jnp.asarray([3, 1]), 5))
+    np.testing.assert_array_equal(mk[..., 0],
+                                  [[1, 1, 1, 0, 0], [1, 0, 0, 0, 0]])

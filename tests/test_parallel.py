@@ -122,6 +122,7 @@ def test_ring_attention_custom_axis():
 
 
 def test_orbax_sharded_checkpoint_roundtrip(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
     from sonata_tpu.parallel import checkpoint
 
     v = tiny_voice(seed=17)
@@ -137,6 +138,7 @@ def test_orbax_sharded_checkpoint_roundtrip(tmp_path):
 
 
 def test_orbax_restore_missing_path(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
     from sonata_tpu.core import FailedToLoadResource
     from sonata_tpu.parallel import checkpoint
 
