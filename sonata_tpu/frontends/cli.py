@@ -60,6 +60,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", choices=("xla",), default="xla",
                    help="compute backend (XLA/PJRT only)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--info", action="store_true",
+                   help="print voice metadata as JSON and exit")
     return p
 
 
@@ -180,6 +182,25 @@ def main(argv=None) -> int:
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
     args = build_parser().parse_args(argv)
     try:
+        if args.info:
+            # metadata comes straight from the JSON config; don't pay the
+            # weight import just to print it
+            from ..models import ModelConfig
+
+            mc = ModelConfig.from_path(args.config)
+            sc = mc.inference
+            print(json.dumps({
+                "language": mc.language or mc.espeak_voice,
+                "sample_rate": mc.sample_rate,
+                "num_channels": 1,
+                "speakers": mc.reversed_speaker_map() or None,
+                "supports_streaming_output": True,
+                "properties": {"quality": mc.quality or "unknown"},
+                "synthesis": {"length_scale": sc.length_scale,
+                              "noise_scale": sc.noise_scale,
+                              "noise_w": sc.noise_w},
+            }, ensure_ascii=False))
+            return 0
         voice = from_config_path(args.config, seed=args.seed)
         synth = SpeechSynthesizer(voice)
         _apply_scales(synth, args)

@@ -40,6 +40,7 @@ from .. import __version__
 from ..core import FailedToLoadResource, OperationError, SonataError
 from ..models import PiperVoice, from_config_path
 from ..synth import AudioOutputConfig, SpeechSynthesizer
+from ..utils.profiling import RtfCounter
 from . import grpc_messages as pb
 
 log = logging.getLogger("sonata.grpc")
@@ -62,6 +63,8 @@ class _Voice:
         self.synth = SpeechSynthesizer(voice)
         self.config_path = config_path
         self.voice_id = voice_id
+        self.rtf = RtfCounter()  # aggregate serving metrics (SURVEY §5)
+        self.rtf_logged_at = 0  # watermark for periodic aggregate logging
         self.scheduler = None
         if continuous_batching:
             from ..synth.scheduler import BatchScheduler
@@ -120,6 +123,18 @@ class SonataGrpcService:
             quality=pb.Quality.from_string(v.voice.config.quality),
             supports_streaming_output=v.voice.supports_streaming_output(),
         )
+
+    @staticmethod
+    def _maybe_log_rtf(v: "_Voice", every: int = 50) -> None:
+        """Log aggregate serving RTF roughly every ``every`` utterances
+        (watermark, not modulo: multi-sentence requests advance the count
+        in jumps)."""
+        stats = v.rtf.snapshot()
+        if stats.utterances - v.rtf_logged_at >= every:
+            v.rtf_logged_at = stats.utterances
+            log.info("voice %s: %d utterances, aggregate RTF %.4f "
+                     "(%.1f audio-s/s)", v.voice_id, stats.utterances,
+                     stats.rtf, stats.audio_seconds_per_second)
 
     # -- unary RPCs -----------------------------------------------------------
     def GetSonataVersion(self, request: pb.Empty, context) -> pb.Version:
@@ -218,9 +233,11 @@ class SonataGrpcService:
                            for sentence in v.synth.phonemize_text(request.text)]
                 for fut in futures:
                     audio = fut.result()
+                    v.rtf.record(audio)
                     yield pb.SynthesisResult(
                         wav_samples=audio.as_wave_bytes(),
                         rtf=audio.real_time_factor())
+                self._maybe_log_rtf(v)
                 return
             if request.synthesis_mode in (pb.SynthesisMode.PARALLEL,
                                           pb.SynthesisMode.BATCHED):
@@ -228,9 +245,11 @@ class SonataGrpcService:
             else:
                 stream = v.synth.synthesize_lazy(request.text, cfg)
             for audio in stream:
+                v.rtf.record(audio)
                 yield pb.SynthesisResult(
                     wav_samples=audio.as_wave_bytes(),
                     rtf=audio.real_time_factor())  # main.rs:345-348
+            self._maybe_log_rtf(v)
         except SonataError as e:
             context.abort(_status_for(e), str(e))
 

@@ -619,17 +619,30 @@ class PiperVoice(BaseModel):
 
         t_enc0 = time.perf_counter()
         m_p, logs_p, w_ceil, x_mask, sid, b, t = self._run_encode([ids], sc)
-        # row 0 only: with a mesh attached the batch is padded with dummy
-        # rows whose frames must not count
+        # TTFB: dispatch acoustics immediately with the *estimated* frame
+        # bucket so the frame-count host sync overlaps device work instead
+        # of serializing before it; on the rare underestimate, redo
+        # acoustics with the exact bucket
+        weighted = len(ids) * max(float(sc.length_scale), 0.05)
+        f = self._estimate_frame_bucket(weighted)
+
+        def run_acoustics(bucket: int):
+            aco = self._acoustics_fn(b, t, bucket)
+            _, _, ns, _ = self._scale_arrays(sc, b)
+            args = [self.params, m_p, logs_p, w_ceil, x_mask,
+                    self._next_rng(), ns]
+            if sid is not None:
+                args.append(sid)
+            return aco(*args)
+
+        z, y_lengths = run_acoustics(f)
+        # sync on row 0 only (with a mesh the batch has dummy rows); by now
+        # acoustics is in flight or done
         total_frames = int(jnp.sum(w_ceil[:1]))
-        f = bucket_for(max(total_frames, 1), FRAME_BUCKETS)
-        aco = self._acoustics_fn(b, t, f)
-        _, _, ns, _ = self._scale_arrays(sc, b)
-        args = [self.params, m_p, logs_p, w_ceil, x_mask, self._next_rng(),
-                ns]
-        if sid is not None:
-            args.append(sid)
-        z, y_lengths = aco(*args)
+        self._observe_frames(weighted, total_frames)
+        if total_frames > f:  # underestimate: z would be clipped
+            f = bucket_for(total_frames, FRAME_BUCKETS)
+            z, y_lengths = run_acoustics(f)
         total_frames = min(total_frames, f)
         enc_ms = (time.perf_counter() - t_enc0) * 1000.0
 

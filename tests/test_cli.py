@@ -116,3 +116,11 @@ def test_stdin_requests_do_not_leak_scales(tmp_path, voice_path, monkeypatch):
     a1, _, _ = read_wave_file(tmp_path / "leak-1.wav")
     # stretched request must be materially longer than the default one
     assert a0.size > a1.size * 1.5
+
+
+def test_info_flag(voice_path, capsys):
+    assert main([str(voice_path), "--info"]) == 0
+    info = json.loads(capsys.readouterr().out.strip())
+    assert info["sample_rate"] == 16000
+    assert info["supports_streaming_output"] is True
+    assert info["synthesis"]["length_scale"] == 1.0
