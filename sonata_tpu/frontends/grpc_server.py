@@ -141,6 +141,9 @@ class SonataGrpcService:
         return pb.Version(version=__version__)
 
     def LoadVoice(self, request: pb.VoicePath, context) -> pb.VoiceInfo:
+        if not request.config_path:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "config_path is required")
         vid = voice_id_for(request.config_path)
         # per-voice load lock: concurrent loads of the same path block on
         # one load instead of each importing the model (the reference holds

@@ -83,6 +83,8 @@ def iter_fields(buf) -> Iterator[tuple[int, int, Any]]:
         if wire == WIRE_VARINT:
             value, pos = read_varint(mv, pos)
         elif wire == WIRE_64BIT:
+            if pos + 8 > len(mv):
+                raise WireError("truncated 64-bit field")
             value = mv[pos:pos + 8]
             pos += 8
         elif wire == WIRE_LEN:
@@ -92,6 +94,8 @@ def iter_fields(buf) -> Iterator[tuple[int, int, Any]]:
             value = mv[pos:pos + n]
             pos += n
         elif wire == WIRE_32BIT:
+            if pos + 4 > len(mv):
+                raise WireError("truncated 32-bit field")
             value = mv[pos:pos + 4]
             pos += 4
         else:
@@ -135,7 +139,11 @@ def _decode_value(kind: str, wire: int, raw, submsg):
         return bytes(raw)
     if kind == "message":
         return submsg.decode(raw)
-    if kind in ("uint32", "uint64", "int64", "int32", "enum"):
+    if kind in ("int64", "int32"):
+        value = int(raw)
+        # proto varints are two's-complement 64-bit: sign-extend negatives
+        return value - (1 << 64) if value >= (1 << 63) else value
+    if kind in ("uint32", "uint64", "enum"):
         return int(raw)
     if kind == "bool":
         return bool(raw)
@@ -146,12 +154,35 @@ def _decode_value(kind: str, wire: int, raw, submsg):
     if kind == "map_int64_string":
         k = v = None
         for f, w, val in iter_fields(raw):
-            if f == 1:
+            if f == 1 and w == WIRE_VARINT:
                 k = int(val)
-            elif f == 2:
+                if k >= (1 << 63):
+                    k -= 1 << 64
+            elif f == 2 and w == WIRE_LEN:
                 v = bytes(val).decode("utf-8", errors="replace")
         return (k, v)
     raise WireError(f"unknown kind {kind}")
+
+
+def _decode_packed(kind: str, raw, submsg) -> list:
+    """Decode a packed repeated scalar payload."""
+    out = []
+    expected = _KIND_WIRE[kind]
+    if expected == WIRE_VARINT:
+        mv = memoryview(raw)
+        pos = 0
+        while pos < len(mv):
+            v, pos = read_varint(mv, pos)
+            out.append(_decode_value(kind, WIRE_VARINT, v, submsg))
+    else:
+        width = 4 if expected == WIRE_32BIT else 8
+        mv = memoryview(raw)
+        if len(mv) % width:
+            raise WireError("truncated packed fixed-width payload")
+        for i in range(0, len(mv), width):
+            out.append(_decode_value(kind, expected, mv[i:i + width],
+                                     submsg))
+    return out
 
 
 @dataclass(frozen=True)
@@ -201,6 +232,18 @@ class Message:
             if entry is None:
                 continue  # unknown field: skip (proto3 semantics)
             name, f = entry
+            expected = _KIND_WIRE[f.kind]
+            if wire != expected:
+                if (f.repeated and wire == WIRE_LEN
+                        and expected in (WIRE_VARINT, WIRE_32BIT,
+                                         WIRE_64BIT)):
+                    # packed repeated scalars (proto3 writers pack by
+                    # default)
+                    getattr(msg, name).extend(
+                        _decode_packed(f.kind, raw, f.message))
+                # else: wire-type mismatch (malformed or incompatible
+                # writer) — treat like an unknown field, don't crash mid-RPC
+                continue
             value = _decode_value(f.kind, wire, raw, f.message)
             if f.repeated:
                 getattr(msg, name).append(value)

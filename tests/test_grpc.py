@@ -246,3 +246,10 @@ def test_continuous_batching_speaker_snapshot(tmp_path_factory):
     results = list(service.SynthesizeUtterance(
         pb.Utterance(voice_id=info.voice_id, text="Snapshot check."), Ctx()))
     assert len(results) == 1 and len(results[0].wav_samples) > 0
+
+
+def test_load_voice_empty_path_invalid_argument(server_and_voice):
+    channel, _ = server_and_voice
+    with pytest.raises(grpc.RpcError) as e:
+        _unary(channel, "LoadVoice", pb.VoicePath(), pb.VoiceInfo)
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT

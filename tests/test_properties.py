@@ -1,0 +1,148 @@
+"""Property-based tests (hypothesis) for the pure-host invariants.
+
+The protobuf codec carries the gRPC wire contract and the chunker schedules
+every streamed utterance — both must hold for arbitrary inputs, not just
+the examples in the unit tests.
+"""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from sonata_tpu.audio import AudioSamples
+from sonata_tpu.models.chunker import MIN_CHUNK_SIZE, plan_chunks
+from sonata_tpu.utils.buckets import (
+    BATCH_BUCKETS,
+    FRAME_BUCKETS,
+    TEXT_BUCKETS,
+    bucket_for,
+)
+from sonata_tpu.utils.protowire import Field, Message
+
+
+class _Inner(Message):
+    FIELDS = {"x": Field(1, "uint32")}
+
+
+class _Msg(Message):
+    FIELDS = {
+        "s": Field(1, "string"),
+        "b": Field(2, "bytes"),
+        "u": Field(3, "uint32"),
+        "i": Field(4, "int64"),
+        "f": Field(5, "float"),
+        "flag": Field(6, "bool"),
+        "sub": Field(7, "message", _Inner),
+        "m": Field(8, "map_int64_string"),
+        "reps": Field(9, "string", repeated=True),
+    }
+
+
+@settings(max_examples=200, deadline=None)
+@given(
+    s=st.text(max_size=50),
+    b=st.binary(max_size=64),
+    u=st.integers(min_value=0, max_value=2**32 - 1),
+    i=st.integers(min_value=-(2**63), max_value=2**63 - 1),
+    flag=st.booleans(),
+    x=st.integers(min_value=0, max_value=2**31),
+    m=st.dictionaries(st.integers(min_value=-(2**31), max_value=2**31),
+                      st.text(max_size=20), max_size=5),
+    reps=st.lists(st.text(max_size=10), max_size=5),
+)
+def test_protowire_roundtrip_property(s, b, u, i, flag, x, m, reps):
+    msg = _Msg(s=s, b=b, u=u, i=i, f=1.5, flag=flag, sub=_Inner(x=x),
+               m=m, reps=reps)
+    back = _Msg.decode(msg.encode())
+    assert back.s == s and back.b == b and back.u == u and back.i == i
+    assert back.flag is flag and back.sub.x == x
+    assert back.m == m and back.reps == reps
+
+
+@settings(max_examples=200, deadline=None)
+@given(data=st.binary(max_size=200))
+def test_protowire_decode_never_crashes_on_garbage(data):
+    from sonata_tpu.utils.protowire import WireError
+
+    try:
+        _Msg.decode(data)
+    except (WireError, UnicodeDecodeError):
+        pass  # rejecting garbage is fine; crashing any other way is not
+
+
+@settings(max_examples=300, deadline=None)
+@given(total=st.integers(min_value=1, max_value=20000),
+       chunk=st.integers(min_value=1, max_value=1500),
+       pad=st.integers(min_value=0, max_value=20))
+def test_chunk_plans_partition_property(total, chunk, pad):
+    plans = plan_chunks(total, chunk, pad)
+    # emitted regions partition [0, total) exactly
+    emitted = sum(p.width - p.trim_left - p.trim_right for p in plans)
+    assert emitted == total
+    pos = 0
+    for p in plans:
+        assert 0 <= p.win_start <= p.win_start + p.trim_left
+        assert p.win_end <= total
+        body_start = p.win_start + p.trim_left
+        body_end = p.win_end - p.trim_right
+        assert body_start == pos
+        pos = body_end
+    assert pos == total
+    # no emitted tail shorter than MIN_CHUNK_SIZE (unless one-shot)
+    if len(plans) > 1:
+        last = plans[-1]
+        assert (last.width - last.trim_left - last.trim_right
+                >= min(MIN_CHUNK_SIZE, total))
+
+
+@settings(max_examples=200, deadline=None)
+@given(n=st.integers(min_value=1, max_value=10**6),
+       which=st.sampled_from([TEXT_BUCKETS, FRAME_BUCKETS, BATCH_BUCKETS]))
+def test_bucket_for_property(n, which):
+    b = bucket_for(n, which)
+    assert b >= n
+    # minimal: no smaller bucket (or top-multiple) would fit
+    if b in which:
+        smaller = [x for x in which if x < b]
+        assert all(x < n for x in smaller)
+    else:
+        assert b % which[-1] == 0 and b - which[-1] < n
+
+
+@settings(max_examples=100, deadline=None)
+@given(data=st.lists(st.floats(min_value=-10, max_value=10,
+                               allow_nan=False), max_size=100))
+def test_to_i16_bounds_property(data):
+    i = AudioSamples(np.asarray(data, dtype=np.float32)).to_i16()
+    assert i.dtype == np.int16
+    if len(data):
+        assert int(np.abs(i.astype(np.int32)).max()) <= 32767
+
+
+@settings(max_examples=100, deadline=None)
+@given(data=st.lists(st.floats(min_value=-1, max_value=1, allow_nan=False),
+                     min_size=1, max_size=60),
+       n=st.integers(min_value=0, max_value=80))
+def test_fades_never_increase_magnitude(data, n):
+    x = np.asarray(data, dtype=np.float32)
+    out = AudioSamples(x.copy()).crossfade(n)
+    assert np.all(np.abs(out.data) <= np.abs(x) + 1e-6)
+
+
+def test_packed_repeated_scalars_decode():
+    from sonata_tpu.utils.protowire import write_varint
+
+    class R(Message):
+        FIELDS = {"vals": Field(1, "uint32", repeated=True),
+                  "floats": Field(2, "float", repeated=True)}
+
+    import struct
+
+    packed_varints = b"".join(write_varint(v) for v in (1, 300, 7))
+    payload = (write_varint((1 << 3) | 2) + write_varint(len(packed_varints))
+               + packed_varints)
+    packed_floats = struct.pack("<3f", 1.0, -2.5, 3.25)
+    payload += (write_varint((2 << 3) | 2) + write_varint(len(packed_floats))
+                + packed_floats)
+    msg = R.decode(payload)
+    assert msg.vals == [1, 300, 7]
+    assert msg.floats == [1.0, -2.5, 3.25]
