@@ -626,11 +626,15 @@ class PiperVoice(BaseModel):
         weighted = len(ids) * max(float(sc.length_scale), 0.05)
         f = self._estimate_frame_bucket(weighted)
 
+        # one key for both attempts: the underestimate-retry must produce
+        # identical noise (and so identical audio), matching _infer_batch
+        rng = self._next_rng()
+
         def run_acoustics(bucket: int):
             aco = self._acoustics_fn(b, t, bucket)
             _, _, ns, _ = self._scale_arrays(sc, b)
             args = [self.params, m_p, logs_p, w_ceil, x_mask,
-                    self._next_rng(), ns]
+                    rng, ns]
             if sid is not None:
                 args.append(sid)
             return aco(*args)

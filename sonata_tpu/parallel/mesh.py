@@ -40,6 +40,10 @@ def make_mesh(n_devices: Optional[int] = None, *,
     """
     devs = list(devices if devices is not None else jax.devices())
     if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"requested a {n_devices}-device mesh but only "
+                f"{len(devs)} devices are available")
         devs = devs[:n_devices]
     n = len(devs)
     if n % seq_parallel != 0:

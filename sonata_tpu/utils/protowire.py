@@ -57,6 +57,10 @@ def read_varint(buf, pos: int) -> tuple[int, int]:
 
 
 def write_varint(n: int) -> bytes:
+    # Two's-complement 64-bit mask: negative ints (int64 map keys, enums)
+    # must encode as their 10-byte varint form, and an unmasked negative
+    # Python int never reaches 0 under >>= 7.
+    n &= 0xFFFFFFFFFFFFFFFF
     out = bytearray()
     while True:
         b = n & 0x7F
