@@ -25,6 +25,7 @@ import numpy as np
 
 from ..core import FailedToLoadResource
 from ..utils.protowire import (
+    WIRE_32BIT as _WIRE_32BIT,
     WIRE_LEN as _WIRE_LEN,
     WIRE_VARINT as _WIRE_VARINT,
     WireError,
@@ -108,7 +109,12 @@ def _decode_tensor(buf) -> tuple[str, np.ndarray]:
 
 
 def read_onnx_initializers(path: Union[str, Path]) -> dict[str, np.ndarray]:
-    """Extract ``{initializer name: ndarray}`` from an ONNX file."""
+    """Extract ``{initializer name: ndarray}`` from an ONNX file.
+
+    Initializer-only walk — skips node decoding entirely (a ~100 MB voice
+    file has thousands of nodes the plain weight path never needs); use
+    :func:`read_onnx_graph` when node topology matters.
+    """
     data = Path(path).read_bytes()
     out: dict[str, np.ndarray] = {}
     for field, wire, value in iter_fields(memoryview(data)):
@@ -117,13 +123,125 @@ def read_onnx_initializers(path: Union[str, Path]) -> dict[str, np.ndarray]:
                 if gfield == 5 and gwire == _WIRE_LEN:  # initializer
                     name, arr = _decode_tensor(gvalue)
                     out[name] = arr
-                elif gfield == 1 and gwire == _WIRE_LEN:
-                    # nodes may carry Constant-op tensors; skip (weights for
-                    # VITS live in initializers)
-                    continue
     if not out:
         raise FailedToLoadResource(
             f"{path}: no initializers found (not an ONNX model?)")
+    return out
+
+
+def to_f32(sd: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """Upcast/downcast half/double tensors to float32 (shared by every
+    ONNX import path)."""
+    return {k: v.astype(np.float32) if v.dtype in (np.float16, np.float64)
+            else v for k, v in sd.items()}
+
+
+def _decode_attribute(buf) -> tuple[str, object]:
+    """AttributeProto → (name, value) for the subset importers need.
+
+    Fields (onnx.proto): name=1, f=2, i=3, s=4, t=5, ints=8.
+    """
+    name = ""
+    value: object = None
+    ints: list[int] = []
+
+    def _signed(v: int) -> int:
+        return v - (1 << 64) if v >= (1 << 63) else v
+
+    for field, wire, raw in iter_fields(buf):
+        if field == 1 and wire == _WIRE_LEN:
+            name = bytes(raw).decode("utf-8", errors="replace")
+        elif field == 2 and wire == _WIRE_32BIT:
+            value = struct.unpack("<f", raw)[0]
+        elif field == 3 and wire == _WIRE_VARINT:
+            value = _signed(int(raw))
+        elif field == 4 and wire == _WIRE_LEN:
+            value = bytes(raw).decode("utf-8", errors="replace")
+        elif field == 5 and wire == _WIRE_LEN:
+            value = _decode_tensor(raw)[1]
+        elif field == 8:
+            if wire == _WIRE_VARINT:
+                ints.append(_signed(int(raw)))
+            else:
+                ints.extend(_signed(v) for v in _varints(raw))
+    if ints:
+        value = ints
+    return name, value
+
+
+def _decode_node(buf) -> dict:
+    """NodeProto → {op_type, inputs, outputs, attrs} (fields 1,2,4,5)."""
+    node = {"op_type": "", "inputs": [], "outputs": [], "attrs": {}}
+    for field, wire, raw in iter_fields(buf):
+        if field == 1 and wire == _WIRE_LEN:
+            node["inputs"].append(
+                bytes(raw).decode("utf-8", errors="replace"))
+        elif field == 2 and wire == _WIRE_LEN:
+            node["outputs"].append(
+                bytes(raw).decode("utf-8", errors="replace"))
+        elif field == 4 and wire == _WIRE_LEN:
+            node["op_type"] = bytes(raw).decode("utf-8", errors="replace")
+        elif field == 5 and wire == _WIRE_LEN:
+            k, v = _decode_attribute(raw)
+            node["attrs"][k] = v
+    return node
+
+
+def read_onnx_graph(
+        path: Union[str, Path],
+) -> tuple[dict[str, np.ndarray], list[dict]]:
+    """Extract ``({initializer name: ndarray}, [node dicts])`` from an ONNX
+    file.  Nodes are returned in graph (topological) order; ``Constant``
+    nodes contribute their tensor to the initializer map under their output
+    name — ``torch.onnx.export`` with constant folding emits transformed
+    weights (e.g. recurrent ``W/R/B``) this way.
+    """
+    data = Path(path).read_bytes()
+    inits: dict[str, np.ndarray] = {}
+    nodes: list[dict] = []
+    for field, wire, value in iter_fields(memoryview(data)):
+        if field == 7 and wire == _WIRE_LEN:  # ModelProto.graph
+            for gfield, gwire, gvalue in iter_fields(value):
+                if gfield == 5 and gwire == _WIRE_LEN:  # initializer
+                    name, arr = _decode_tensor(gvalue)
+                    inits[name] = arr
+                elif gfield == 1 and gwire == _WIRE_LEN:  # node
+                    node = _decode_node(gvalue)
+                    nodes.append(node)
+                    if (node["op_type"] == "Constant"
+                            and node["outputs"]
+                            and isinstance(node["attrs"].get("value"),
+                                           np.ndarray)):
+                        inits[node["outputs"][0]] = node["attrs"]["value"]
+    if not inits:
+        raise FailedToLoadResource(
+            f"{path}: no initializers found (not an ONNX model?)")
+    return inits, nodes
+
+
+def resolve_identity_aliases(inits: dict, nodes: list) -> dict:
+    """Materialize tensors routed through ``Identity`` nodes.
+
+    ``torch.onnx.export`` deduplicates value-identical tensors: only one
+    copy becomes an initializer and the other names are produced by
+    ``Identity`` nodes (e.g. a fresh BatchNorm's ``running_mean`` aliasing
+    its zero ``bias``).  Returns ``inits`` extended with one entry per
+    resolvable Identity output.
+    """
+    out = dict(inits)
+    pending = [n for n in nodes if n["op_type"] == "Identity"
+               and n["inputs"] and n["outputs"]]
+    progress = True
+    while pending and progress:
+        progress = False
+        rest = []
+        for n in pending:
+            if n["inputs"][0] in out:
+                out[n["outputs"][0]] = out[n["inputs"][0]]
+                progress = True
+            else:
+                rest.append(n)
+        pending = rest
     return out
 
 
@@ -138,8 +256,6 @@ def import_onnx_weights(path: Union[str, Path], hp: VitsHyperParams, *,
     """
     from .import_torch import state_dict_to_params, strip_prefix
 
-    sd = read_onnx_initializers(path)
-    sd = {k: v.astype(np.float32) if v.dtype in (np.float16, np.float64)
-          else v for k, v in sd.items()}
+    sd = to_f32(read_onnx_initializers(path))
     return state_dict_to_params(strip_prefix(sd), hp, n_vocab=n_vocab,
                                 n_speakers=n_speakers)

@@ -110,8 +110,40 @@ class PiperVoice(BaseModel):
         # Piper convention: "voice.onnx" + "voice.onnx.json", so the config
         # path minus ".json" may itself be the ONNX file (piper/lib.rs:98-108)
         onnx_path = stem if stem.suffix == ".onnx" else stem.with_suffix(".onnx")
-        if stem.with_suffix(".npz").exists():
+        # streaming ("rt") voice directories split the exported graph into
+        # encoder.onnx + decoder.onnx siblings of the config
+        # (piper/src/lib.rs:90-96).  The two initializer sets partition the
+        # same VITS weights; merged, they feed the one staged model — the
+        # split *runtime* is superfluous here because the serving path is
+        # already staged into encode/acoustics/decode executables.
+        enc_path = Path(config_path).with_name("encoder.onnx")
+        dec_path = Path(config_path).with_name("decoder.onnx")
+        if stem.with_suffix(".npz").exists():  # native format stays first
             params = load_params(stem.with_suffix(".npz"))
+        elif config.streaming and enc_path.exists() and dec_path.exists():
+            try:
+                from .import_onnx import read_onnx_initializers, to_f32
+                from .import_torch import state_dict_to_params, strip_prefix
+            except ImportError as e:
+                raise FailedToLoadResource(
+                    f"ONNX weight import unavailable: {e}") from e
+            merged = read_onnx_initializers(enc_path)
+            for name, arr in read_onnx_initializers(dec_path).items():
+                prev = merged.get(name)
+                # anonymous scope-generated names ("/Constant_output_0",
+                # "onnx::MatMul_12") legitimately collide across two
+                # independent exports; only real parameter names must agree
+                anonymous = name.startswith("/") or "::" in name
+                if (prev is not None and not anonymous
+                        and (prev.shape != arr.shape
+                             or not np.array_equal(prev, arr))):
+                    raise FailedToLoadResource(
+                        f"streaming voice: initializer {name!r} differs "
+                        "between encoder.onnx and decoder.onnx")
+                merged[name] = arr
+            params = state_dict_to_params(
+                strip_prefix(to_f32(merged)), config.hyper, n_vocab=n_vocab,
+                n_speakers=config.num_speakers)
         elif onnx_path.exists():
             try:
                 from .import_onnx import import_onnx_weights

@@ -1,0 +1,569 @@
+"""CBHG tashkeel tagger: the architecture family of libtashkeel's bundled
+ONNX model, natively in JAX.
+
+The reference auto-creates a libtashkeel inference engine whenever a voice's
+eSpeak language is ``ar`` (``crates/sonata/models/piper/src/lib.rs:63-77,
+270-281,321-333``); libtashkeel_core (patched submodule, ``cargo.toml:18-19``)
+runs its bundled ONNX sequence tagger — a CBHG-style model (character
+embedding → conv bank → max-pool → conv projections → residual → highway
+stack → bidirectional GRU → linear classifier) from the Arabic
+diacritization literature.  That submodule is not checked out in this
+environment, so this module reconstructs the architecture and validates the
+weight import against genuine ``torch.onnx.export`` artifacts of a faithful
+torch mirror (``tests/test_tashkeel_cbhg.py``) rather than the bundled file.
+
+Import is *shape-driven*: bank size K, projection widths, highway depth,
+GRU units, and the post-CBHG recurrent stack are all inferred from the
+weights present, so config variants of the same family load without a
+sidecar config.  BatchNorm (inference mode) is folded into the preceding
+conv at import time — one less elementwise pass over HBM per layer.
+
+TPU notes: convs run in NTC layout (MXU matmuls); the GRU/LSTM input
+projections are hoisted out of ``lax.scan`` so the big matmuls batch over
+time; the reverse direction reuses the forward scan on an index-gathered
+flip of the valid region (static shapes, no ragged control flow).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core import FailedToLoadResource
+from ..utils.buckets import bucket_for, pad_to
+
+
+# ---------------------------------------------------------------------------
+# forward pass
+# ---------------------------------------------------------------------------
+
+def _conv_ntc(x, w, b, pad_left: int, pad_right: int):
+    """Conv1d, ``x: [B, T, Cin]``, ``w: [K, Cin, Cout]`` → same-length out."""
+    y = lax.conv_general_dilated(
+        x, w, window_strides=(1,), padding=[(pad_left, pad_right)],
+        dimension_numbers=("NHC", "HIO", "NHC"))
+    return y + b
+
+
+def _torch_same_pad(k: int) -> tuple[int, int]:
+    """torch Conv1d(padding=k//2) then trim-to-T ≡ pad (k//2, (k-1)//2)."""
+    return k // 2, (k - 1) // 2
+
+
+def _gru_scan(x_proj, w_hh, b_hh, h0):
+    """Scan a GRU over time.  ``x_proj: [B, T, 3H]`` already includes
+    ``x @ W_ih^T + b_ih`` (hoisted out of the scan → one big MXU matmul).
+
+    torch gate order (r, z, n); ``n`` uses linear-before-reset semantics:
+    ``n = tanh(x_n + r * (h @ W_hn^T + b_hn))``.
+    """
+    H = w_hh.shape[1] // 3
+
+    def cell(h, xp):
+        hp = h @ w_hh + b_hh  # [B, 3H]
+        r = jax.nn.sigmoid(xp[:, :H] + hp[:, :H])
+        z = jax.nn.sigmoid(xp[:, H:2 * H] + hp[:, H:2 * H])
+        n = jnp.tanh(xp[:, 2 * H:] + r * hp[:, 2 * H:])
+        h = (1.0 - z) * n + z * h
+        return h, h
+
+    _, ys = lax.scan(cell, h0, jnp.swapaxes(x_proj, 0, 1))
+    return jnp.swapaxes(ys, 0, 1)  # [B, T, H]
+
+
+def _lstm_scan(x_proj, w_hh, b_hh, h0, c0):
+    """torch LSTM gate order (i, f, g, o)."""
+    H = w_hh.shape[1] // 4
+
+    def cell(carry, xp):
+        h, c = carry
+        g = xp + h @ w_hh + b_hh
+        i = jax.nn.sigmoid(g[:, :H])
+        f = jax.nn.sigmoid(g[:, H:2 * H])
+        gg = jnp.tanh(g[:, 2 * H:3 * H])
+        o = jax.nn.sigmoid(g[:, 3 * H:])
+        c = f * c + i * gg
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    _, ys = lax.scan(cell, (h0, c0), jnp.swapaxes(x_proj, 0, 1))
+    return jnp.swapaxes(ys, 0, 1)
+
+
+def _flip_valid(x, lengths):
+    """Reverse each row's first ``lengths[b]`` steps; tail is zeroed.
+
+    Maps position ``t`` → ``L-1-t`` for ``t < L``.  Applying it twice
+    restores the original order, so the same gather aligns the reverse
+    scan's outputs back to forward positions.
+    """
+    B, T = x.shape[0], x.shape[1]
+    t = jnp.arange(T)[None, :]
+    L = lengths[:, None]
+    idx = jnp.where(t < L, L - 1 - t, 0)
+    flipped = jnp.take_along_axis(x, idx[:, :, None], axis=1)
+    return flipped * (t < L)[:, :, None]
+
+
+def _bidi(x, lengths, direction_params, scan_one):
+    """Run fwd+bwd recurrences and concat features."""
+    B = x.shape[0]
+    outs = []
+    for tag in ("fwd", "bwd"):
+        p = direction_params[tag]
+        xi = x if tag == "fwd" else _flip_valid(x, lengths)
+        x_proj = xi @ p["w_ih"] + p["b_ih"]
+        o = scan_one(x_proj, p, B)
+        if tag == "bwd":
+            o = _flip_valid(o, lengths)
+        outs.append(o)
+    return jnp.concatenate(outs, axis=-1)
+
+
+def apply_cbhg(params: dict, ids, lengths):
+    """``ids [B, T]`` int32 → diacritic logits ``[B, T, n_targets]``.
+
+    Matches torch inference on the exact-length sequence: every conv input
+    is masked to zero beyond ``lengths`` so boundary windows see the same
+    zero padding torch sees at its true sequence end.
+    """
+    B, T = ids.shape
+    t = jnp.arange(T)[None, :]
+    mask = (t < lengths[:, None])[:, :, None].astype(jnp.float32)
+
+    emb = params["embedding"][ids] * mask  # [B, T, E]
+    x = emb
+    if params.get("prenet"):
+        for lin in params["prenet"]:
+            x = jax.nn.relu(x @ lin["w"] + lin["b"]) * mask
+
+    # conv bank: kernel sizes 1..K, BN pre-folded into w/b
+    bank = []
+    for i, c in enumerate(params["bank"]):
+        pl, pr = _torch_same_pad(i + 1)
+        bank.append(jax.nn.relu(_conv_ntc(x, c["w"], c["b"], pl, pr)))
+    y = jnp.concatenate(bank, axis=-1) * mask
+
+    # max-pool k=2 stride=1 (left pad = -inf ⇒ out[t] = max(y[t-1], y[t]))
+    prev = jnp.pad(y[:, :-1], ((0, 0), (1, 0), (0, 0)),
+                   constant_values=-jnp.inf)
+    y = jnp.maximum(y, prev) * mask
+
+    # conv projections (ReLU on all but the last), BN folded
+    for i, c in enumerate(params["projs"]):
+        pl, pr = _torch_same_pad(c["w"].shape[0])
+        y = _conv_ntc(y, c["w"], c["b"], pl, pr)
+        if i + 1 < len(params["projs"]):
+            y = jax.nn.relu(y)
+        y = y * mask
+
+    if params.get("pre_highway") is not None:
+        y = y @ params["pre_highway"]["w"]
+    y = (y + x) * mask  # residual onto the (pre-)bank input
+
+    for hw in params["highways"]:
+        h = jax.nn.relu(y @ hw["H"]["w"] + hw["H"]["b"])
+        tgate = jax.nn.sigmoid(y @ hw["T"]["w"] + hw["T"]["b"])
+        y = (h * tgate + y * (1.0 - tgate)) * mask
+
+    H = params["gru"]["fwd"]["w_hh"].shape[0]
+    y = _bidi(y, lengths, params["gru"],
+              lambda xp, p, b: _gru_scan(
+                  xp, p["w_hh"], p["b_hh"], jnp.zeros((b, H)))) * mask
+
+    for layer in params["post"]:
+        Hl = layer["fwd"]["w_hh"].shape[0]
+        y = _bidi(y, lengths, layer,
+                  lambda xp, p, b: _lstm_scan(
+                      xp, p["w_hh"], p["b_hh"], jnp.zeros((b, Hl)),
+                      jnp.zeros((b, Hl)))) * mask
+
+    logits = y @ params["out"]["w"] + params["out"]["b"]
+    return logits * mask
+
+
+# ---------------------------------------------------------------------------
+# weight import (state-dict names → pytree), BN folding
+# ---------------------------------------------------------------------------
+
+_BN_EPS = 1e-5
+
+
+def _fold_bn(w_oik: np.ndarray, bias: Optional[np.ndarray],
+             gamma, beta, mean, var) -> tuple[np.ndarray, np.ndarray]:
+    """Fold inference-mode BatchNorm into the preceding conv.
+
+    ``w_oik`` is torch layout ``[Cout, Cin, K]``; returns NTC layout
+    ``[K, Cin, Cout]`` plus a folded bias.
+    """
+    scale = gamma / np.sqrt(var + _BN_EPS)  # [Cout]
+    w = w_oik * scale[:, None, None]
+    b = (bias if bias is not None else 0.0) * scale + beta - mean * scale
+    return np.transpose(w, (2, 1, 0)).astype(np.float32), b.astype(np.float32)
+
+
+def _linear(sd, name) -> dict:
+    w = sd[f"{name}.weight"]
+    out = {"w": np.ascontiguousarray(w.T).astype(np.float32)}
+    if f"{name}.bias" in sd:
+        out["b"] = sd[f"{name}.bias"].astype(np.float32)
+    else:
+        out["b"] = np.zeros(w.shape[0], np.float32)
+    return out
+
+
+def _rnn_direction(sd, prefix: str, suffix: str) -> dict:
+    try:
+        w_ih = sd[f"{prefix}.weight_ih_l0{suffix}"].astype(np.float32)
+        w_hh = sd[f"{prefix}.weight_hh_l0{suffix}"].astype(np.float32)
+    except KeyError as e:
+        raise FailedToLoadResource(
+            f"tashkeel CBHG import: missing recurrent weights "
+            f"{prefix}.*_l0{suffix} — unidirectional exports are not part "
+            "of the CBHG family (its recurrences are bidirectional)") from e
+    b_ih = sd.get(f"{prefix}.bias_ih_l0{suffix}")
+    b_hh = sd.get(f"{prefix}.bias_hh_l0{suffix}")
+    G = w_ih.shape[0]
+    return {
+        "w_ih": np.ascontiguousarray(w_ih.T),
+        "w_hh": np.ascontiguousarray(w_hh.T),
+        "b_ih": (b_ih if b_ih is not None else np.zeros(G)).astype(
+            np.float32),
+        "b_hh": (b_hh if b_hh is not None else np.zeros(G)).astype(
+            np.float32),
+    }
+
+
+def _strip_wrappers(sd: dict) -> dict:
+    """Drop common wrapper prefixes (``model.``, ``cbhg_model.``,
+    ``module.``) when every key carries the same one."""
+    for prefix in ("model.", "cbhg_model.", "module."):
+        if sd and all(k.startswith(prefix) for k in sd):
+            sd = {k[len(prefix):]: v for k, v in sd.items()}
+    return sd
+
+
+def state_dict_to_cbhg(sd: dict) -> dict:
+    """Map a torch CBHG state dict (or ONNX initializers preserving those
+    names) onto the :func:`apply_cbhg` pytree.  Hyperparameters are inferred
+    from the keys/shapes present."""
+    sd = _strip_wrappers({k: np.asarray(v) for k, v in sd.items()})
+    if "embedding.weight" not in sd:
+        raise FailedToLoadResource(
+            "tashkeel CBHG import: no 'embedding.weight' initializer "
+            f"(found {sorted(sd)[:8]}…)")
+    params: dict = {"embedding": sd["embedding.weight"].astype(np.float32)}
+
+    # optional prenet: prenet.layers.{i}.weight or prenet.fc{i}.weight
+    prenet = []
+    for i in range(8):
+        for cand in (f"prenet.layers.{i}", f"prenet.fc{i + 1}"):
+            if f"{cand}.weight" in sd:
+                prenet.append(_linear(sd, cand))
+                break
+    params["prenet"] = prenet
+
+    def conv_block(base: str) -> Optional[dict]:
+        for conv_name in (f"{base}.conv1d", f"{base}.conv", base):
+            if f"{conv_name}.weight" in sd:
+                break
+        else:
+            return None
+        w = sd[f"{conv_name}.weight"].astype(np.float32)
+        bias = sd.get(f"{conv_name}.bias")
+        for bn_name in (f"{base}.bn", f"{base}.batch_norm"):
+            if f"{bn_name}.weight" in sd:
+                wf, bf = _fold_bn(
+                    w, bias, sd[f"{bn_name}.weight"].astype(np.float32),
+                    sd[f"{bn_name}.bias"].astype(np.float32),
+                    sd[f"{bn_name}.running_mean"].astype(np.float32),
+                    sd[f"{bn_name}.running_var"].astype(np.float32))
+                return {"w": wf, "b": bf}
+        b = (bias if bias is not None else np.zeros(w.shape[0])).astype(
+            np.float32)
+        return {"w": np.transpose(w, (2, 1, 0)).copy(), "b": b}
+
+    bank = []
+    for i in range(64):
+        blk = conv_block(f"cbhg.conv1d_banks.{i}")
+        if blk is None:
+            break
+        bank.append(blk)
+    if not bank:
+        raise FailedToLoadResource(
+            "tashkeel CBHG import: no conv bank (cbhg.conv1d_banks.*)")
+    params["bank"] = bank
+
+    projs = []
+    for i in range(16):
+        blk = conv_block(f"cbhg.conv1d_projections.{i}")
+        if blk is None:
+            break
+        projs.append(blk)
+    params["projs"] = projs
+
+    if "cbhg.pre_highway.weight" in sd:
+        w = sd["cbhg.pre_highway.weight"].astype(np.float32)
+        params["pre_highway"] = {"w": np.ascontiguousarray(w.T)}
+    else:
+        params["pre_highway"] = None
+
+    highways = []
+    for i in range(16):
+        if f"cbhg.highways.{i}.H.weight" not in sd:
+            break
+        highways.append({"H": _linear(sd, f"cbhg.highways.{i}.H"),
+                         "T": _linear(sd, f"cbhg.highways.{i}.T")})
+    params["highways"] = highways
+
+    params["gru"] = {"fwd": _rnn_direction(sd, "cbhg.gru", ""),
+                     "bwd": _rnn_direction(sd, "cbhg.gru", "_reverse")}
+
+    # post-CBHG recurrent stack: any other '<name>.weight_ih_l0' keys,
+    # in sorted order (covers post_cbhg.{i}./lstm./layers.{i}. variants)
+    post = []
+    seen = set()
+    for key in sorted(sd):
+        m = re.match(r"(.+)\.weight_ih_l0$", key)
+        if not m or m.group(1) == "cbhg.gru" or m.group(1) in seen:
+            continue
+        seen.add(m.group(1))
+        post.append({"fwd": _rnn_direction(sd, m.group(1), ""),
+                     "bwd": _rnn_direction(sd, m.group(1), "_reverse")})
+    params["post"] = post
+
+    for out_name in ("projections", "fc", "out", "classifier"):
+        if f"{out_name}.weight" in sd:
+            params["out"] = _linear(sd, out_name)
+            break
+    else:
+        raise FailedToLoadResource(
+            "tashkeel CBHG import: no output projection "
+            "(projections/fc/out/classifier)")
+    return jax.tree_util.tree_map(jnp.asarray, params)
+
+
+# ---------------------------------------------------------------------------
+# ONNX import, including recurrent weights folded into GRU/LSTM nodes
+# ---------------------------------------------------------------------------
+
+def _rnn_keys_from_nodes(inits: dict, nodes: list) -> dict:
+    """Recover torch-style recurrent weight entries from ONNX GRU/LSTM
+    *nodes* when ``torch.onnx.export`` constant folding replaced the named
+    parameter initializers with anonymous reordered constants.
+
+    ONNX gate orders: GRU ``(z, r, h)`` vs torch ``(r, z, n)``; LSTM
+    ``(i, o, f, c)`` vs torch ``(i, f, g, o)``.
+    """
+    out: dict = {}
+    n_lstm = 0
+    for node in nodes:
+        op = node["op_type"]
+        if op not in ("GRU", "LSTM"):
+            continue
+        ins = node["inputs"]
+        if len(ins) < 3 or ins[1] not in inits or ins[2] not in inits:
+            continue
+        W, R = np.asarray(inits[ins[1]]), np.asarray(inits[ins[2]])
+        B = (np.asarray(inits[ins[3]])
+             if len(ins) > 3 and ins[3] in inits else None)
+        n_gates = 3 if op == "GRU" else 4
+        H = W.shape[1] // n_gates
+        if op == "GRU":
+            if node["attrs"].get("linear_before_reset", 0) == 0:
+                raise FailedToLoadResource(
+                    "tashkeel CBHG import: GRU node without "
+                    "linear_before_reset — not a torch export; unsupported")
+            reorder = np.r_[H:2 * H, 0:H, 2 * H:3 * H]  # (z,r,h) → (r,z,n)
+            prefix = "cbhg.gru"
+        else:
+            # (i,o,f,c) → (i,f,g,o)
+            reorder = np.r_[0:H, 2 * H:3 * H, 3 * H:4 * H, H:2 * H]
+            prefix = f"post_rnn.{n_lstm}"
+            n_lstm += 1
+        dirs = [""]
+        if node["attrs"].get("direction") == "bidirectional" or W.shape[0] == 2:
+            dirs = ["", "_reverse"]
+        for d, suffix in enumerate(dirs):
+            out[f"{prefix}.weight_ih_l0{suffix}"] = W[d][reorder]
+            out[f"{prefix}.weight_hh_l0{suffix}"] = R[d][reorder]
+            if B is not None:
+                nb = n_gates * H
+                out[f"{prefix}.bias_ih_l0{suffix}"] = B[d][:nb][reorder]
+                out[f"{prefix}.bias_hh_l0{suffix}"] = B[d][nb:][reorder]
+    return out
+
+
+def _folded_linears_from_nodes(inits: dict, nodes: list) -> dict:
+    """Recover ``<base>.weight`` for Linear layers whose weights were
+    constant-folded into anonymous ``onnx::MatMul_*`` tensors.
+
+    The bias initializer keeps its name, so a ``MatMul(x, W) → Add(bias)``
+    (or fused ``Gemm``) pair identifies the layer: the anonymous ``W`` is
+    the torch weight pre-transposed to ``[in, out]``.
+    """
+    out: dict = {}
+    produced_by = {o: n for n in nodes for o in n["outputs"]}
+    for n in nodes:
+        if n["op_type"] == "Gemm" and len(n["inputs"]) >= 3:
+            w_name, b_name = n["inputs"][1], n["inputs"][2]
+            if (b_name in inits and b_name.endswith(".bias")
+                    and w_name in inits and not b_name.startswith("onnx::")):
+                w = np.asarray(inits[w_name])
+                if not n["attrs"].get("transB", 0):
+                    w = w.T  # → torch [out, in]
+                out[b_name[:-5] + ".weight"] = w
+            continue
+        if n["op_type"] != "Add" or len(n["inputs"]) != 2:
+            continue
+        bias_name = next(
+            (i for i in n["inputs"]
+             if i in inits and i.endswith(".bias")
+             and not i.startswith("onnx::")), None)
+        if bias_name is None:
+            continue
+        other = (n["inputs"][1] if n["inputs"][0] == bias_name
+                 else n["inputs"][0])
+        mm = produced_by.get(other)
+        if mm is None or mm["op_type"] != "MatMul" or len(mm["inputs"]) != 2:
+            continue
+        w_name = mm["inputs"][1]
+        if w_name in inits and w_name not in (bias_name,):
+            w = np.asarray(inits[w_name])
+            if w.ndim == 2:
+                out[bias_name[:-5] + ".weight"] = np.ascontiguousarray(w.T)
+    return out
+
+
+def cbhg_from_onnx(path) -> dict:
+    """Load CBHG params from an ONNX export (name-preserving or
+    constant-folded)."""
+    from .import_onnx import read_onnx_graph, resolve_identity_aliases
+
+    inits, nodes = read_onnx_graph(path)
+    inits = resolve_identity_aliases(inits, nodes)
+    sd = {k: v for k, v in inits.items()}
+    stripped = _strip_wrappers(dict(sd))
+    if not any(k.endswith("gru.weight_ih_l0") for k in stripped):
+        sd.update(_rnn_keys_from_nodes(inits, nodes))
+    for name, w in _folded_linears_from_nodes(inits, nodes).items():
+        sd.setdefault(name, w)
+    # bias-less pre_highway can't be recovered via its bias; when the
+    # projection width differs from the embedding width one is required —
+    # match the unique anonymous [proj_out, emb] MatMul weight
+    if "cbhg.pre_highway.weight" not in sd and "embedding.weight" in sd:
+        emb_dim = int(np.asarray(sd["embedding.weight"]).shape[1])
+        last_proj = None
+        for i in range(16):
+            key = f"cbhg.conv1d_projections.{i}.conv1d.weight"
+            if key in sd:
+                last_proj = int(np.asarray(sd[key]).shape[0])
+        if last_proj is not None and last_proj != emb_dim:
+            cands = {
+                n["inputs"][1]
+                for n in nodes
+                if n["op_type"] == "MatMul" and len(n["inputs"]) == 2
+                and n["inputs"][1] in inits
+                and np.asarray(inits[n["inputs"][1]]).shape
+                == (last_proj, emb_dim)}
+            if len(cands) == 1:
+                w = np.asarray(inits[cands.pop()])
+                sd["cbhg.pre_highway.weight"] = np.ascontiguousarray(w.T)
+    from .import_onnx import to_f32
+
+    return state_dict_to_cbhg(to_f32(sd))
+
+
+# ---------------------------------------------------------------------------
+# inference wrapper
+# ---------------------------------------------------------------------------
+
+class TashkeelCBHGModel:
+    """Diacritization wrapper over :func:`apply_cbhg`.
+
+    Character/target id maps default to the package's Arabic vocab and
+    diacritic class list; a real artifact's own maps load from a JSON
+    sidecar ``<model>.json`` with ``input_id_map`` (char → id) and
+    ``target_id_map`` (diacritic string → id) — the same maps libtashkeel
+    keeps as JSON resources beside its model.  Long inputs are chunked at
+    ``max_len`` on whitespace (libtashkeel caps input length the same way).
+    """
+
+    def __init__(self, params: dict, *,
+                 input_id_map: Optional[dict] = None,
+                 target_id_map: Optional[dict] = None,
+                 max_len: int = 315):
+        from .tashkeel import DIACRITICS, _DEFAULT_VOCAB
+
+        self.params = params
+        self._char_to_id = (dict(input_id_map) if input_id_map else
+                            {c: i + 1 for i, c in enumerate(_DEFAULT_VOCAB)})
+        tmap = (dict(target_id_map) if target_id_map else
+                {d: i for i, d in enumerate(DIACRITICS)})
+        n_targets = int(np.asarray(params["out"]["b"]).shape[0])
+        self._id_to_target = [""] * n_targets
+        for diac, i in tmap.items():
+            if 0 <= int(i) < n_targets:
+                self._id_to_target[int(i)] = diac
+        self.max_len = max_len
+        self._apply = jax.jit(apply_cbhg)
+
+    @classmethod
+    def from_path(cls, path) -> "TashkeelCBHGModel":
+        path = Path(path)
+        params = cbhg_from_onnx(path)
+        meta = {}
+        sidecar = path.with_suffix(".json")
+        if sidecar.exists():
+            try:
+                meta = json.loads(sidecar.read_text(encoding="utf-8"))
+            except (OSError, ValueError) as e:
+                raise FailedToLoadResource(
+                    f"bad tashkeel sidecar {sidecar}: {e}") from e
+        return cls(params,
+                   input_id_map=meta.get("input_id_map"),
+                   target_id_map=meta.get("target_id_map"),
+                   max_len=int(meta.get("max_len", 315)))
+
+    def _tag_chunk(self, base: str) -> str:
+        ids = [self._char_to_id.get(ch, 0) for ch in base]
+        t = bucket_for(len(ids))  # jit re-traces per bucket width only
+        ids_arr = jnp.asarray([pad_to(ids, t)], dtype=jnp.int32)
+        lengths = jnp.asarray([len(ids)], dtype=jnp.int32)
+        logits = self._apply(self.params, ids_arr, lengths)
+        classes = np.asarray(jnp.argmax(logits, axis=-1))[0, :len(ids)]
+        out = []
+        for ch, cls in zip(base, classes):
+            out.append(ch)
+            if "ء" <= ch <= "ي":  # only Arabic letters take diacritics
+                out.append(self._id_to_target[int(cls)])
+        return "".join(out)
+
+    def diacritize(self, text: str) -> str:
+        from .tashkeel import strip_diacritics
+
+        base = strip_diacritics(text)
+        if not base.strip():
+            return text
+        if len(base) <= self.max_len:
+            return self._tag_chunk(base)
+        # chunk on whitespace near max_len; hard-split a pathological
+        # single token
+        chunks, start = [], 0
+        while start < len(base):
+            end = min(start + self.max_len, len(base))
+            if end < len(base):
+                cut = base.rfind(" ", start, end)
+                if cut > start:
+                    end = cut + 1
+            chunks.append(base[start:end])
+            start = end
+        return "".join(self._tag_chunk(c) if c.strip() else c
+                       for c in chunks)

@@ -24,13 +24,20 @@ class TashkeelEngine:
         self._lock = threading.Lock()
         if model_path is not None:
             try:
-                from ..models.tashkeel import TashkeelModel
+                if str(model_path).endswith((".onnx", ".ort")):
+                    # libtashkeel-family CBHG artifact (ONNX export)
+                    from ..models.tashkeel_cbhg import TashkeelCBHGModel
+
+                    self._model = TashkeelCBHGModel.from_path(model_path)
+                else:
+                    from ..models.tashkeel import TashkeelModel
+
+                    self._model = TashkeelModel.from_path(model_path)
             except ImportError as e:
                 from ..core import FailedToLoadResource
 
                 raise FailedToLoadResource(
                     f"tashkeel model support unavailable: {e}") from e
-            self._model = TashkeelModel.from_path(model_path)
 
     @property
     def has_model(self) -> bool:
@@ -49,10 +56,18 @@ _GLOBAL_LOCK = threading.Lock()
 
 def get_default_engine() -> TashkeelEngine:
     """Lazy module-global engine (parity: the Python frontend's lazy global
-    tashkeel instance, ``crates/frontends/python/src/lib.rs:17-18``)."""
+    tashkeel instance, ``crates/frontends/python/src/lib.rs:17-18``).
+
+    ``SONATA_TASHKEEL_MODEL`` names the model artifact (`.onnx` CBHG export
+    or `.npz` native tagger) — the counterpart of libtashkeel's bundled
+    model, which cannot ship here.  Unset ⇒ identity engine.
+    """
     global _GLOBAL
     if _GLOBAL is None:
         with _GLOBAL_LOCK:
             if _GLOBAL is None:
-                _GLOBAL = TashkeelEngine()
+                import os
+
+                _GLOBAL = TashkeelEngine(
+                    os.environ.get("SONATA_TASHKEEL_MODEL") or None)
     return _GLOBAL

@@ -172,3 +172,62 @@ def test_read_onnx_rejects_garbage(tmp_path):
     p.write_bytes(b"\x00\x01\x02garbage")
     with pytest.raises(FailedToLoadResource):
         read_onnx_initializers(p)
+
+
+# ---------------------------------------------------------------------------
+# streaming ("rt") voice layout: encoder.onnx + decoder.onnx siblings
+# (reference loads these when config.streaming, piper/src/lib.rs:90-96)
+# ---------------------------------------------------------------------------
+
+def _write_streaming_voice(tmp_path, seed=11):
+    import json
+
+    from voices import TINY_MODEL
+
+    v = tiny_voice(seed=seed)
+    sd = params_to_state_dict(v.params, v.hp)
+    sd = {k: np.ascontiguousarray(x, dtype=np.float32) for k, x in sd.items()}
+    dec = {k: x for k, x in sd.items() if k.startswith("dec.")}
+    enc = {k: x for k, x in sd.items() if not k.startswith("dec.")}
+    assert dec and enc  # the split actually partitions
+    (tmp_path / "encoder.onnx").write_bytes(_onnx_bytes(enc))
+    (tmp_path / "decoder.onnx").write_bytes(_onnx_bytes(dec))
+    cfg = {
+        "audio": {"sample_rate": 16000, "quality": None},
+        "model": dict(TINY_MODEL),
+        "num_speakers": 1,
+        "espeak": {"voice": "en-us"},
+        "phoneme_id_map": {k: list(ids) for k, ids in
+                           v.config.phoneme_id_map.items()},
+        "num_symbols": v.config.num_symbols,
+        "streaming": True,
+    }
+    cfg_path = tmp_path / "voice.json"
+    cfg_path.write_text(json.dumps(cfg), encoding="utf-8")
+    return v, cfg_path
+
+
+def test_streaming_voice_layout_loads_and_streams(tmp_path):
+    v, cfg_path = _write_streaming_voice(tmp_path)
+    loaded = PiperVoice.from_config_path(cfg_path)
+    _assert_params_equal(v.params, loaded.params)
+    assert loaded.config.streaming
+    chunks = list(loaded.stream_synthesis("tɛst wʌn tuː.", 12, 2))
+    assert chunks and all(len(c.samples) > 0 for c in chunks)
+
+
+def test_streaming_voice_layout_rejects_conflicting_weights(tmp_path):
+    from sonata_tpu.core import FailedToLoadResource
+
+    v, cfg_path = _write_streaming_voice(tmp_path)
+    # corrupt: decoder carries a same-named tensor with different values
+    sd = params_to_state_dict(v.params, v.hp)
+    enc_keys = [k for k in sd if not k.startswith("dec.")]
+    clash = {enc_keys[0]:
+             np.ascontiguousarray(sd[enc_keys[0]] + 1.0, dtype=np.float32)}
+    dec = {k: np.ascontiguousarray(x, dtype=np.float32)
+           for k, x in sd.items() if k.startswith("dec.")}
+    dec.update(clash)
+    (tmp_path / "decoder.onnx").write_bytes(_onnx_bytes(dec))
+    with pytest.raises(FailedToLoadResource):
+        PiperVoice.from_config_path(cfg_path)
