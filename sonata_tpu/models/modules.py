@@ -237,6 +237,66 @@ def transformer(x, mask, p, *, n_heads, window):
     return x * mask
 
 
+def transformer_seq_parallel(x, mask, p, *, n_heads, window, mesh):
+    """The same post-norm encoder stack, SPMD over the mesh's ``seq`` axis.
+
+    Long inputs shard along time: attention runs as a ring
+    (:func:`sonata_tpu.parallel.ring.ring_rel_attention_sharded`, exact —
+    including the windowed relative embeddings, which only couple
+    ring-adjacent blocks since |s−t| ≤ window), and the FFN's kernel-3
+    convs see their neighbors' boundary columns via a halo exchange.  All
+    other ops are per-position and stay local.  Numerics match
+    :func:`transformer` (same math, blockwise softmax).
+
+    ``x: [B, T, C]`` with ``T`` divisible by the seq-axis size.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS, SEQ_AXIS
+    from ..parallel.ring import halo_exchange, ring_rel_attention_sharded
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    def attn_local(x_loc, mask_loc, lp):
+        b, t, c = x_loc.shape
+        head = c // n_heads
+
+        def split(u):  # [B, T, C] → [B, H, T, head]
+            return u.reshape(b, t, n_heads, head).transpose(0, 2, 1, 3)
+
+        out = ring_rel_attention_sharded(
+            split(conv1d(x_loc, lp["q"])),
+            split(conv1d(x_loc, lp["k"])),
+            split(conv1d(x_loc, lp["v"])),
+            mask_loc[..., 0],
+            lp["emb_rel_k"][0], lp["emb_rel_v"][0], window=window)
+        out = out.transpose(0, 2, 1, 3).reshape(b, t, c)
+        return conv1d(out, lp["o"]) * mask_loc
+
+    def conv_halo(x_loc, cp):
+        k = cp["w"].shape[0]
+        ext = halo_exchange(x_loc, k // 2, k - 1 - k // 2)
+        return conv1d(ext, cp, padding=0)
+
+    def inner(x_loc, mask_loc, params):
+        x_loc = x_loc * mask_loc
+        for layer in params["layers"]:
+            y = attn_local(x_loc, mask_loc, layer["attn"])
+            x_loc = layer_norm(x_loc + y, layer["ln1"])
+            y = conv_halo(x_loc * mask_loc, layer["ffn"]["c1"])
+            y = jax.nn.relu(y)
+            y = conv_halo(y * mask_loc, layer["ffn"]["c2"]) * mask_loc
+            x_loc = layer_norm(x_loc + y, layer["ln2"])
+        return x_loc * mask_loc
+
+    spec_x = P(DATA_AXIS, SEQ_AXIS, None)
+    fn = shard_map(inner, mesh=mesh, in_specs=(spec_x, spec_x, P()),
+                   out_specs=spec_x)
+    return fn(x, mask, p)
+
+
 # ---------------------------------------------------------------------------
 # WaveNet block (used by the coupling flow)
 # ---------------------------------------------------------------------------

@@ -398,17 +398,19 @@ class PiperVoice(BaseModel):
             if fn is None:
                 hp = self.hp
 
+                mesh = self.mesh  # seq>1 ⇒ ring-attention text encoder
+
                 if self.multi_speaker:
                     def run(params, ids, lens, rng, noise_w, length_scale, sid):
                         m_p, logs_p, w_ceil, x_mask, _ = vits.encode_text(
                             params, hp, ids, lens, rng, noise_w=noise_w,
-                            length_scale=length_scale, sid=sid)
+                            length_scale=length_scale, sid=sid, mesh=mesh)
                         return m_p, logs_p, w_ceil, x_mask
                 else:
                     def run(params, ids, lens, rng, noise_w, length_scale):
                         m_p, logs_p, w_ceil, x_mask, _ = vits.encode_text(
                             params, hp, ids, lens, rng, noise_w=noise_w,
-                            length_scale=length_scale)
+                            length_scale=length_scale, mesh=mesh)
                         return m_p, logs_p, w_ceil, x_mask
 
                 batch = ((1, 2, 4, 5, 6) if self.multi_speaker
@@ -492,12 +494,14 @@ class PiperVoice(BaseModel):
                 hp = self.hp
                 max_frames = f
 
+                mesh = self.mesh  # seq>1 ⇒ ring-attention text encoder
+
                 def body(params, ids, lens, rng, noise_w, length_scale,
                          noise_scale, sid):
                     rng_dur, rng_noise = jax.random.split(rng)
                     m_p, logs_p, w_ceil, x_mask, g = vits.encode_text(
                         params, hp, ids, lens, rng_dur, noise_w=noise_w,
-                        length_scale=length_scale, sid=sid)
+                        length_scale=length_scale, sid=sid, mesh=mesh)
                     frames_needed = jnp.sum(w_ceil, axis=1).astype(jnp.int32)
                     z, y_mask, y_lengths = vits.acoustics(
                         params, hp, m_p, logs_p, w_ceil, x_mask, rng_noise,

@@ -156,10 +156,18 @@ def sequence_mask(lengths, max_len: int):
     return (idx < lengths[:, None]).astype(jnp.float32)[..., None]
 
 
-def text_encoder(p: Params, hp: VitsHyperParams, ids, x_mask):
+def text_encoder(p: Params, hp: VitsHyperParams, ids, x_mask, mesh=None):
     x = p["emb"][ids] * math.sqrt(hp.hidden_channels)  # [B, T, H]
-    x = m.transformer(x, x_mask, p["encoder"], n_heads=hp.n_heads,
-                      window=hp.attn_window)
+    seq = 0 if mesh is None else mesh.shape.get("seq", 1)
+    if mesh is not None and seq > 1 and x.shape[1] % seq == 0:
+        # sequence parallelism: ring attention + halo convs over the
+        # mesh's seq axis (long inputs shard along time)
+        x = m.transformer_seq_parallel(x, x_mask, p["encoder"],
+                                       n_heads=hp.n_heads,
+                                       window=hp.attn_window, mesh=mesh)
+    else:
+        x = m.transformer(x, x_mask, p["encoder"], n_heads=hp.n_heads,
+                          window=hp.attn_window)
     stats = m.conv1d(x, p["proj"]) * x_mask
     m_p, logs_p = jnp.split(stats, 2, axis=-1)
     return x, m_p, logs_p
@@ -215,7 +223,7 @@ def _conv_flow_reverse(pf: Params, hp: VitsHyperParams, z, mask, g):
 
 
 def encode_text(p: Params, hp: VitsHyperParams, ids, x_lengths, rng, *,
-                noise_w: float, length_scale: float, sid=None):
+                noise_w: float, length_scale: float, sid=None, mesh=None):
     """ids [B, T] → (m_p, logs_p [B, T, C], durations w_ceil [B, T], g).
 
     Everything whose output size depends on data (durations) is computed
@@ -225,7 +233,7 @@ def encode_text(p: Params, hp: VitsHyperParams, ids, x_lengths, rng, *,
     g = None
     if sid is not None and "emb_g" in p:
         g = p["emb_g"][sid][:, None, :]  # [B, 1, gin]
-    x, m_p, logs_p = text_encoder(p["enc_p"], hp, ids, x_mask)
+    x, m_p, logs_p = text_encoder(p["enc_p"], hp, ids, x_mask, mesh=mesh)
     logw = duration_predictor_reverse(p["dp"], hp, x, x_mask, rng,
                                       noise_w, g=g)
     length_scale = jnp.reshape(jnp.asarray(length_scale, jnp.float32),
