@@ -51,10 +51,14 @@ class AudioSamples:
     Mirrors ``AudioSamples(Vec<f32>)`` (reference ``samples.rs:18``).
     """
 
-    __slots__ = ("data",)
+    __slots__ = ("data", "peak_normalize")
 
     def __init__(self, data: ArrayLike = ()):
         self.data = _as_f32(data)
+        # i16-conversion gain mode: True = per-buffer peak normalization
+        # (reference parity); False = fixed unit-range gain (seam-free
+        # streams, see AudioOutputConfig.stream_normalization)
+        self.peak_normalize = True
 
     # -- basic container ----------------------------------------------------
     def __len__(self) -> int:
@@ -69,25 +73,38 @@ class AudioSamples:
         return np.array_equal(self.data, other.data)
 
     def copy(self) -> "AudioSamples":
-        return AudioSamples(self.data.copy())
+        out = AudioSamples(self.data.copy())
+        out.peak_normalize = self.peak_normalize
+        return out
 
     # -- conversions (samples.rs:51-78) -------------------------------------
-    def to_i16(self) -> np.ndarray:
-        """Peak-normalizing conversion to int16 (``samples.rs:51-75``).
+    def to_i16(self, normalize: Optional[bool] = None) -> np.ndarray:
+        """Conversion to int16 (``samples.rs:51-75``).
 
-        Scales so the loudest sample hits full scale, with a floor on the
-        measured peak so near-silence is not amplified into noise.
+        ``normalize=True`` (the reference behavior) scales so the loudest
+        sample hits full scale, with a floor on the measured peak so
+        near-silence is not amplified into noise.  ``normalize=False``
+        scales by the fixed unit range instead (the model's tanh output is
+        already in [-1, 1]) — chunk-invariant, so consecutive streamed
+        chunks share one gain and cannot seam (see
+        ``AudioOutputConfig.stream_normalization``).  ``None`` defers to
+        the instance's ``peak_normalize`` attribute (default True).
         """
         if len(self) == 0:
             return np.zeros(0, dtype=np.int16)
-        peak = float(np.max(np.abs(self.data)))
-        scale = _I16_MAX / max(peak, _MIN_PEAK)
+        if normalize is None:
+            normalize = getattr(self, "peak_normalize", True)
+        if normalize:
+            peak = float(np.max(np.abs(self.data)))
+            scale = _I16_MAX / max(peak, _MIN_PEAK)
+        else:
+            scale = _I16_MAX
         scaled = np.clip(self.data * scale, -32768.0, 32767.0)
         return scaled.astype(np.int16)
 
-    def as_wave_bytes(self) -> bytes:
+    def as_wave_bytes(self, normalize: Optional[bool] = None) -> bytes:
         """Raw little-endian 16-bit PCM bytes (``samples.rs:76-78``)."""
-        return self.to_i16().astype("<i2").tobytes()
+        return self.to_i16(normalize).astype("<i2").tobytes()
 
     # -- combination ---------------------------------------------------------
     def merge(self, other: "AudioSamples") -> "AudioSamples":

@@ -245,7 +245,29 @@ def resolve_identity_aliases(inits: dict, nodes: list) -> dict:
     return out
 
 
-def import_onnx_weights(path: Union[str, Path], hp: VitsHyperParams, *,
+def _merge_initializers(dicts: "list[tuple[str, dict]]") -> dict:
+    """Merge per-file initializer maps (the streaming encoder/decoder
+    split).  Real parameter names must agree when repeated; anonymous
+    scope-generated names ("/Constant_output_0", "onnx::MatMul_12")
+    legitimately collide across independent exports and are last-wins.
+    """
+    merged: dict = {}
+    for label, d in dicts:
+        for name, arr in d.items():
+            prev = merged.get(name)
+            anonymous = name.startswith("/") or "::" in name
+            if (prev is not None and not anonymous
+                    and (prev.shape != arr.shape
+                         or not np.array_equal(prev, arr))):
+                raise FailedToLoadResource(
+                    f"initializer {name!r} differs between the merged "
+                    f"ONNX files (last: {label})")
+            merged[name] = arr
+    return merged
+
+
+def import_onnx_weights(path: Union[str, Path, "tuple", "list"],
+                        hp: VitsHyperParams, *,
                         n_vocab: int, n_speakers: int = 1) -> dict:
     """ONNX initializers → native param pytree.
 
@@ -253,9 +275,27 @@ def import_onnx_weights(path: Union[str, Path], hp: VitsHyperParams, *,
     state-dict mapper applies directly.  Weight-norm is usually already
     fused in exports (piper removes it); if ``weight_g/v`` pairs survive,
     the mapper fuses them.
+
+    ``path`` may be a sequence of files whose initializer sets partition
+    one model — the streaming voice layout's ``encoder.onnx`` +
+    ``decoder.onnx`` (``piper/src/lib.rs:90-96``).
     """
     from .import_torch import state_dict_to_params, strip_prefix
 
-    sd = to_f32(read_onnx_initializers(path))
-    return state_dict_to_params(strip_prefix(sd), hp, n_vocab=n_vocab,
-                                n_speakers=n_speakers)
+    paths = list(path) if isinstance(path, (tuple, list)) else [path]
+    sd = to_f32(_merge_initializers(
+        [(str(p), read_onnx_initializers(p)) for p in paths]))
+    try:
+        return state_dict_to_params(strip_prefix(sd), hp, n_vocab=n_vocab,
+                                    n_speakers=n_speakers)
+    except FailedToLoadResource:
+        # torch.onnx.export deduplicates value-identical tensors behind
+        # Identity nodes (e.g. untouched LayerNorm gammas); retry with the
+        # full graph walk resolving those aliases
+        resolved = []
+        for p in paths:
+            inits, nodes = read_onnx_graph(p)
+            resolved.append((str(p), resolve_identity_aliases(inits, nodes)))
+        sd = to_f32(_merge_initializers(resolved))
+        return state_dict_to_params(strip_prefix(sd), hp, n_vocab=n_vocab,
+                                    n_speakers=n_speakers)

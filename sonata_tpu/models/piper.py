@@ -122,27 +122,12 @@ class PiperVoice(BaseModel):
             params = load_params(stem.with_suffix(".npz"))
         elif config.streaming and enc_path.exists() and dec_path.exists():
             try:
-                from .import_onnx import read_onnx_initializers, to_f32
-                from .import_torch import state_dict_to_params, strip_prefix
+                from .import_onnx import import_onnx_weights
             except ImportError as e:
                 raise FailedToLoadResource(
                     f"ONNX weight import unavailable: {e}") from e
-            merged = read_onnx_initializers(enc_path)
-            for name, arr in read_onnx_initializers(dec_path).items():
-                prev = merged.get(name)
-                # anonymous scope-generated names ("/Constant_output_0",
-                # "onnx::MatMul_12") legitimately collide across two
-                # independent exports; only real parameter names must agree
-                anonymous = name.startswith("/") or "::" in name
-                if (prev is not None and not anonymous
-                        and (prev.shape != arr.shape
-                             or not np.array_equal(prev, arr))):
-                    raise FailedToLoadResource(
-                        f"streaming voice: initializer {name!r} differs "
-                        "between encoder.onnx and decoder.onnx")
-                merged[name] = arr
-            params = state_dict_to_params(
-                strip_prefix(to_f32(merged)), config.hyper, n_vocab=n_vocab,
+            params = import_onnx_weights(
+                (enc_path, dec_path), config.hyper, n_vocab=n_vocab,
                 n_speakers=config.num_speakers)
         elif onnx_path.exists():
             try:

@@ -44,6 +44,17 @@ class AudioOutputConfig:
     volume: Optional[int] = None
     pitch: Optional[int] = None
     appended_silence_ms: Optional[int] = None
+    # "per-chunk" (default; reference parity: each streamed chunk
+    # peak-normalizes independently, samples.rs:51-75 — can audibly seam
+    # between chunks) or "global": one fixed unit-range gain for the whole
+    # stream, seam-free.  See PARITY.md "Streaming normalization".
+    stream_normalization: Optional[str] = None
+
+    def __post_init__(self):
+        if self.stream_normalization not in (None, "per-chunk", "global"):
+            raise ValueError(
+                f"stream_normalization={self.stream_normalization!r}: "
+                "expected None, 'per-chunk', or 'global'")
 
     def apply(self, samples: AudioSamples, sample_rate: int) -> AudioSamples:
         """Silence first, then rate/volume/pitch (``synth/lib.rs:37-53``)."""

@@ -89,6 +89,11 @@ class SpeechSynthesizer:
             return audio
         processed = output_config.apply(audio.samples,
                                         audio.info.sample_rate)
+        if output_config.stream_normalization == "global":
+            # one fixed gain for every chunk of the stream — seam-free
+            # (the default replicates the reference's per-chunk peak
+            # normalization, samples.rs:51-75)
+            processed.peak_normalize = False
         return Audio(processed, audio.info, inference_ms=audio.inference_ms)
 
     # -- modes ---------------------------------------------------------------

@@ -24,7 +24,15 @@ class TashkeelEngine:
         self._lock = threading.Lock()
         if model_path is not None:
             try:
-                if str(model_path).endswith((".onnx", ".ort")):
+                if str(model_path).endswith(".ort"):
+                    from ..core import FailedToLoadResource
+
+                    raise FailedToLoadResource(
+                        f"{model_path}: ORT-format models are flatbuffers, "
+                        "not ONNX protobuf — convert to .onnx "
+                        "(python -m onnxruntime.tools.convert_onnx_models_"
+                        "to_ort reverses with the original .onnx kept)")
+                if str(model_path).endswith(".onnx"):
                     # libtashkeel-family CBHG artifact (ONNX export)
                     from ..models.tashkeel_cbhg import TashkeelCBHGModel
 

