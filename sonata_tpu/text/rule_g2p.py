@@ -19,33 +19,8 @@ from __future__ import annotations
 
 import re
 
-# -- small lexicon of irregular / very common words -------------------------
-_LEXICON = {
-    "a": "ə", "an": "æn", "the": "ðə", "of": "ʌv", "to": "tuː", "and": "ænd",
-    "in": "ɪn", "is": "ɪz", "it": "ɪt", "you": "juː", "that": "ðæt",
-    "he": "hiː", "she": "ʃiː", "was": "wʌz", "for": "fɔːɹ", "on": "ɑːn",
-    "are": "ɑːɹ", "as": "æz", "with": "wɪð", "his": "hɪz", "her": "hɜːɹ",
-    "they": "ðeɪ", "i": "aɪ", "at": "æt", "be": "biː", "this": "ðɪs",
-    "have": "hæv", "from": "fɹʌm", "or": "ɔːɹ", "one": "wʌn", "had": "hæd",
-    "by": "baɪ", "word": "wɜːd", "but": "bʌt", "not": "nɑːt", "what": "wʌt",
-    "all": "ɔːl", "were": "wɜːɹ", "we": "wiː", "when": "wɛn", "your": "jʊɹ",
-    "can": "kæn", "said": "sɛd", "there": "ðɛɹ", "use": "juːz", "each": "iːtʃ",
-    "which": "wɪtʃ", "do": "duː", "how": "haʊ", "their": "ðɛɹ", "if": "ɪf",
-    "will": "wɪl", "way": "weɪ", "about": "əbaʊt", "many": "mɛni",
-    "then": "ðɛn", "them": "ðɛm", "would": "wʊd", "like": "laɪk",
-    "so": "soʊ", "these": "ðiːz", "some": "sʌm", "two": "tuː",
-    "more": "mɔːɹ", "very": "vɛɹi", "time": "taɪm", "could": "kʊd",
-    "no": "noʊ", "my": "maɪ", "than": "ðæn", "been": "bɪn", "who": "huː",
-    "its": "ɪts", "now": "naʊ", "people": "piːpəl", "made": "meɪd",
-    "over": "oʊvɚ", "did": "dɪd", "down": "daʊn", "only": "oʊnli",
-    "little": "lɪɾəl", "world": "wɜːld", "good": "ɡʊd", "me": "miː",
-    "our": "aʊɚ", "out": "aʊt", "up": "ʌp", "other": "ʌðɚ", "new": "nuː",
-    "work": "wɜːk", "first": "fɜːst", "water": "wɔːɾɚ", "after": "æftɚ",
-    "where": "wɛɹ", "through": "θɹuː", "hello": "həloʊ", "test": "tɛst",
-    "speech": "spiːtʃ", "voice": "vɔɪs", "sound": "saʊnd", "once": "wʌns",
-    "says": "sɛz", "does": "dʌz", "gone": "ɡɔːn", "come": "kʌm",
-    "alice": "ælɪs", "here": "hɪɹ", "any": "ɛni", "again": "əɡɛn",
-}
+# The word lexicon lives in :mod:`.lexicon` (~1.2k stressed base words
+# multiplied by morphological derivation).
 
 # -- ordered letter-to-sound rules ------------------------------------------
 # (pattern, ipa) — longest-match-first within position scanning.
@@ -117,8 +92,33 @@ def normalize_text(text: str) -> str:
     return text.lower()
 
 
+from .lexicon import IPA_VOWELS as _IPA_VOWEL_STARTS
+
+
+def _default_stress(ipa: str) -> str:
+    """Insert primary stress before the first syllable when a
+    rule-generated word has two or more vowel nuclei and no stress mark
+    yet (eSpeak marks stress on every content word; Piper voices carry
+    ˈ/ˌ in their phoneme maps)."""
+    if "ˈ" in ipa or "ˌ" in ipa:
+        return ipa
+    nuclei = [i for i, ch in enumerate(ipa) if ch in _IPA_VOWEL_STARTS
+              and (i == 0 or ipa[i - 1] not in _IPA_VOWEL_STARTS)]
+    if len(nuclei) < 2:
+        return ipa  # monosyllables are left unmarked, like the lexicon
+    first = nuclei[0]
+    # place the mark before the syllable onset (the consonant run
+    # preceding the first nucleus)
+    onset = first
+    while onset > 0 and ipa[onset - 1] not in _IPA_VOWEL_STARTS + "ː":
+        onset -= 1
+    return ipa[:onset] + "ˈ" + ipa[onset:]
+
+
 def english_word_to_ipa(word: str) -> str:
-    hit = _LEXICON.get(word)
+    from .lexicon import derive
+
+    hit = derive(word)  # lexicon + morphological derivations
     if hit is not None:
         return hit
     out: list[str] = []
@@ -130,6 +130,15 @@ def english_word_to_ipa(word: str) -> str:
         if body[i] == "y" and i == len(body) - 1:
             out.append("i")  # word-final y is a vowel ("twenty" → …ti)
             break
+        # context rules: soft c/g before front vowels
+        if body[i] == "c" and i + 1 < len(body) and body[i + 1] in "eiy":
+            out.append("s")
+            i += 1
+            continue
+        if body[i] == "g" and i + 1 < len(body) and body[i + 1] in "ei":
+            out.append("dʒ")
+            i += 1
+            continue
         for pat, ipa in _RULES:
             if body.startswith(pat, i):
                 out.append(ipa)
@@ -146,7 +155,7 @@ def english_word_to_ipa(word: str) -> str:
         idx = ipa.rfind(best[0])
         if idx >= 0:
             ipa = ipa[:idx] + best[1] + ipa[idx + len(best[0]):]
-    return ipa
+    return _default_stress(ipa)
 
 
 def arabic_word_to_ipa(word: str) -> str:
