@@ -72,6 +72,39 @@ def main() -> None:
         "vs_baseline": None,
     }), file=sys.stdout)
 
+    # TTFB degradation under load: p50 first-chunk latency with N
+    # concurrent streams vs the single-stream p50 above.  The shared
+    # decode coalescer should keep this ratio well below N (the
+    # reference's thread-per-stream serving degrades linearly).
+    for n in (4, 8):
+        def first_chunk_latency(i: int) -> float:
+            t = time.perf_counter()
+            stream = synth.synthesize_streamed(SENTENCE, chunk_size=55,
+                                               chunk_padding=3)
+            next(iter(stream))
+            dt = time.perf_counter() - t
+            for _chunk in stream:
+                pass
+            return dt
+
+        with concurrent.futures.ThreadPoolExecutor(n) as ex:
+            lats = list(ex.map(first_chunk_latency, range(n)))
+        print(json.dumps({
+            "metric": f"streaming_ttfb_p50_at_{n}_streams",
+            "value": round(statistics.median(lats) * 1000.0, 2),
+            "unit": "ms",
+            "vs_baseline": None,
+        }))
+    co = voice._stream_coalescer
+    if co is not None:
+        print(json.dumps({
+            "metric": "stream_decode_coalescing_ratio",
+            "value": round(co.stats["requests"]
+                           / max(co.stats["dispatches"], 1), 2),
+            "unit": "requests_per_dispatch",
+            "vs_baseline": None,
+        }))
+
 
 if __name__ == "__main__":
     main()

@@ -22,6 +22,7 @@ serialized inside the text backend.
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from pathlib import Path
@@ -71,6 +72,7 @@ class PiperVoice(BaseModel):
         self._full_cache: dict = {}
         self._aco_cache: dict = {}
         self._dec_cache: dict = {}
+        self._stream_coalescer: "Optional[_StreamDecodeCoalescer]" = None
         # adaptive frame-budget estimator for the single-dispatch path:
         # running upper bound of frames per input id per unit length_scale.
         # Start optimistic — an underestimate costs one overflow retry on
@@ -532,6 +534,34 @@ class PiperVoice(BaseModel):
                 self._dec_cache[key] = fn
         return fn
 
+    def _decode_windows_batch_fn(self, width: int, b: int, has_sid: bool):
+        """Jitted batched chunk decoder for coalesced concurrent streams:
+        stacked per-stream z rows + per-row starts → [B, width*hop]."""
+        key = ("wbatch", width, b, has_sid)
+        with self._jit_lock:
+            fn = self._dec_cache.get(key)
+            if fn is None:
+                hp = self.hp
+
+                def run(params, zs, starts, sid=None):
+                    g = (params["emb_g"][sid][:, None, :]
+                         if sid is not None else None)
+                    windows = jax.vmap(
+                        lambda z, s: jax.lax.dynamic_slice_in_dim(
+                            z, s, width, axis=0))(zs, starts)
+                    return vits.decode(params, hp, windows, g=g)
+
+                fn = jax.jit(run)
+                self._dec_cache[key] = fn
+        return fn
+
+    @property
+    def _stream_decoder(self) -> "_StreamDecodeCoalescer":
+        with self._jit_lock:
+            if self._stream_coalescer is None:
+                self._stream_coalescer = _StreamDecodeCoalescer(self)
+            return self._stream_coalescer
+
     def _pad_batch(self, ids_list: list[list[int]]):
         """Pad a sentence batch to (batch, text) buckets.
 
@@ -661,6 +691,23 @@ class PiperVoice(BaseModel):
             return aco(*args)
 
         z, y_lengths = run_acoustics(f)
+        # TTFB: the first window of a multi-chunk schedule is always
+        # (start=0, width=chunk+padding) regardless of the total frame
+        # count, so dispatch its decode NOW — it overlaps the frame-count
+        # host sync and the acoustics tail instead of serializing after
+        # them.  Gated on the estimator predicting a multi-chunk schedule
+        # with margin: a wasted speculative decode on a one-shot
+        # utterance would serialize AHEAD of the real one and make TTFB
+        # worse, so near the one-shot boundary we don't speculate.
+        # Also discarded on an acoustics retry (z was clipped).
+        sid0 = int(sid[0]) if sid is not None else None
+        pre_width = bucket_for(chunk_size + chunk_padding, FRAME_BUCKETS)
+        with self._fpi_lock:
+            est_frames = weighted * self._frames_per_id
+        one_shot_bound = 2 * chunk_size + 2 * chunk_padding
+        pre_fut = (self._stream_decoder.submit(z[0], 0, pre_width, sid0)
+                   if pre_width <= f and est_frames > 1.5 * one_shot_bound
+                   else None)
         # sync on row 0 only (with a mesh the batch has dummy rows); by now
         # acoustics is in flight or done
         total_frames = int(jnp.sum(w_ceil[:1]))
@@ -668,6 +715,7 @@ class PiperVoice(BaseModel):
         if total_frames > f:  # underestimate: z would be clipped
             f = bucket_for(total_frames, FRAME_BUCKETS)
             z, y_lengths = run_acoustics(f)
+            pre_fut = None  # predispatched against the clipped z
         total_frames = min(total_frames, f)
         enc_ms = (time.perf_counter() - t_enc0) * 1000.0
 
@@ -676,12 +724,15 @@ class PiperVoice(BaseModel):
             width = bucket_for(plan.width, FRAME_BUCKETS)
             start = min(plan.win_start, max(f - width, 0))
             shift = plan.win_start - start  # window moved left by padding
-            dec = self._decode_window_fn(width)
-            dec_args = [self.params, z, start]
-            if sid is not None:
-                dec_args.append(sid)
-            wav = dec(*dec_args)
-            wav = np.asarray(jax.block_until_ready(wav))[0]
+            # window decodes route through the shared coalescer so N
+            # concurrent streams' equal-width chunks ride one dispatch
+            # (the reference gives each stream its own blocking session,
+            # grpc/src/main.rs:381-409 — linear degradation under load)
+            if pre_fut is not None and start == 0 and width == pre_width:
+                wav = pre_fut.result()  # already in flight since encode
+            else:
+                wav = self._stream_decoder.decode(z[0], start, width, sid0)
+            pre_fut = None
             lo = (shift + plan.trim_left) * hop
             hi = (shift + plan.width - plan.trim_right) * hop
             samples = AudioSamples(wav[lo:hi])
@@ -689,3 +740,136 @@ class PiperVoice(BaseModel):
             ms = (time.perf_counter() - t0) * 1000.0 + enc_ms
             enc_ms = 0.0  # encoder cost attributed to the first chunk
             yield Audio(samples, info, inference_ms=ms)
+
+
+class _StreamDecodeCoalescer:
+    """Shared dispatcher for streaming window decodes.
+
+    The reference serves each realtime stream from its own blocking thread
+    (``grpc/src/main.rs:381-409``), so N concurrent streams contend for
+    the device with N independent decode calls per chunk wave.  Here every
+    stream's window decode funnels through one queue; a worker groups
+    requests of equal window width (and same z frame-bucket shape) that
+    arrive within ``max_wait_ms`` and issues ONE batched decode — under
+    concurrent load the chunk cost approaches one dispatch per wave
+    instead of one per stream, while a lone stream pays only the tiny
+    wait window.
+    """
+
+    def __init__(self, voice: "PiperVoice", *, max_batch: int = 8,
+                 max_wait_ms: float = 2.0):
+        import weakref
+
+        # weak back-reference: the voice owns the coalescer; a strong ref
+        # here would pin the voice (and its params) to this thread's frame
+        # for process lifetime
+        self._voice_ref = weakref.ref(voice)
+        self._max_batch = max_batch
+        self._max_wait = max_wait_ms / 1000.0
+        self._queue: "queue.Queue" = queue.Queue()
+        self.stats = {"requests": 0, "dispatches": 0}
+        self._closed = False
+        self._worker = threading.Thread(target=self._run,
+                                        name="sonata_stream_decoder",
+                                        daemon=True)
+        self._worker.start()
+
+    def close(self) -> None:
+        self._closed = True
+        self._queue.put(None)  # wake the worker
+
+    def submit(self, z_row, start: int, width: int, sid: "Optional[int]"):
+        """Enqueue a window decode; returns a Future of the [width*hop]
+        waveform.  ``z_row``: [F, C] device array."""
+        from concurrent.futures import Future
+
+        fut: "Future[np.ndarray]" = Future()
+        self._queue.put((z_row, start, width, sid, fut))
+        return fut
+
+    def decode(self, z_row, start: int, width: int,
+               sid: "Optional[int]") -> np.ndarray:
+        """Blocking variant of :meth:`submit`."""
+        return self.submit(z_row, start, width, sid).result()
+
+    # -- worker ---------------------------------------------------------
+    def _run(self) -> None:
+        while not self._closed:
+            try:
+                first = self._queue.get(timeout=5.0)
+            except queue.Empty:
+                if self._voice_ref() is None:
+                    return  # voice collected: let the thread die
+                continue
+            if first is None:
+                continue
+            group = [first]
+            key = self._key(first)
+            deadline = time.monotonic() + self._max_wait
+            leftovers = []
+            while len(group) < self._max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    break
+                if self._key(nxt) == key:
+                    group.append(nxt)
+                else:
+                    leftovers.append(nxt)  # different shape: next wave
+            for item in leftovers:
+                self._queue.put(item)
+            self._dispatch(group)
+
+    @staticmethod
+    def _key(item) -> tuple:
+        z_row, _start, width, sid, _fut = item
+        return (tuple(z_row.shape), width, sid is not None)
+
+    def _dispatch(self, group) -> None:
+        v = self._voice_ref()
+        futures = [item[4] for item in group]
+        if v is None:
+            for fut in futures:
+                try:
+                    fut.set_exception(
+                        OperationError("voice was garbage-collected"))
+                except Exception:
+                    pass
+            return
+        try:
+            n = len(group)
+            b = bucket_for(n, [x for x in BATCH_BUCKETS
+                               if x <= self._max_batch] or [self._max_batch])
+            pad = b - n
+            zs = jnp.stack([item[0] for item in group]
+                           + [group[0][0]] * pad)
+            starts = jnp.asarray([item[1] for item in group]
+                                 + [group[0][1]] * pad, dtype=jnp.int32)
+            width = group[0][2]
+            has_sid = group[0][3] is not None
+            args = [v.params, zs, starts]
+            if has_sid:
+                args.append(jnp.asarray(
+                    [item[3] for item in group] + [group[0][3]] * pad,
+                    dtype=jnp.int32))
+            fn = v._decode_windows_batch_fn(width, b, has_sid)
+            wavs = np.asarray(jax.block_until_ready(fn(*args)))
+            self.stats["requests"] += n
+            self.stats["dispatches"] += 1
+        except Exception as e:
+            for fut in futures:
+                try:
+                    fut.set_exception(e)
+                except Exception:
+                    pass
+            return
+        for fut, wav in zip(futures, wavs):
+            try:
+                fut.set_result(wav)
+            except Exception:
+                pass

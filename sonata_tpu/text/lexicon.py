@@ -573,17 +573,24 @@ def derive(word: str) -> Optional[str]:
     ):
         if len(word) > len(suf) + 1 and word.endswith(suf):
             stem = word[: -len(suf)]
+            # a 1-2 letter base is almost always a false split ("united"
+            # must not parse as un+it+ed, "asses" not as as+es); real
+            # inflected bases are 3+ letters
+            if len(stem) < 3 and LEXICON.get(stem + "e") is None:
+                continue
             b = base(stem, vowel_suffix=suf[0] in "aei")
-            if b is None and len(stem) > 2 and stem[-1] == stem[-2]:
+            if b is None and len(stem) > 3 and stem[-1] == stem[-2]:
                 b = LEXICON.get(stem[:-1])  # "stopped" → "stop"
             if b is not None:
                 return render(b)
-    # prefixes
+    # prefixes: the remainder must be a whole lexicon word — recursive
+    # derivation here produced non-compositional garbage ("united" →
+    # un+ited)
     for pre, ipa in (("un", "ʌn"), ("re", "ɹiː"), ("dis", "dɪs"),
                      ("non", "nɑːn"), ("pre", "pɹiː"), ("over", "ˌoʊvɚ"),
                      ("under", "ˌʌndɚ"), ("mis", "mɪs"), ("out", "ˌaʊt")):
         if word.startswith(pre) and len(word) > len(pre) + 2:
-            b = derive(word[len(pre):])
+            b = LEXICON.get(word[len(pre):])
             if b is not None:
                 return ipa + b
     return None

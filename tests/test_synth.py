@@ -280,3 +280,63 @@ def test_stream_normalization_modes(synth):
         peak = float(np.max(np.abs(chunk.samples.data)))
         if peak > 0.01:
             assert int(np.max(np.abs(chunk.samples.to_i16()))) >= 32700
+
+
+# ---------------------------------------------------------------------------
+# concurrent realtime streams coalesce through the shared decoder
+# (VERDICT round-1 next#7; reference gap: grpc/src/main.rs:381-409)
+# ---------------------------------------------------------------------------
+
+def test_stream_decode_coalescer_correctness():
+    """A window decoded through the coalescer (possibly batched with
+    other streams' windows) equals the direct single-stream decode."""
+    import jax
+    import jax.numpy as jnp
+    from concurrent.futures import wait
+
+    from sonata_tpu.models.piper import _StreamDecodeCoalescer
+
+    v = tiny_voice(seed=9)
+    # wide wait window so the 4 submissions deterministically coalesce
+    # even on a loaded 1-core host
+    v._stream_coalescer = _StreamDecodeCoalescer(v, max_wait_ms=300.0)
+    f = 64
+    z = jax.random.normal(jax.random.PRNGKey(3),
+                          (1, f, v.hp.inter_channels))
+    width = 16
+    direct = np.asarray(v._decode_window_fn(width)(v.params, z, 8))[0]
+    # submit 4 equal-shape requests at once so they coalesce
+    futs = [v._stream_decoder.submit(z[0], 8, width, None)
+            for _ in range(4)]
+    wait(futs)
+    for fut in futs:
+        np.testing.assert_allclose(fut.result(), direct, atol=1e-5)
+    stats = v._stream_decoder.stats
+    assert stats["dispatches"] < stats["requests"]  # they actually batched
+
+
+def test_concurrent_streams_share_dispatches():
+    import threading
+
+    from sonata_tpu.models.piper import _StreamDecodeCoalescer
+
+    v = tiny_voice(seed=5)
+    # wide wait window: on a loaded 1-core host the four stream threads
+    # can skew past a small window at every chunk wave, which would make
+    # the batching assertion timing-dependent
+    v._stream_coalescer = _StreamDecodeCoalescer(v, max_wait_ms=300.0)
+    results = [None] * 4
+
+    def run(i):
+        chunks = list(v.stream_synthesis("tɛst nʌmbɚ wˈʌn tuː θɹˈiː",
+                                         12, 2))
+        results[i] = np.concatenate([c.samples.data for c in chunks])
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(r is not None and len(r) > 0 for r in results)
+    stats = v._stream_coalescer.stats
+    assert stats["dispatches"] < stats["requests"]
