@@ -100,6 +100,11 @@ class EspeakBackend:
     _AUDIO_OUTPUT_RETRIEVAL = 1
     _CHARS_UTF8 = 1
     _PHONEMES_IPA = 0x02
+    # terminator word layout (espeak-ng clause codes; reference constants
+    # espeak-phonemizer/src/lib.rs:14-18)
+    _INTONATION_MASK = 0x0000F000
+    _INTONATION_CHAR = {0x0000: ".", 0x1000: ",", 0x2000: "?", 0x3000: "!"}
+    _CLAUSE_TYPE_SENTENCE = 0x00080000
 
     def __init__(self, library_path: Optional[str] = None):
         path = (
@@ -126,6 +131,22 @@ class EspeakBackend:
             ctypes.c_int,
             ctypes.c_int,
         ]
+        # the reference patches espeak-ng with a terminator-reporting
+        # variant (espeak_TextToPhonemesWithTerminator) and derives clause
+        # punctuation + sentence breaks from its clause loop
+        # (espeak-phonemizer/src/lib.rs:113-137); when the loaded library
+        # carries that symbol we use the same loop instead of host-side
+        # regex segmentation
+        self._with_terminator = getattr(
+            self._lib, "espeak_TextToPhonemesWithTerminator", None)
+        if self._with_terminator is not None:
+            self._with_terminator.restype = ctypes.c_char_p
+            self._with_terminator.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int),
+            ]
         data_dir = os.environ.get(ESPEAK_DATA_ENV)
         rate = self._lib.espeak_Initialize(
             self._AUDIO_OUTPUT_RETRIEVAL,
@@ -138,27 +159,76 @@ class EspeakBackend:
                 f"espeak_Initialize failed (data dir: {data_dir or 'default'})"
             )
 
+    @property
+    def has_terminator_support(self) -> bool:
+        return self._with_terminator is not None
+
+    @classmethod
+    def decode_terminator(cls, value: int) -> tuple[str, bool]:
+        """(terminator char, sentence_end) from an eSpeak clause code —
+        the mapping the reference applies at lib.rs:124-136."""
+        char = cls._INTONATION_CHAR.get(value & cls._INTONATION_MASK, ".")
+        return char, bool(value & cls._CLAUSE_TYPE_SENTENCE)
+
+    def _set_voice_locked(self, voice: str) -> None:
+        if voice != self._voice:
+            if self._lib.espeak_SetVoiceByName(voice.encode()) != 0:
+                raise PhonemizationError(f"unknown eSpeak voice: {voice}")
+            self._voice = voice
+
+    def _consume_clauses(self, text: str, call):
+        """Drive eSpeak's consume-one-clause-per-call loop over ``text``.
+
+        ``call(ptr_ref)`` performs one library call and returns the raw
+        result; yields each decoded non-raw piece.  Callers must hold the
+        lock and have set the voice.
+        """
+        buf = ctypes.create_string_buffer(text.encode("utf-8"))
+        ptr = ctypes.c_void_p(ctypes.addressof(buf))
+        while ptr.value:
+            res = call(ctypes.byref(ptr))
+            if res is None:
+                break
+            yield res.decode("utf-8", errors="replace").strip()
+
+    def phonemize_clauses(self, line: str, voice: str):
+        """eSpeak's own clause loop → [(ipa, terminator, sentence_end)].
+
+        Only meaningful when :attr:`has_terminator_support`; mirrors the
+        reference's ``_text_to_phonemes`` loop (lib.rs:113-137), so
+        non-Latin scripts break sentences exactly where eSpeak does.
+        Empty clauses (punctuation-only input) fold their terminator into
+        the previous clause, matching the host-side segmentation's
+        behavior for stray terminators.
+        """
+        out = []
+        term = ctypes.c_int(0)
+        with self._lock:
+            self._set_voice_locked(voice)
+            for ipa in self._consume_clauses(
+                    line,
+                    lambda ptr_ref: self._with_terminator(
+                        ptr_ref, self._CHARS_UTF8, self._PHONEMES_IPA,
+                        ctypes.byref(term))):
+                char, sentence_end = self.decode_terminator(term.value)
+                if not ipa:
+                    if out:  # stray terminator attaches to previous clause
+                        prev = out[-1]
+                        out[-1] = (prev[0], char, prev[2] or sentence_end)
+                    continue
+                out.append((ipa, char, sentence_end))
+        return out
+
     def phonemize_clause(self, text: str, voice: str) -> str:
         with self._lock:
-            if voice != self._voice:
-                if self._lib.espeak_SetVoiceByName(voice.encode()) != 0:
-                    raise PhonemizationError(f"unknown eSpeak voice: {voice}")
-                self._voice = voice
-            buf = ctypes.create_string_buffer(text.encode("utf-8"))
-            ptr = ctypes.c_void_p(ctypes.addressof(buf))
-            pieces: list[str] = []
+            self._set_voice_locked(voice)
             # eSpeak consumes one clause per call, advancing the pointer;
             # we pre-split clauses, but a clause may still span eSpeak's
             # internal limits, so loop until the input is consumed.
-            while ptr.value:
-                res = self._lib.espeak_TextToPhonemes(
-                    ctypes.byref(ptr), self._CHARS_UTF8, self._PHONEMES_IPA
-                )
-                if res is None:
-                    break
-                piece = res.decode("utf-8", errors="replace").strip()
-                if piece:
-                    pieces.append(piece)
+            pieces = [p for p in self._consume_clauses(
+                text,
+                lambda ptr_ref: self._lib.espeak_TextToPhonemes(
+                    ptr_ref, self._CHARS_UTF8, self._PHONEMES_IPA)) if p]
             return " ".join(pieces)
 
 
@@ -214,9 +284,14 @@ def _phonemize_line(
     out: Phonemes,
 ) -> None:
     current: list[str] = []
-    clauses = split_clauses(line)
-    for clause in clauses:
-        ipa = backend.phonemize_clause(clause.text, voice)
+    if getattr(backend, "has_terminator_support", False):
+        # patched eSpeak: its clause loop is the segmentation authority
+        # (parity with the reference's terminator-driven splitting)
+        triples = backend.phonemize_clauses(line, voice)
+    else:
+        triples = [(backend.phonemize_clause(c.text, voice), c.terminator,
+                    c.sentence_end) for c in split_clauses(line)]
+    for ipa, terminator, sentence_end in triples:
         if remove_lang_switch_flags:
             ipa = LANG_SWITCH_RE.sub("", ipa)  # lib.rs:141-147
         if remove_stress:
@@ -228,8 +303,8 @@ def _phonemize_line(
             # ties, length marks, and combining diacritics stay attached.
             ipa = separator.join(split_ipa_segments(ipa))
         # terminator punctuation is a real symbol for VITS (lib.rs:124-133)
-        current.append(ipa + clause.terminator)
-        if clause.sentence_end:
+        current.append(ipa + terminator)
+        if sentence_end:
             out.append(" ".join(current))
             current = []
     if current:

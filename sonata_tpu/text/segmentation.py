@@ -6,12 +6,16 @@ terminator metadata, the intonation bits are mapped back to punctuation, and
 the CLAUSE_TYPE_SENTENCE bit ends a sentence
 (``crates/text/espeak-phonemizer/src/lib.rs:124-136``).
 
-On TPU the segmentation contract matters doubly: sentence boundaries bound
-the length of every device program (SURVEY §5 "long-context"), so they must
-be stable and host-side.  We therefore implement clause splitting natively,
-independent of any G2P backend, with the same observable contract:
-each clause carries its terminator punctuation (one of ``. , ? ! ; :``) and
-a "sentence end" flag.
+This module is the host-side implementation of that contract — clause
+splitting independent of any G2P backend, each clause carrying its
+terminator punctuation (one of ``. , ? ! ; :``) and a "sentence end" flag.
+It is the default segmentation authority; when the loaded libespeak-ng
+carries the reference's patched terminator API, the phonemizer defers to
+eSpeak's own clause loop instead (:meth:`EspeakBackend.phonemize_clauses`)
+for exact reference parity on non-Latin scripts.  Either way compiled
+program shapes stay bounded: sentences pad to TEXT_BUCKETS shapes
+downstream (multiples of the top bucket beyond it) regardless of where
+the boundaries fall.
 """
 
 from __future__ import annotations

