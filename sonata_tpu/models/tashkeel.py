@@ -122,10 +122,12 @@ class TashkeelModel:
     @classmethod
     def from_path(cls, path: Union[str, Path]) -> "TashkeelModel":
         try:
+            import zipfile
+
             with np.load(Path(path), allow_pickle=False) as data:
                 flat = {k: data[k] for k in data.files if k != "__meta__"}
                 meta = json.loads(bytes(data["__meta__"]).decode("utf-8"))
-        except (OSError, KeyError, ValueError) as e:
+        except (OSError, KeyError, ValueError, zipfile.BadZipFile) as e:
             raise FailedToLoadResource(
                 f"cannot load tashkeel model {path}: {e}") from e
         hp = TashkeelHyperParams(**meta.get("hyper", {}))

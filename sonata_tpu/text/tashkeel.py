@@ -4,10 +4,11 @@ In the reference, ``libtashkeel`` (a Rust crate running its own bundled ONNX
 seq-tagging model) is auto-enabled whenever the voice's eSpeak language is
 ``ar`` (``crates/sonata/models/piper/src/lib.rs:63-77,253-258,270-281``).
 
-Here the same rule applies (see ``PiperVoice.phonemize_text``), and the
-engine is a small JAX character tagger (:mod:`sonata_tpu.models.tashkeel`)
-when weights are available, with an identity fallback otherwise so the
-Arabic chain never hard-fails.
+Here the same rule applies (see ``PiperVoice.phonemize_text``).  The
+engine resolves, in order: an explicit model artifact (CBHG ``.onnx`` or
+native ``.npz`` tagger), the bundled default tagger, and finally the
+heuristic rule engine (:mod:`.tashkeel_rules`) — so the Arabic chain
+always diacritizes and never hard-fails.
 """
 
 from __future__ import annotations
@@ -17,7 +18,8 @@ from typing import Optional
 
 
 class TashkeelEngine:
-    """Diacritize Arabic text.  Identity fallback when no model is loaded."""
+    """Diacritize Arabic text.  Falls back to the heuristic rule engine
+    when no model is loaded (non-Arabic text passes through either way)."""
 
     def __init__(self, model_path: Optional[str] = None):
         self._model = None
@@ -53,7 +55,11 @@ class TashkeelEngine:
 
     def diacritize(self, text: str) -> str:
         if self._model is None:
-            return text
+            # no model: heuristic rules rather than an identity pass, so
+            # the auto-enabled Arabic chain always diacritizes something
+            from . import tashkeel_rules
+
+            return tashkeel_rules.diacritize(text)
         with self._lock:
             return self._model.diacritize(text)
 
@@ -67,15 +73,35 @@ def get_default_engine() -> TashkeelEngine:
     tashkeel instance, ``crates/frontends/python/src/lib.rs:17-18``).
 
     ``SONATA_TASHKEEL_MODEL`` names the model artifact (`.onnx` CBHG export
-    or `.npz` native tagger) — the counterpart of libtashkeel's bundled
-    model, which cannot ship here.  Unset ⇒ identity engine.
+    or `.npz` native tagger).  Unset ⇒ the bundled default tagger
+    (``sonata_tpu/data/tashkeel_default.npz``, trained by
+    ``tools/train_tashkeel.py`` to reproduce the heuristic rule engine);
+    if that is also absent the engine applies the rules directly.
     """
     global _GLOBAL
     if _GLOBAL is None:
         with _GLOBAL_LOCK:
             if _GLOBAL is None:
                 import os
+                from pathlib import Path
 
-                _GLOBAL = TashkeelEngine(
-                    os.environ.get("SONATA_TASHKEEL_MODEL") or None)
+                path = os.environ.get("SONATA_TASHKEEL_MODEL") or None
+                bundled = path is None
+                if bundled:
+                    cand = (Path(__file__).resolve().parent.parent
+                            / "data" / "tashkeel_default.npz")
+                    if cand.exists():
+                        path = str(cand)
+                try:
+                    _GLOBAL = TashkeelEngine(path)
+                except Exception:
+                    if not bundled:
+                        raise  # an explicit env-var model must not be
+                        # silently ignored
+                    import logging
+
+                    logging.getLogger("sonata.tashkeel").warning(
+                        "bundled tashkeel model unreadable; falling back "
+                        "to the rule engine", exc_info=True)
+                    _GLOBAL = TashkeelEngine()
     return _GLOBAL

@@ -1,0 +1,130 @@
+"""Heuristic Arabic diacritization rules.
+
+The reference ships libtashkeel's trained neural model; a real training
+corpus (Tashkeela etc.) cannot be fetched in this environment, so the
+out-of-the-box Arabic chain uses this deterministic rule engine instead —
+a simplified rendering of MSA orthographic regularities:
+
+- the definite article ``ال``: bare alif, lam takes sukun before moon
+  letters; before sun letters the lam assimilates and the sun letter
+  takes shadda;
+- word-final letters take sukun (pausal form); final ``ة`` is preceded
+  by fatha;
+- long-vowel carriers (ا و ي) after a consonant are left unmarked and
+  suppress the preceding default vowel mark where they lengthen it;
+- other consonants take a default short vowel chosen per letter class
+  (emphatic/pharyngeal → fatha, labial → damma-leaning, else kasra/fatha
+  alternation) — deterministic, so output is stable and reversible.
+
+These rules double as the synthetic supervision for the bundled neural
+tagger (``tools/train_tashkeel.py``): the tagger learns to reproduce them
+exactly, which exercises the full train→save→load→serve loop and gives
+``TashkeelEngine`` a functional default model.  Swap in a real
+libtashkeel ONNX artifact (``SONATA_TASHKEEL_MODEL``) for production
+Arabic quality.
+"""
+
+from __future__ import annotations
+
+FATHA, DAMMA, KASRA, SUKUN, SHADDA = "َ", "ُ", "ِ", "ْ", "ّ"
+_ALL_MARKS = set("ًٌٍَُِّْـ")  # harakat/tanwin/shadda/sukun/tatweel
+
+ARABIC_LETTERS = set("ءآأؤإئابةتثجحخدذرزسشصضطظعغفقكلمنهويى")
+# sun letters assimilate the article's lam (t, th, d, dh, r, z, s, sh,
+# s., d., t., z., l, n)
+SUN_LETTERS = set("تثدذرزسشصضطظلن")
+LONG_VOWELS = set("اويى")
+_LENGTHEN_MARK = {"ا": FATHA, "و": DAMMA, "ي": KASRA, "ى": FATHA}
+_EMPHATIC = set("صضطظقحعغخ")  # fatha-colored
+_LABIAL = set("بمو")          # damma-leaning
+
+
+def _default_mark(ch: str, idx: int) -> str:
+    if ch in _EMPHATIC:
+        return FATHA
+    if ch in _LABIAL:
+        return DAMMA
+    return KASRA if idx % 2 else FATHA
+
+
+def diacritize_word(word: str) -> str:
+    """Apply the rule set to one undiacritized Arabic word."""
+    out = []
+    n = len(word)
+    i = 0
+    # the definite article may follow a one-letter conjunction/preposition
+    # prefix (و ف ب ل ك): وَالقمر, بِالبيت…
+    base = 1 if (n > 4 and word[0] in "وفبلك"
+                 and word[1:].startswith("ال")) else 0
+    article = word.startswith("ال", base) and n - base > 3
+    while i < n:
+        ch = word[i]
+        nxt = word[i + 1] if i + 1 < n else ""
+        out.append(ch)
+        if ch not in ARABIC_LETTERS:
+            i += 1
+            continue
+        if article and base == 1 and i == 0:  # the prefix letter itself
+            out.append(FATHA if ch in "وف" else KASRA)
+            i += 1
+            continue
+        if article and i == base:          # article alif: bare
+            i += 1
+            continue
+        if article and i == base + 1:      # article lam
+            if nxt in SUN_LETTERS:
+                pass                       # assimilated: no mark on lam
+            else:
+                out.append(SUKUN)
+            i += 1
+            continue
+        if article and i == base + 2 and ch in SUN_LETTERS:
+            out.append(SHADDA)
+            out.append(_default_mark(ch, i))
+            i += 1
+            continue
+        # long-vowel carriers stay bare; و/ي are consonantal (w/y) at
+        # word start
+        if ch in "اىآ" or (ch in "وي" and i > 0):
+            i += 1
+            continue
+        if i == n - 1:                     # word-final: pausal sukun
+            if ch == "ة":
+                pass                       # ta marbuta itself stays bare
+            else:
+                out.append(SUKUN)
+            i += 1
+            continue
+        if nxt == "ة":                     # fatha before ta marbuta
+            out.append(FATHA)
+            i += 1
+            continue
+        if nxt in LONG_VOWELS:             # lengthened: mark matches vowel
+            out.append(_LENGTHEN_MARK.get(nxt, FATHA))
+            i += 1
+            continue
+        out.append(_default_mark(ch, i))
+        i += 1
+    return "".join(out)
+
+
+def diacritize(text: str) -> str:
+    """Rule-diacritize running text; non-Arabic spans pass through.
+
+    Existing diacritics are stripped first (same contract as the neural
+    taggers) so pre-marked input is re-diacritized, never double-marked.
+    """
+    text = "".join(ch for ch in text if ch not in _ALL_MARKS)
+    out = []
+    word = []
+    for ch in text:
+        if ch in ARABIC_LETTERS:
+            word.append(ch)
+        else:
+            if word:
+                out.append(diacritize_word("".join(word)))
+                word = []
+            out.append(ch)
+    if word:
+        out.append(diacritize_word("".join(word)))
+    return "".join(out)

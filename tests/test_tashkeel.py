@@ -57,10 +57,18 @@ def test_save_load_roundtrip(tmp_path, model):
     assert back.diacritize("مرحبا") == model.diacritize("مرحبا")
 
 
-def test_engine_identity_fallback():
+def test_engine_rule_fallback():
+    from sonata_tpu.models.tashkeel import strip_diacritics
+    from sonata_tpu.text import tashkeel_rules
+
     eng = TashkeelEngine()
     assert not eng.has_model
-    assert eng.diacritize("مرحبا") == "مرحبا"
+    # no model ⇒ heuristic rules, not an identity pass
+    out = eng.diacritize("مرحبا")
+    assert out == tashkeel_rules.diacritize("مرحبا")
+    assert strip_diacritics(out) == "مرحبا" and len(out) > len("مرحبا")
+    # non-Arabic text passes through untouched
+    assert eng.diacritize("hello") == "hello"
 
 
 def test_arabic_voice_uses_tashkeel_hook():
@@ -84,3 +92,58 @@ def test_arabic_end_to_end_synthesis():
     assert len(audios) == 1
     assert len(audios[0].samples) > 0
     assert np.isfinite(audios[0].samples.data).all()
+
+
+# ---------------------------------------------------------------------------
+# heuristic rule engine + bundled default model
+# ---------------------------------------------------------------------------
+
+def test_rule_diacritizer_basics():
+    from sonata_tpu.models.tashkeel import strip_diacritics
+    from sonata_tpu.text import tashkeel_rules as tr
+
+    out = tr.diacritize("الشمس والقمر")
+    assert strip_diacritics(out) == "الشمس والقمر"
+    assert len(out) > len("الشمس والقمر")  # marks inserted
+    # sun-letter assimilation: shadda on ش, no sukun on the article lam
+    assert "شّ" in out
+    assert "لْش" not in out
+    # moon letter keeps the lam sukun: القمر → لْق
+    assert "لْق" in out
+    # deterministic
+    assert tr.diacritize("الشمس والقمر") == out
+
+
+def test_engine_without_model_applies_rules(monkeypatch):
+    from sonata_tpu.models.tashkeel import strip_diacritics
+    from sonata_tpu.text.tashkeel import TashkeelEngine
+
+    eng = TashkeelEngine()  # no model
+    assert not eng.has_model
+    out = eng.diacritize("كتاب")
+    assert strip_diacritics(out) == "كتاب" and len(out) > 4
+
+
+def test_default_engine_loads_bundled_model(monkeypatch):
+    import pathlib
+
+    import sonata_tpu.text.tashkeel as tk
+
+    bundled = (pathlib.Path(tk.__file__).resolve().parent.parent / "data"
+               / "tashkeel_default.npz")
+    if not bundled.exists():
+        import pytest
+
+        pytest.skip("bundled tashkeel model not built")
+    monkeypatch.delenv("SONATA_TASHKEEL_MODEL", raising=False)
+    monkeypatch.setattr(tk, "_GLOBAL", None)
+    try:
+        eng = tk.get_default_engine()
+        assert eng.has_model
+        from sonata_tpu.models.tashkeel import strip_diacritics
+
+        out = eng.diacritize("السلام عليكم")
+        assert strip_diacritics(out) == "السلام عليكم"
+        assert len(out) > len("السلام عليكم")
+    finally:
+        monkeypatch.setattr(tk, "_GLOBAL", None)

@@ -1,0 +1,153 @@
+"""Train the bundled default tashkeel tagger on the rule engine's output.
+
+No real diacritization corpus can be fetched in this environment (zero
+egress), so the bundled model learns to reproduce
+:mod:`sonata_tpu.text.tashkeel_rules` exactly — a deterministic,
+linguistically-simplified supervision that makes the out-of-the-box
+Arabic chain functional and exercises the full train→save→load→serve
+loop.  Production deployments should point ``SONATA_TASHKEEL_MODEL`` at a
+real libtashkeel artifact.
+
+Run:  python tools/train_tashkeel.py  (writes
+sonata_tpu/data/tashkeel_default.npz; ~2-4 min on the 1-core CPU)
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_FLAGS", "")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from sonata_tpu.models.tashkeel import (  # noqa: E402
+    DIACRITICS,
+    TashkeelHyperParams,
+    TashkeelModel,
+    apply_tashkeel,
+    strip_diacritics,
+)
+from sonata_tpu.text import tashkeel_rules as rules  # noqa: E402
+
+LETTERS = sorted(rules.ARABIC_LETTERS)
+# a sprinkling of real common words keeps the distribution non-uniform
+COMMON = ["السلام", "عليكم", "مرحبا", "العالم", "كتاب", "مدرسة", "الشمس",
+          "القمر", "بيت", "ولد", "بنت", "يوم", "ليل", "صباح", "مساء",
+          "الله", "محمد", "عربي", "لغة", "كلمة", "جملة", "صوت", "كلام"]
+T = 48  # training sequence bucket
+_CLASS_OF = {d: i for i, d in enumerate(DIACRITICS)}
+_DIACRITIC_CHARS = set("".join(DIACRITICS))
+
+
+def random_sentence(rng: random.Random) -> str:
+    words = []
+    for _ in range(rng.randint(2, 5)):
+        if rng.random() < 0.35:
+            words.append(rng.choice(COMMON))
+        else:
+            n = rng.randint(2, 6)
+            w = "".join(rng.choice(LETTERS) for _ in range(n))
+            if rng.random() < 0.25:
+                w = "ال" + w
+            words.append(w)
+    return " ".join(words)
+
+
+def encode_pair(model: TashkeelModel, plain: str, marked: str):
+    """(ids, classes) for one sentence; classes index DIACRITICS."""
+    ids, classes = [], []
+    i = 0
+    for ch in plain:
+        ids.append(model._char_to_id.get(ch, 0))
+        # collect the diacritic run following this char in `marked`
+        assert marked[i] == ch, (plain, marked, i)
+        i += 1
+        run = ""
+        while i < len(marked) and marked[i] in _DIACRITIC_CHARS:
+            run += marked[i]
+            i += 1
+        classes.append(_CLASS_OF.get(run, 0))
+    return ids, classes
+
+
+def make_batch(model: TashkeelModel, rng: random.Random, batch: int):
+    xs = np.zeros((batch, T), np.int32)
+    ys = np.zeros((batch, T), np.int32)
+    mask = np.zeros((batch, T), np.float32)
+    lens = np.zeros((batch,), np.int32)
+    for b in range(batch):
+        s = random_sentence(rng)[:T]
+        ids, classes = encode_pair(model, s, rules.diacritize(s))
+        n = len(ids)
+        xs[b, :n], ys[b, :n] = ids, classes
+        mask[b, :n] = 1.0
+        lens[b] = n
+    return jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(mask), \
+        jnp.asarray(lens)
+
+
+def main() -> None:
+    hp = TashkeelHyperParams(hidden=96, filter=256, n_heads=2, n_layers=2,
+                             kernel=3, window=8)
+    model = TashkeelModel.random(hp, seed=0)
+    params = model.params
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, xs, ys, mask, lens):
+        def loss_fn(p):
+            logits = apply_tashkeel(p, hp, xs, lens)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, ys)
+            return (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = random.Random(0)
+    steps = int(os.environ.get("TASHKEEL_STEPS", 400))
+    for it in range(steps):
+        xs, ys, mask, lens = make_batch(model, rng, 32)
+        params, opt_state, loss = step(params, opt_state, xs, ys, mask, lens)
+        if it % 50 == 0 or it == steps - 1:
+            print(f"step {it}: loss {float(loss):.4f}", flush=True)
+
+    # held-out exact-class accuracy
+    model.params = params
+    eval_rng = random.Random(999)
+    correct = total = 0
+    for _ in range(50):
+        s = random_sentence(eval_rng)[:T]
+        golden = rules.diacritize(s)
+        got = model.diacritize(s)
+        # compare class-by-class via re-encode
+        _, want = encode_pair(model, s, golden)
+        _, have = encode_pair(model, strip_diacritics(got), got)
+        correct += sum(int(a == b) for a, b in zip(want, have))
+        total += len(want)
+    acc = correct / max(total, 1)
+    print(f"held-out class accuracy: {acc:.4f}")
+
+    if acc < 0.97:
+        print("FAILED: accuracy below 0.97 — bundled model NOT written")
+        sys.exit(1)
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "sonata_tpu", "data",
+        "tashkeel_default.npz")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    model.save(out)
+    print(f"saved {out} ({os.path.getsize(out) / 1e6:.2f} MB)")
+
+
+if __name__ == "__main__":
+    main()
