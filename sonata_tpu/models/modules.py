@@ -317,19 +317,22 @@ def init_wn(rng, *, hidden, kernel, dilation_rate, n_layers, gin_channels=0):
     return p
 
 
-def wn(x, mask, p, *, kernel, dilation_rate, n_layers, g=None):
+def wn(x, mask, p, *, kernel, dilation_rate, n_layers, g=None, conv=None):
     """Non-causal WaveNet: dilated convs, gated tanh units, residual+skip.
 
     ``x: [B, T, H]``; ``g: [B, 1, gin]`` speaker conditioning or None.
     The gate runs through :func:`sonata_tpu.ops.gate.fused_gate` — a Pallas
-    kernel on TPU, plain jnp elsewhere.
+    kernel on TPU, plain jnp elsewhere.  ``conv`` overrides the dilated
+    conv primitive (sequence-sharded callers inject a halo-exchange
+    version); pointwise convs never need halos and stay plain.
     """
+    conv = conv or conv1d
     hidden = x.shape[-1]
     output = jnp.zeros_like(x)
     if g is not None and "cond" in p:
         g_all = conv1d(g, p["cond"])  # [B, 1, 2*H*n_layers]
     for i in range(n_layers):
-        x_in = conv1d(x, p["in"][i], dilation=dilation_rate ** i)
+        x_in = conv(x, p["in"][i], dilation=dilation_rate ** i)
         g_l = None
         if g is not None and "cond" in p:
             g_l = lax.dynamic_slice_in_dim(g_all, i * 2 * hidden, 2 * hidden, axis=2)

@@ -407,7 +407,7 @@ class PiperVoice(BaseModel):
         return fn
 
     @staticmethod
-    def _decode_quantize(params, hp, z, y_lengths, g):
+    def _decode_quantize(params, hp, z, y_lengths, g, mesh=None):
         """HiFi-GAN decode + on-device peak-scaled i16 quantization.
 
         i16 quarters the host transfer, which dominates when the chip sits
@@ -419,7 +419,7 @@ class PiperVoice(BaseModel):
         The single definition of the quantization contract — every path that
         decodes a full batch goes through here.
         """
-        wav = vits.decode(params, hp, z, g=g)
+        wav = vits.decode(params, hp, z, g=g, mesh=mesh)
         wav_lengths = y_lengths * hp.hop_length
         valid = (jnp.arange(wav.shape[1])[None, :] < wav_lengths[:, None])
         peak = jnp.max(jnp.abs(wav) * valid, axis=1, keepdims=True)
@@ -434,12 +434,14 @@ class PiperVoice(BaseModel):
             if fn is None:
                 hp = self.hp
                 max_frames = f
+                mesh = self.mesh
 
                 def body(params, m_p, logs_p, w_ceil, x_mask, rng,
                          noise_scale, g):
                     z, y_mask, y_lengths = vits.acoustics(
                         params, hp, m_p, logs_p, w_ceil, x_mask, rng,
-                        noise_scale=noise_scale, max_frames=max_frames, g=g)
+                        noise_scale=noise_scale, max_frames=max_frames, g=g,
+                        mesh=mesh)
                     return z, y_lengths
 
                 # signature arity must match the call exactly so that mesh
@@ -492,9 +494,10 @@ class PiperVoice(BaseModel):
                     frames_needed = jnp.sum(w_ceil, axis=1).astype(jnp.int32)
                     z, y_mask, y_lengths = vits.acoustics(
                         params, hp, m_p, logs_p, w_ceil, x_mask, rng_noise,
-                        noise_scale=noise_scale, max_frames=max_frames, g=g)
+                        noise_scale=noise_scale, max_frames=max_frames, g=g,
+                        mesh=mesh)
                     wav_i16, wav_lengths, peaks = self._decode_quantize(
-                        params, hp, z, y_lengths, g)
+                        params, hp, z, y_lengths, g, mesh=mesh)
                     return wav_i16, wav_lengths, peaks, frames_needed
 
                 if self.multi_speaker:

@@ -265,7 +265,8 @@ def generate_path(w_ceil, x_mask, max_frames: int):
 
 
 def acoustics(p: Params, hp: VitsHyperParams, m_p, logs_p, w_ceil, x_mask,
-              rng, *, noise_scale: float, max_frames: int, g=None):
+              rng, *, noise_scale: float, max_frames: int, g=None,
+              mesh=None):
     """Durations + priors → latent ``z`` [B, F, C] and frame mask."""
     y_lengths = jnp.clip(jnp.sum(w_ceil, axis=1), 1, max_frames).astype(jnp.int32)
     y_mask = sequence_mask(y_lengths, max_frames)  # [B, F, 1]
@@ -276,18 +277,40 @@ def acoustics(p: Params, hp: VitsHyperParams, m_p, logs_p, w_ceil, x_mask,
     noise_scale = jnp.reshape(jnp.asarray(noise_scale, jnp.float32),
                               (-1, 1, 1))  # scalar or per-row [B]
     z_p = m_p_f + noise * jnp.exp(logs_p_f) * noise_scale
-    z = flow_reverse(p["flow"], hp, z_p, y_mask, g=g)
+    if _use_seq_parallel(mesh, max_frames, hp):
+        from .seq_parallel import flow_reverse_sp
+
+        z = flow_reverse_sp(p["flow"], hp, z_p, y_mask, mesh, g=g)
+    else:
+        z = flow_reverse(p["flow"], hp, z_p, y_mask, g=g)
     return z * y_mask, y_mask, y_lengths
 
 
-def flow_reverse(pf: Params, hp: VitsHyperParams, z, mask, g=None):
+def _use_seq_parallel(mesh, frames: int, hp: VitsHyperParams) -> bool:
+    """Frame-domain ops shard over the seq axis when the mesh has one and
+    the per-shard frame count leaves room for every conv halo (the halos
+    are neighbor-only, so each stage's local length must cover its
+    largest receptive-field reach — derived from hp, not hard-coded)."""
+    if mesh is None:
+        return False
+    seq = mesh.shape.get("seq", 1)
+    if seq <= 1 or frames % seq:
+        return False
+    from .seq_parallel import min_local_frames
+
+    return frames // seq >= min_local_frames(hp)
+
+
+def flow_reverse(pf: Params, hp: VitsHyperParams, z, mask, g=None,
+                 conv=None):
     half = hp.inter_channels // 2
     for layer in reversed(pf["layers"]):
         z = z[..., ::-1]  # Flip (reverse order: undo the flip first)
         z0, z1 = z[..., :half], z[..., half:]
         h = m.conv1d(z0, layer["pre"]) * mask
         h = m.wn(h, mask, layer["wn"], kernel=hp.flow_kernel_size,
-                 dilation_rate=1, n_layers=hp.flow_wn_layers, g=g)
+                 dilation_rate=1, n_layers=hp.flow_wn_layers, g=g,
+                 conv=conv)
         mean = m.conv1d(h, layer["post"]) * mask
         z1 = (z1 - mean) * mask  # mean-only coupling, reverse
         z = jnp.concatenate([z0, z1], axis=-1)
@@ -298,39 +321,58 @@ def flow_reverse(pf: Params, hp: VitsHyperParams, z, mask, g=None):
 # stage 3: HiFi-GAN decoder
 # ---------------------------------------------------------------------------
 
-def decode(p: Params, hp: VitsHyperParams, z, g=None):
+def decode(p: Params, hp: VitsHyperParams, z, g=None, mesh=None):
     """Latent ``z`` [B, F, C] → waveform [B, F * hop].
 
     The FLOPs live here (upsampling convs); channels shrink as time grows,
-    keeping every conv an MXU-friendly matmul over the channel dim.
+    keeping every conv an MXU-friendly matmul over the channel dim.  With
+    a seq-axis mesh the frames (and output samples) shard across chips
+    (:mod:`.seq_parallel`).
     """
+    if _use_seq_parallel(mesh, z.shape[1], hp):
+        from .seq_parallel import decode_sp
+
+        return decode_sp(p, hp, z, mesh, g=g)
+    return decode_with(p, hp, z, g=g)
+
+
+def decode_with(p: Params, hp: VitsHyperParams, z, g=None, conv=None,
+                tconv=None):
+    """:func:`decode` body with injectable conv primitives — the
+    sequence-sharded path passes halo-exchange versions, so the model
+    math exists exactly once."""
+    conv = conv or m.conv1d
+    tconv = tconv or (lambda x, p_, *, stride, padding:
+                      m.conv_transpose1d(x, p_, stride=stride,
+                                         padding=padding))
     pd = p["dec"]
-    x = m.conv1d(z, pd["conv_pre"])
+    x = conv(z, pd["conv_pre"])
     if g is not None and "cond" in pd:
         x = x + m.conv1d(g, pd["cond"])
     n_kernels = len(hp.resblock_kernel_sizes)
     for i, (r_up, k_up) in enumerate(zip(hp.upsample_rates, hp.upsample_kernel_sizes)):
         x = jax.nn.leaky_relu(x, m.LRELU_SLOPE)
-        x = m.conv_transpose1d(x, pd["ups"][i], stride=r_up,
-                               padding=(k_up - r_up) // 2)
+        x = tconv(x, pd["ups"][i], stride=r_up,
+                  padding=(k_up - r_up) // 2)
         xs = None
         for j in range(n_kernels):
             block = pd["resblocks"][i * n_kernels + j]
             y = _resblock1(block, x, hp.resblock_kernel_sizes[j],
-                           hp.resblock_dilation_sizes[j])
+                           hp.resblock_dilation_sizes[j], conv=conv)
             xs = y if xs is None else xs + y
         x = xs / n_kernels
     x = jax.nn.leaky_relu(x, m.LRELU_SLOPE)
-    x = m.conv1d(x, pd["conv_post"])
+    x = conv(x, pd["conv_post"])
     return jnp.tanh(x)[..., 0]  # [B, samples]
 
 
-def _resblock1(block: Params, x, kernel: int, dilations):
+def _resblock1(block: Params, x, kernel: int, dilations, conv=None):
+    conv = conv or m.conv1d
     for c1, c2, d in zip(block["convs1"], block["convs2"], dilations):
         y = jax.nn.leaky_relu(x, m.LRELU_SLOPE)
-        y = m.conv1d(y, c1, dilation=d)
+        y = conv(y, c1, dilation=d)
         y = jax.nn.leaky_relu(y, m.LRELU_SLOPE)
-        y = m.conv1d(y, c2)
+        y = conv(y, c2)
         x = x + y
     return x
 
