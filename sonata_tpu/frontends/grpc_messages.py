@@ -100,6 +100,18 @@ class Utterance(Message):
         "text": Field(2, "string"),
         "speech_args": Field(3, "message", SpeechArgs),
         "synthesis_mode": Field(4, "enum"),
+        # sonata-tpu extensions: per-request realtime chunk scheduling
+        # (0/absent ⇒ the reference's hardcoded 55/3)
+        "realtime_chunk_size": Field(5, "uint32"),
+        "realtime_chunk_padding": Field(6, "uint32"),
+    }
+
+
+class VoiceList(Message):
+    """sonata-tpu extension: catalog of loaded voices."""
+
+    FIELDS = {
+        "voices": Field(1, "message", VoiceInfo, repeated=True),
     }
 
 

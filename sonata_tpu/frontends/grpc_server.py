@@ -256,13 +256,25 @@ class SonataGrpcService:
         except SonataError as e:
             context.abort(_status_for(e), str(e))
 
+    def ListVoices(self, request: pb.Empty, context) -> pb.VoiceList:
+        """sonata-tpu extension: catalog of loaded voices (the reference
+        has no listing endpoint)."""
+        with self._lock:
+            voices = list(self._voices.values())
+        return pb.VoiceList(voices=[self._voice_info(v) for v in voices])
+
     def SynthesizeUtteranceRealtime(self, request: pb.Utterance,
                                     context) -> Iterator[pb.WaveSamples]:
         v = self._get(request.voice_id, context)
         cfg = self._speech_args_config(request.speech_args)
+        # per-request chunk negotiation (sonata-tpu extension); absent/0
+        # fields keep the reference's hardcoded schedule (main.rs:383)
+        chunk_size = int(request.realtime_chunk_size or 0) or 55
+        chunk_padding = int(request.realtime_chunk_padding or 0) or 3
         try:
             stream = v.synth.synthesize_streamed(
-                request.text, cfg, chunk_size=55, chunk_padding=3)  # :383
+                request.text, cfg, chunk_size=chunk_size,
+                chunk_padding=chunk_padding)
             for chunk in stream:
                 yield pb.WaveSamples(wav_samples=chunk.as_wave_bytes())
         except SonataError as e:
@@ -279,6 +291,7 @@ _METHODS = {
                             False),
     "SynthesizeUtterance": (pb.Utterance, pb.SynthesisResult, True),
     "SynthesizeUtteranceRealtime": (pb.Utterance, pb.WaveSamples, True),
+    "ListVoices": (pb.Empty, pb.VoiceList, False),
 }
 
 

@@ -253,3 +253,38 @@ def test_load_voice_empty_path_invalid_argument(server_and_voice):
     with pytest.raises(grpc.RpcError) as e:
         _unary(channel, "LoadVoice", pb.VoicePath(), pb.VoiceInfo)
     assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+# ---------------------------------------------------------------------------
+# sonata-tpu service extensions (additive; absent from the reference)
+# ---------------------------------------------------------------------------
+
+def test_list_voices_catalog(server_and_voice):
+    channel, cfg = server_and_voice
+    vid = _unary(channel, "LoadVoice", pb.VoicePath(config_path=cfg),
+                 pb.VoiceInfo).voice_id
+    catalog = _unary(channel, "ListVoices", pb.Empty(), pb.VoiceList)
+    assert any(v.voice_id == vid for v in catalog.voices)
+    entry = next(v for v in catalog.voices if v.voice_id == vid)
+    assert entry.audio.sample_rate > 0
+
+
+def test_realtime_chunk_negotiation(server_and_voice):
+    """Clients may pick their own chunk schedule; smaller chunks produce
+    at least as many chunks as the 55/3 default for the same text."""
+    channel, cfg = server_and_voice
+    vid = _unary(channel, "LoadVoice", pb.VoicePath(config_path=cfg),
+                 pb.VoiceInfo).voice_id
+    text = ("A much longer sentence with very many words to force the "
+            "chunker to produce several chunks either way.")
+    small = _stream(channel, "SynthesizeUtteranceRealtime",
+                    pb.Utterance(voice_id=vid, text=text,
+                                 realtime_chunk_size=10,
+                                 realtime_chunk_padding=2),
+                    pb.WaveSamples)
+    default = _stream(channel, "SynthesizeUtteranceRealtime",
+                      pb.Utterance(voice_id=vid, text=text),
+                      pb.WaveSamples)
+    assert small and default
+    assert len(small) >= len(default)
+    assert all(len(c.wav_samples) > 0 for c in small)
