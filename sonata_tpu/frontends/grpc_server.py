@@ -269,8 +269,8 @@ class SonataGrpcService:
         cfg = self._speech_args_config(request.speech_args)
         # per-request chunk negotiation (sonata-tpu extension); absent/0
         # fields keep the reference's hardcoded schedule (main.rs:383)
-        chunk_size = int(request.realtime_chunk_size or 0) or 55
-        chunk_padding = int(request.realtime_chunk_padding or 0) or 3
+        chunk_size = request.realtime_chunk_size or 55
+        chunk_padding = request.realtime_chunk_padding or 3
         try:
             stream = v.synth.synthesize_streamed(
                 request.text, cfg, chunk_size=chunk_size,

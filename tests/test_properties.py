@@ -146,3 +146,58 @@ def test_packed_repeated_scalars_decode():
     msg = R.decode(payload)
     assert msg.vals == [1, 300, 7]
     assert msg.floats == [1.0, -2.5, 3.25]
+
+
+# ---------------------------------------------------------------------------
+# hermetic G2P lexicon / Arabic rule engine (round-2 additions)
+# ---------------------------------------------------------------------------
+
+@given(st.text(alphabet=st.characters(min_codepoint=97, max_codepoint=122),
+               min_size=1, max_size=14))
+@settings(max_examples=200, deadline=None)
+def test_lexicon_derive_total_function(word):
+    """derive() never crashes and never returns an empty pronunciation for
+    any lowercase ASCII word."""
+    from sonata_tpu.text.lexicon import derive
+
+    out = derive(word)
+    assert out is None or (isinstance(out, str) and out)
+
+
+@given(st.text(alphabet=st.characters(min_codepoint=97, max_codepoint=122),
+               min_size=1, max_size=14))
+@settings(max_examples=200, deadline=None)
+def test_rule_g2p_total_and_stress_sane(word):
+    from sonata_tpu.text.rule_g2p import english_word_to_ipa
+
+    ipa = english_word_to_ipa(word)
+    assert isinstance(ipa, str)
+    assert ipa.count("ˈ") <= 1  # at most one primary stress inserted
+
+
+@given(st.text(alphabet="ءآأؤإئابةتثجحخدذرزسشصضطظعغفقكلمنهويى ",
+               min_size=0, max_size=40))
+@settings(max_examples=200, deadline=None)
+def test_tashkeel_rules_strip_roundtrip(text):
+    """Rule diacritization only ever inserts marks: stripping them
+    recovers the input exactly, for any Arabic-letter string."""
+    from sonata_tpu.models.tashkeel import strip_diacritics
+    from sonata_tpu.text import tashkeel_rules
+
+    out = tashkeel_rules.diacritize(text)
+    assert strip_diacritics(out) == text
+
+
+@given(st.lists(st.sampled_from(
+    list("ًٌٍَُِّْ")), min_size=0, max_size=6),
+    st.text(alphabet="ابتثجحخ", min_size=1, max_size=8))
+@settings(max_examples=100, deadline=None)
+def test_tashkeel_rules_idempotent_under_premarking(marks, base):
+    """Pre-existing diacritics anywhere in the input never change the
+    result (they are stripped before re-diacritization)."""
+    from sonata_tpu.text import tashkeel_rules
+
+    clean = tashkeel_rules.diacritize(base)
+    # interleave stray marks into the input
+    noisy = base[: len(base) // 2] + "".join(marks) + base[len(base) // 2:]
+    assert tashkeel_rules.diacritize(noisy) == clean
