@@ -19,6 +19,24 @@ SENTENCE = ("Streaming synthesis should deliver the first chunk quickly "
 
 
 def main() -> None:
+    from bench import _accelerator_ready
+
+    if _accelerator_ready() is None:
+        # one parseable error line per metric this script would report
+        for metric, unit in (
+                ("streaming_ttfb_p50", "ms"),
+                ("concurrent_streaming_audio_s_per_s",
+                 "audio_seconds_per_second"),
+                ("streaming_ttfb_p50_at_4_streams", "ms"),
+                ("streaming_ttfb_p50_at_8_streams", "ms"),
+                ("stream_decode_coalescing_ratio", "requests_per_dispatch")):
+            print(json.dumps({
+                "metric": metric, "value": None, "unit": unit,
+                "vs_baseline": None,
+                "error": "accelerator backend unavailable (init timeout)",
+            }))
+        return
+
     from sonata_tpu.models import PiperVoice
     from sonata_tpu.synth import SpeechSynthesizer
 

@@ -257,3 +257,19 @@ def test_full_batch_hlo_shards_frame_domain():
                        ones, ones, ones)
     hlo = lowered.compile().as_text()
     assert hlo.count("collective-permute") >= 4
+
+
+def test_long_utterance_spans_seq_shards():
+    """A genuinely long utterance (frame bucket >= 256 ⇒ 128 frames per
+    shard at seq=2) produces identical audio sharded vs unsharded — the
+    long-context path, with the latent and waveform split across chips."""
+    mesh = make_mesh(8, seq_parallel=2)
+    v_plain = tiny_voice(seed=23)
+    v_mesh = PiperVoice(v_plain.config, v_plain.params, seed=23, mesh=mesh)
+    long_text = " ".join(["wʌn tuː θɹiː fɔːɹ faɪv sɪks"] * 8) + "."
+    a_plain = v_plain.speak_batch([long_text])
+    a_mesh = v_mesh.speak_batch([long_text])
+    assert len(a_plain[0].samples) == len(a_mesh[0].samples)
+    assert len(a_plain[0].samples) > 3000  # actually long
+    np.testing.assert_allclose(a_plain[0].samples.data,
+                               a_mesh[0].samples.data, atol=2e-4)
