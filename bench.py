@@ -123,11 +123,17 @@ def main() -> None:
 
     iters = 5
     total_audio = 0.0
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        audios = voice.speak_batch(phonemes)
-        total_audio += sum(a.duration_ms() for a in audios) / 1000.0
-    elapsed = time.perf_counter() - t0
+    profile_dir = os.environ.get("SONATA_PROFILE")  # xprof trace target
+    import contextlib
+
+    ctx = (jax.profiler.trace(profile_dir) if profile_dir
+           else contextlib.nullcontext())
+    with ctx:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            audios = voice.speak_batch(phonemes)
+            total_audio += sum(a.duration_ms() for a in audios) / 1000.0
+        elapsed = time.perf_counter() - t0
     rtf = elapsed / max(total_audio, 1e-9)
 
     print(json.dumps({

@@ -353,9 +353,24 @@ def main(argv=None) -> int:
     ap.add_argument("--continuous-batching", action="store_true",
                     help="coalesce concurrent requests into shared device "
                          "dispatches")
+    ap.add_argument("--mesh-devices", type=int, default=0,
+                    help="attach an N-device jax mesh to every loaded "
+                         "voice (0 = single device)")
+    ap.add_argument("--seq-parallel", type=int, default=1,
+                    help="of the mesh devices, how many form the sequence"
+                         "-parallel axis (ring attention + frame-domain "
+                         "sharding); must divide --mesh-devices")
     args = ap.parse_args(argv)
 
-    server, port = create_server(args.port, host=args.host,
+    mesh = None
+    if args.mesh_devices:
+        from ..parallel import make_mesh
+
+        mesh = make_mesh(args.mesh_devices, seq_parallel=args.seq_parallel)
+    elif args.seq_parallel > 1:
+        ap.error("--seq-parallel requires --mesh-devices")
+
+    server, port = create_server(args.port, host=args.host, mesh=mesh,
                                  continuous_batching=args.continuous_batching)
     server.start()
     log.info("sonata-tpu gRPC server v%s listening on %s:%d",

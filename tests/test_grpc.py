@@ -288,3 +288,30 @@ def test_realtime_chunk_negotiation(server_and_voice):
     assert small and default
     assert len(small) >= len(default)
     assert all(len(c.wav_samples) > 0 for c in small)
+
+
+def test_server_main_mesh_flags(monkeypatch):
+    """--mesh-devices/--seq-parallel build the mesh the service attaches
+    to loaded voices (flag parsing + make_mesh wiring, no serving)."""
+    import sonata_tpu.frontends.grpc_server as gs
+
+    captured = {}
+
+    def fake_create(port=None, *, mesh=None, **kw):
+        captured["mesh"] = mesh
+
+        class _S:
+            def start(self):
+                pass
+
+            def wait_for_termination(self):
+                raise KeyboardInterrupt  # exit main immediately
+
+            def stop(self, grace=None):
+                pass
+
+        return _S(), 1
+    monkeypatch.setattr(gs, "create_server", fake_create)
+    gs.main(["--mesh-devices", "8", "--seq-parallel", "2"])
+    assert captured["mesh"] is not None
+    assert dict(captured["mesh"].shape) == {"data": 4, "seq": 2}
