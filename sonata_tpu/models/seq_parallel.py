@@ -101,11 +101,12 @@ def _flow_reverse_local(pf: Params, hp: VitsHyperParams, z, mask, g):
     return vits.flow_reverse(pf, hp, z, mask, g=g, conv=_conv_halo)
 
 
-def _decode_local_impl(p: Params, hp: VitsHyperParams, z, g):
+def _decode_local_impl(p: Params, hp: VitsHyperParams, z, g,
+                       compute_dtype=None):
     from . import vits
 
     return vits.decode_with(p, hp, z, g=g, conv=_conv_halo,
-                            tconv=_tconv_halo)
+                            tconv=_tconv_halo, compute_dtype=compute_dtype)
 
 
 def flow_reverse_sp(pf: Params, hp: VitsHyperParams, z, mask, mesh, g=None):
@@ -124,20 +125,24 @@ def flow_reverse_sp(pf: Params, hp: VitsHyperParams, z, mask, mesh, g=None):
     return fn(z, mask, g, pf)
 
 
-def decode_sp(p: Params, hp: VitsHyperParams, z, mesh, g=None):
+def decode_sp(p: Params, hp: VitsHyperParams, z, mesh, g=None,
+              compute_dtype=None):
     """Sequence-parallel :func:`vits.decode`: frames sharded over the seq
     axis; returns the waveform [B, F*hop] with samples sharded the same
-    way."""
+    way.  ``compute_dtype`` follows the same reduced-precision policy as
+    the unsharded path (halo exchanges ride the narrower dtype too)."""
     spec_z = P(DATA_AXIS, SEQ_AXIS, None)
     spec_out = P(DATA_AXIS, SEQ_AXIS)
     g_spec = P(DATA_AXIS, None, None)
     pd = {"dec": p["dec"]}  # decode only touches the generator subtree
     if g is None:
         fn = shard_map(
-            lambda zz, pp: _decode_local_impl(pp, hp, zz, None),
+            lambda zz, pp: _decode_local_impl(pp, hp, zz, None,
+                                              compute_dtype=compute_dtype),
             mesh=mesh, in_specs=(spec_z, P()), out_specs=spec_out)
         return fn(z, pd)
     fn = shard_map(
-        lambda zz, gg, pp: _decode_local_impl(pp, hp, zz, gg),
+        lambda zz, gg, pp: _decode_local_impl(pp, hp, zz, gg,
+                                              compute_dtype=compute_dtype),
         mesh=mesh, in_specs=(spec_z, g_spec, P()), out_specs=spec_out)
     return fn(z, g, pd)

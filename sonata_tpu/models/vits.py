@@ -321,23 +321,31 @@ def flow_reverse(pf: Params, hp: VitsHyperParams, z, mask, g=None,
 # stage 3: HiFi-GAN decoder
 # ---------------------------------------------------------------------------
 
-def decode(p: Params, hp: VitsHyperParams, z, g=None, mesh=None):
+def decode(p: Params, hp: VitsHyperParams, z, g=None, mesh=None,
+           compute_dtype=None):
     """Latent ``z`` [B, F, C] → waveform [B, F * hop].
 
     The FLOPs live here (upsampling convs); channels shrink as time grows,
     keeping every conv an MXU-friendly matmul over the channel dim.  With
     a seq-axis mesh the frames (and output samples) shard across chips
     (:mod:`.seq_parallel`).
+
+    ``compute_dtype``: optional reduced-precision policy for the conv
+    stack (``jnp.bfloat16`` keeps the MXU in its native mode — one
+    hardware pass instead of three for float32).  Weights and activations
+    are cast on entry; the output returns to float32 before ``tanh`` so
+    the final waveform (and its downstream i16 quantization) stays
+    full-precision at the last nonlinearity.
     """
     if _use_seq_parallel(mesh, z.shape[1], hp):
         from .seq_parallel import decode_sp
 
-        return decode_sp(p, hp, z, mesh, g=g)
-    return decode_with(p, hp, z, g=g)
+        return decode_sp(p, hp, z, mesh, g=g, compute_dtype=compute_dtype)
+    return decode_with(p, hp, z, g=g, compute_dtype=compute_dtype)
 
 
 def decode_with(p: Params, hp: VitsHyperParams, z, g=None, conv=None,
-                tconv=None):
+                tconv=None, compute_dtype=None):
     """:func:`decode` body with injectable conv primitives — the
     sequence-sharded path passes halo-exchange versions, so the model
     math exists exactly once."""
@@ -346,6 +354,14 @@ def decode_with(p: Params, hp: VitsHyperParams, z, g=None, conv=None,
                       m.conv_transpose1d(x, p_, stride=stride,
                                          padding=padding))
     pd = p["dec"]
+    if compute_dtype is not None:
+        # on-device cast of the decoder weights: pure HBM traffic (~0.1 ms
+        # for the full stack), repaid many times over by MXU-native convs
+        pd = jax.tree_util.tree_map(
+            lambda a: a.astype(compute_dtype), pd)
+        z = z.astype(compute_dtype)
+        if g is not None:
+            g = g.astype(compute_dtype)
     x = conv(z, pd["conv_pre"])
     if g is not None and "cond" in pd:
         x = x + m.conv1d(g, pd["cond"])
@@ -363,7 +379,7 @@ def decode_with(p: Params, hp: VitsHyperParams, z, g=None, conv=None,
         x = xs / n_kernels
     x = jax.nn.leaky_relu(x, m.LRELU_SLOPE)
     x = conv(x, pd["conv_post"])
-    return jnp.tanh(x)[..., 0]  # [B, samples]
+    return jnp.tanh(x.astype(jnp.float32))[..., 0]  # [B, samples]
 
 
 def _resblock1(block: Params, x, kernel: int, dilations, conv=None):

@@ -96,17 +96,32 @@ class SpeechSynthesizer:
             processed.peak_normalize = False
         return Audio(processed, audio.info, inference_ms=audio.inference_ms)
 
+    @staticmethod
+    def _check_output_config(output_config) -> None:
+        """Fail fast on a wrong positional: the config is used mid-stream,
+        where a type error would otherwise surface as a confusing
+        AttributeError from a worker thread."""
+        if output_config is not None and not isinstance(
+                output_config, AudioOutputConfig):
+            raise OperationError(
+                "output_config must be an AudioOutputConfig or None, got "
+                f"{type(output_config).__name__} (chunk_size is a keyword "
+                "argument: synthesize_streamed(text, chunk_size=..., "
+                "chunk_padding=...))")
+
     # -- modes ---------------------------------------------------------------
     def synthesize_lazy(
         self, text: str,
         output_config: Optional[AudioOutputConfig] = None,
     ) -> "SpeechStreamLazy":
+        self._check_output_config(output_config)
         return SpeechStreamLazy(self, self.phonemize_text(text), output_config)
 
     def synthesize_parallel(
         self, text: str,
         output_config: Optional[AudioOutputConfig] = None,
     ) -> "SpeechStreamBatched":
+        self._check_output_config(output_config)
         return SpeechStreamBatched(self, self.phonemize_text(text),
                                    output_config)
 
@@ -119,6 +134,7 @@ class SpeechSynthesizer:
         output_config: Optional[AudioOutputConfig] = None,
         chunk_size: int = 45, chunk_padding: int = 3,
     ) -> "RealtimeSpeechStream":
+        self._check_output_config(output_config)
         if not self.model.supports_streaming_output():
             raise OperationError("model does not support streamed synthesis")
         return RealtimeSpeechStream(self, self.phonemize_text(text),

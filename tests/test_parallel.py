@@ -273,3 +273,29 @@ def test_long_utterance_spans_seq_shards():
     assert len(a_plain[0].samples) > 3000  # actually long
     np.testing.assert_allclose(a_plain[0].samples.data,
                                a_mesh[0].samples.data, atol=2e-4)
+
+
+def test_decode_sp_bfloat16_close_to_unsharded_bf16():
+    """The reduced-precision policy threads through the seq-parallel
+    decoder (halo exchanges ride bfloat16): sharded-bf16 must match
+    unsharded-bf16 exactly (same ops), and sit near float32."""
+    import jax.numpy as jnp
+
+    from sonata_tpu.models import vits
+    from sonata_tpu.models.seq_parallel import decode_sp
+
+    v = tiny_voice(seed=3)
+    hp, p = v.hp, v.params
+    F = 64
+    mesh = make_mesh(8, seq_parallel=2)
+    B = mesh.shape["data"]
+    z = jax.random.normal(jax.random.PRNGKey(1), (B, F, hp.inter_channels))
+    sharded = np.asarray(decode_sp(p, hp, z, mesh,
+                                   compute_dtype=jnp.bfloat16))
+    unsharded = np.asarray(vits.decode(p, hp, z,
+                                       compute_dtype=jnp.bfloat16))
+    f32 = np.asarray(vits.decode(p, hp, z))
+    np.testing.assert_allclose(sharded, unsharded, atol=2e-5)
+    assert np.isfinite(sharded).all()
+    # bf16 waveform tracks f32 loosely (8-bit mantissa conv stack)
+    assert np.abs(sharded - f32).max() < 0.1

@@ -317,3 +317,63 @@ def test_per_row_scales_in_one_batch(voice):
     assert n1 > 2.3 * n0
     with pytest.raises(Exception):
         voice.speak_batch([ph], scales=[base_cfg, long_cfg])  # len mismatch
+
+
+# ---------------------------------------------------------------------------
+# reduced-precision compute policy (SONATA_COMPUTE_DTYPE / compute_dtype)
+# ---------------------------------------------------------------------------
+
+def test_compute_dtype_parsing(monkeypatch):
+    import jax.numpy as jnp
+
+    from sonata_tpu.core import OperationError
+
+    from voices import tiny_voice
+
+    assert tiny_voice().compute_dtype is None
+    assert tiny_voice(seed=1).compute_dtype is None
+    v = tiny_voice(seed=2)
+    assert v.compute_dtype is None
+    for spelling in ("bfloat16", "bf16"):
+        assert PiperVoiceCD(spelling).compute_dtype == jnp.bfloat16
+    for spelling in ("float32", "f32", None):
+        assert PiperVoiceCD(spelling).compute_dtype is None
+    with pytest.raises(OperationError):
+        PiperVoiceCD("float16")
+    # env var drives the default
+    monkeypatch.setenv("SONATA_COMPUTE_DTYPE", "bfloat16")
+    assert tiny_voice(seed=3).compute_dtype == jnp.bfloat16
+
+
+def PiperVoiceCD(spelling):
+    from voices import tiny_voice
+
+    return tiny_voice(seed=9, compute_dtype=spelling)
+
+
+def test_bfloat16_decode_close_to_float32():
+    # same voice, same seed, bf16 conv stack: audio must stay close to the
+    # float32 waveform (output itself returns to f32 before tanh)
+    from voices import tiny_voice
+
+    ph = "ðɪs ɪz ə tɛst sɛntəns."
+    a32 = tiny_voice(seed=4).speak_batch([ph])[0]
+    a16 = tiny_voice(seed=4, compute_dtype="bfloat16").speak_batch([ph])[0]
+    assert len(a32.samples) == len(a16.samples)
+    x32 = np.asarray(a32.samples.data, np.float64)
+    x16 = np.asarray(a16.samples.data, np.float64)
+    assert np.isfinite(x16).all()
+    err = x16 - x32
+    denom = max(float((x32 ** 2).mean()), 1e-12)
+    snr_db = 10 * np.log10(denom / max(float((err ** 2).mean()), 1e-30))
+    assert snr_db > 25.0, f"bf16 decode SNR too low: {snr_db:.1f} dB"
+
+
+def test_bfloat16_streaming_window_decode():
+    # the streaming window decoder caches carry the policy too
+    from voices import tiny_voice
+
+    v = tiny_voice(seed=5, compute_dtype="bf16")
+    chunks = list(v.stream_synthesis("ə lɒŋɡɚ tɛst sɛntəns hɪɹ.", 12, 2))
+    assert chunks and all(np.isfinite(np.asarray(c.samples.data)).all()
+                          for c in chunks)
