@@ -19,9 +19,9 @@ SENTENCE = ("Streaming synthesis should deliver the first chunk quickly "
 
 
 def main() -> None:
-    from bench import _accelerator_ready
+    from bench import accelerator_ready_with_retries
 
-    if _accelerator_ready() is None:
+    if accelerator_ready_with_retries() is None:
         # one parseable error line per metric this script would report
         for metric, unit in (
                 ("streaming_ttfb_p50", "ms"),

@@ -1,0 +1,77 @@
+"""Build hooks: pre-compile the first-party C++ libraries into wheels.
+
+The reference compiles its native deps at build time (``sonic-sys/build.rs``,
+``espeak-phonemizer/build.rs``); the equivalent here is this setuptools shim:
+``pip install`` / ``pip wheel`` invokes the same ``sonata_tpu.native.build``
+machinery the runtime uses, so wheels built where a C++ toolchain exists ship
+ready-made ``lib*.so``.  Everything stays best-effort — without a toolchain
+the wheel is pure-Python and the libraries compile lazily on first use on the
+target machine (or the DSP falls back to numpy).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import shutil
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
+
+ROOT = Path(__file__).resolve().parent
+
+_BUILT: "list[Path] | None" = None
+
+
+def _build_native_libs() -> list[Path]:
+    """Compile the native libs (memoized: setuptools consults
+    ``has_ext_modules`` repeatedly and ``build_py`` runs it again)."""
+    global _BUILT
+    if _BUILT is not None:
+        return _BUILT
+    # load build.py by file path: importing the sonata_tpu package would
+    # pull numpy/jax into the (PEP 517 isolated) build environment, where
+    # only setuptools is guaranteed to exist
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_sonata_native_build",
+            ROOT / "sonata_tpu" / "native" / "build.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    except Exception as e:  # pragma: no cover - packaging environment issue
+        print(f"[sonata-tpu] native build machinery unavailable: {e}")
+        _BUILT = []
+        return _BUILT
+    built = []
+    for name, embed in (("sonata_dsp", False), ("sonata_capi", True)):
+        lib = mod._build(name, embed_python=embed)
+        if lib is None:
+            print(f"[sonata-tpu] skipping native {name} "
+                  "(no toolchain or compile failed; runtime will retry "
+                  "lazily)")
+        else:
+            built.append(lib)
+    _BUILT = built
+    return built
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        super().run()
+        for lib in _build_native_libs():
+            dest = Path(self.build_lib) / "sonata_tpu" / "native" / lib.name
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            shutil.copy2(lib, dest)
+            print(f"[sonata-tpu] bundled {lib.name}")
+
+
+class BinaryWhenNativeBuilt(Distribution):
+    """Tag the wheel platform-specific iff the .so files compiled."""
+
+    def has_ext_modules(self):
+        return bool(_build_native_libs())
+
+
+setup(cmdclass={"build_py": BuildPyWithNative},
+      distclass=BinaryWhenNativeBuilt)

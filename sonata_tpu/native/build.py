@@ -82,7 +82,21 @@ def _load(name: str, *, embed_python: bool = False) -> Optional[ctypes.CDLL]:
             try:
                 handle = ctypes.CDLL(str(lib_path), mode=mode)
             except OSError as e:
-                log.warning("cannot load %s: %s", lib_path, e)
+                # a wheel may ship a foreign-platform or stale binary:
+                # rebuild from the vendored sources once, then give up to
+                # the numpy fallback
+                log.warning("cannot load %s (%s); rebuilding", lib_path, e)
+                try:
+                    lib_path.unlink()
+                except OSError:
+                    pass
+                lib_path = _build(name, embed_python=embed_python)
+                if lib_path is not None:
+                    try:
+                        handle = ctypes.CDLL(str(lib_path), mode=mode)
+                    except OSError as e2:
+                        log.warning("cannot load rebuilt %s: %s",
+                                    lib_path, e2)
         _CACHE[name] = handle
         return handle
 

@@ -488,6 +488,20 @@ BASE_WORDS = {
     "sorry": "sˈɑːɹi", "alice": "ˈælɪs", "robot": "ɹˈoʊbɑːt",
     "synthesis": "sˈɪnθəsɪs", "phoneme": "fˈoʊniːm",
     "sonata": "sənˈɑːɾə",
+    "base": "beɪs", "target": "tˈɑːɹɡɪt", "neural": "nˈʊɹəl",
+    "chunk": "tʃʌŋk", "matrix": "mˈeɪtɹɪks", "cache": "kæʃ",
+    "storage": "stˈɔːɹɪdʒ", "filter": "fˈɪltɚ", "compile": "kəmpˈaɪl",
+    "runtime": "ɹˈʌntaɪm", "standard": "stˈændɚd",
+    "quantum": "kwˈɑːntəm", "virtual": "vˈɜːtʃuəl",
+    "random": "ɹˈændəm", "static": "stˈæɾɪk", "dynamic": "daɪnˈæmɪk",
+    "parallel": "pˈɛɹəlɛl", "serial": "sˈɪɹiəl", "remote": "ɹɪmˈoʊt",
+    "global": "ɡlˈoʊbəl", "keyboard": "kˈiːbɔːɹd",
+    "schedule": "skˈɛdʒuːl", "monitor": "mˈɑːnɪɾɚ",
+    "module": "mˈɑːdʒuːl", "protocol": "pɹˈoʊɾəkɔːl",
+    "socket": "sˈɑːkɪt", "cluster": "klˈʌstɚ", "shard": "ʃɑːɹd",
+    "gradient": "ɡɹˈeɪdiənt", "inference": "ˈɪnfɚɹəns",
+    "transformer": "tɹænsfˈɔːɹmɚ", "attention": "ətˈɛnʃən",
+    "embedding": "ɛmbˈɛdɪŋ", "softmax": "sˈɔːftmæks",
 }
 # fmt: on
 
@@ -593,4 +607,15 @@ def derive(word: str) -> Optional[str]:
             b = LEXICON.get(word[len(pre):])
             if b is not None:
                 return ipa + b
+    # closed compounds ("framework", "database", "bookshelf"): two whole
+    # lexicon words, longest first part wins.  Both parts must be ≥4
+    # letters — at 3 the false-split rate explodes ("season" → sea+son,
+    # "carpet" → car+pet).  English compounds stress the first element:
+    # the second element's primary mark demotes to secondary.
+    if len(word) >= 8:
+        for cut in range(len(word) - 4, 3, -1):
+            a = LEXICON.get(word[:cut])
+            b = LEXICON.get(word[cut:])
+            if a is not None and b is not None:
+                return a + b.replace("ˈ", "ˌ")
     return None

@@ -34,12 +34,38 @@ _RULES: list[tuple[str, str]] = [
     ("ee", "iː"), ("ea", "iː"), ("oo", "uː"), ("ou", "aʊ"), ("ow", "oʊ"),
     ("ai", "eɪ"), ("ay", "eɪ"), ("oa", "oʊ"), ("oi", "ɔɪ"), ("oy", "ɔɪ"),
     ("au", "ɔː"), ("aw", "ɔː"), ("ew", "uː"), ("ey", "eɪ"), ("ie", "iː"),
+    ("eu", "uː"), ("ue", "uː"),
     ("ar", "ɑːɹ"), ("er", "ɚ"), ("ir", "ɜː"), ("or", "ɔːɹ"), ("ur", "ɜː"),
     ("a", "æ"), ("b", "b"), ("c", "k"), ("d", "d"), ("e", "ɛ"), ("f", "f"),
     ("g", "ɡ"), ("h", "h"), ("i", "ɪ"), ("j", "dʒ"), ("k", "k"), ("l", "l"),
     ("m", "m"), ("n", "n"), ("o", "ɑː"), ("p", "p"), ("r", "ɹ"), ("s", "s"),
     ("t", "t"), ("u", "ʌ"), ("v", "v"), ("w", "w"), ("x", "ks"),
     ("y", "j"), ("z", "z"),
+]
+
+# Suffix-anchored renderings for out-of-lexicon words: Latinate endings
+# whose letter-by-letter readings are badly wrong ("quantization" must end
+# ˈeɪʃən, not æʃən).  Longest-first; entries carrying ˈ fix the stress too
+# (these suffixes attract primary stress onto themselves or leave the stem
+# unstressed, which default stress would get wrong).
+_SUFFIXES: list[tuple[str, str]] = [
+    ("ization", "aɪzˈeɪʃən"), ("ification", "ɪfɪkˈeɪʃən"),
+    ("ation", "ˈeɪʃən"), ("ition", "ˈɪʃən"), ("ution", "ˈuːʃən"),
+    ("cious", "ʃəs"), ("tious", "ʃəs"), ("geous", "dʒəs"),
+    ("cial", "ʃəl"), ("tial", "ʃəl"), ("cian", "ʃən"),
+    ("ience", "iəns"), ("ient", "iənt"),
+    ("ology", "ˈɑːlədʒi"), ("ography", "ˈɑːɡɹəfi"),
+    ("ular", "jʊlɚ"),
+    ("ical", "ɪkəl"), ("ualize", "juəlaɪz"), ("ual", "juəl"),
+    ("ious", "iəs"), ("ous", "əs"), ("ive", "ɪv"),
+    ("able", "əbəl"), ("ible", "əbəl"),
+    ("ture", "tʃɚ"), ("sure", "ʒɚ"),
+    ("ary", "ˌɛɹi"), ("ory", "ˌɔːɹi"),
+    ("ism", "ɪzəm"), ("ist", "ɪst"),
+    ("izer", "aɪzɚ"), ("izing", "aɪzɪŋ"), ("izes", "aɪzɪz"),
+    ("ize", "aɪz"), ("ise", "aɪz"),
+    ("ify", "ɪfaɪ"), ("ity", "ɪti"),
+    ("al", "əl"), ("le", "əl"), ("el", "əl"),
 ]
 
 _ONES = ["zero", "one", "two", "three", "four", "five", "six", "seven",
@@ -115,12 +141,11 @@ def _default_stress(ipa: str) -> str:
     return ipa[:onset] + "ˈ" + ipa[onset:]
 
 
-def english_word_to_ipa(word: str) -> str:
-    from .lexicon import derive
-
-    hit = derive(word)  # lexicon + morphological derivations
-    if hit is not None:
-        return hit
+def _scan_letters(word: str) -> str:
+    """Letter-to-sound scan of one orthographic word (no lexicon)."""
+    # doubled consonant letters read as one sound ("connect", "happen");
+    # doubled vowels stay — they are real digraphs (ee, oo)
+    word = re.sub(r"([b-df-hj-np-tv-z])\1", r"\1", word)
     out: list[str] = []
     i = 0
     # final silent 'e' lengthens the previous vowel (rough magic-e rule)
@@ -155,7 +180,31 @@ def english_word_to_ipa(word: str) -> str:
         idx = ipa.rfind(best[0])
         if idx >= 0:
             ipa = ipa[:idx] + best[1] + ipa[idx + len(best[0]):]
-    return _default_stress(ipa)
+    return ipa
+
+
+def english_word_to_ipa(word: str) -> str:
+    from .lexicon import derive
+
+    hit = derive(word)  # lexicon + morphology + closed compounds
+    if hit is not None:
+        # a polysyllable derived from an unmarked monosyllable base
+        # ("stream" → "streaming") still needs its stress mark
+        return _default_stress(hit)
+    # suffix-anchored endings before the raw letter scan: the stem scans
+    # letter-by-letter, the ending renders from the table (and may carry
+    # the stress mark the suffix attracts)
+    for suf, sipa in _SUFFIXES:
+        stem = word[: -len(suf)]
+        if (word.endswith(suf) and len(stem) >= 3
+                and any(v in stem for v in "aeiouy")):
+            base = derive(stem) or derive(stem + "e") or _scan_letters(stem)
+            # a stem resolved from the lexicon keeps only its own
+            # secondary prominence when the suffix carries the primary
+            if "ˈ" in sipa:
+                base = base.replace("ˈ", "ˌ")
+            return _default_stress(base + sipa)
+    return _default_stress(_scan_letters(word))
 
 
 def arabic_word_to_ipa(word: str) -> str:

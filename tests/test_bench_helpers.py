@@ -1,0 +1,45 @@
+"""Bench harness helpers: these run inside the driver's single recorded
+bench invocation, so they get their own coverage here."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from voices import tiny_voice
+
+
+def test_prewarm_neighbor_buckets_compiles_adjacent_shapes():
+    from bench import prewarm_neighbor_buckets
+
+    v = tiny_voice(seed=7)
+    v.speak_batch(["ʃɔːt."])  # one key → fewer prewarm compiles
+    before = set(v._full_cache)
+    prewarm_neighbor_buckets(v)
+    added = set(v._full_cache) - before
+    assert added, "no neighbor buckets compiled"
+    # every added key shares (b, t) with a warmed key and sits one frame
+    # bucket away
+    from sonata_tpu.utils.buckets import FRAME_BUCKETS
+
+    for (b, t, f) in added:
+        neighbors = {
+            FRAME_BUCKETS[max(FRAME_BUCKETS.index(wf) - 1, 0)]
+            for (wb, wt, wf) in before if (wb, wt) == (b, t)
+        } | {
+            FRAME_BUCKETS[min(FRAME_BUCKETS.index(wf) + 1,
+                              len(FRAME_BUCKETS) - 1)]
+            for (wb, wt, wf) in before if (wb, wt) == (b, t)
+        }
+        assert f in neighbors
+
+
+def test_accelerator_probe_reports_platform(monkeypatch):
+    from bench import _accelerator_ready
+
+    # disable the remote-TPU plugin for the probe subprocess (its
+    # registration ignores JAX_PLATFORMS and would hang on a dead tunnel)
+    # so the probe resolves the CPU backend quickly and deterministically
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert _accelerator_ready(timeout_s=90.0) == "cpu"

@@ -163,7 +163,10 @@ def test_seq_parallel_transformer_matches_baseline():
     mask = (jnp.arange(T)[None, :] <
             lengths[:, None]).astype(jnp.float32)[..., None]
     base = m.transformer(x, mask, p, n_heads=H, window=W)
-    for seq in (2, 4):
+    # seq=4 exercises multi-hop ring passes; seq=2 is a strict subset of
+    # the same code path and compiling both nearly doubles this test's
+    # (compile-dominated) cost
+    for seq in (4,):
         mesh = make_mesh(8, seq_parallel=seq)
         out = m.transformer_seq_parallel(x, mask, p, n_heads=H, window=W,
                                          mesh=mesh)
@@ -225,7 +228,9 @@ def test_frame_domain_seq_parallel_matches_unsharded():
     v = tiny_voice(seed=2)
     hp, p = v.hp, v.params
     F = 64
-    for seq in (2, 4):
+    # seq=4 covers the smallest per-shard frame count (tightest halo
+    # margin); the seq=2 variant compiles the same code for little gain
+    for seq in (4,):
         mesh = make_mesh(8, seq_parallel=seq)
         B = mesh.shape["data"]
         z = jax.random.normal(jax.random.PRNGKey(0),
@@ -294,8 +299,8 @@ def test_decode_sp_bfloat16_close_to_unsharded_bf16():
                                    compute_dtype=jnp.bfloat16))
     unsharded = np.asarray(vits.decode(p, hp, z,
                                        compute_dtype=jnp.bfloat16))
-    f32 = np.asarray(vits.decode(p, hp, z))
     np.testing.assert_allclose(sharded, unsharded, atol=2e-5)
     assert np.isfinite(sharded).all()
-    # bf16 waveform tracks f32 loosely (8-bit mantissa conv stack)
-    assert np.abs(sharded - f32).max() < 0.1
+    # (bf16-vs-f32 closeness is pinned on the unsharded path in
+    # test_vits_model.py::test_bfloat16_decode_close_to_float32; skipping
+    # the extra f32 compile here keeps the suite compile budget down)

@@ -171,7 +171,7 @@ GOLDEN_CORPUS = [
     ("the quick brown fox jumps over the lazy dog",
      "ðə kwɪk bɹaʊn fɑːks dʒʌmps ˈoʊvɚ ðə ˈlæzi dɔːɡ"),
     ("she was reading books yesterday",
-     "ʃiː wʌz ɹiːdɪŋ bʊks jˈɛstɚdeɪ"),
+     "ʃiː wʌz ˈɹiːdɪŋ bʊks jˈɛstɚdeɪ"),
     ("twenty seven computers", "twˈɛnti sˈɛvən kəmpjˈuːɾɚz"),
     ("my mother and father live in the city",
      "maɪ mˈʌðɚ ænd fˈɑːðɚ lɪv ɪn ðə sˈɪɾi"),
@@ -270,3 +270,47 @@ def test_terminator_backend_drives_segmentation():
     ph = text_to_phonemes("whatever text. with? punctuation",
                           backend=FakeTermBackend())
     assert list(ph) == ["aaa, bbb.", "ccc?"]
+
+
+def test_closed_compound_splitting():
+    # two whole lexicon words (≥4 letters each) read as a compound with
+    # first-element stress; the second element's primary demotes
+    from sonata_tpu.text.rule_g2p import english_word_to_ipa as g
+
+    assert g("framework") == "ˈfɹeɪmwɜːk"
+    assert g("database") == "dˈeɪɾəbeɪs"
+    assert g("workload") == "ˈwɜːkloʊd"
+    assert g("bookshelf") == "ˈbʊkʃɛlf"
+    # 3-letter parts must NOT split ("season" is a lexicon word anyway,
+    # but "carpet"-style false compounds stay whole)
+    assert g("season") == "sˈiːzən"
+
+
+def test_latinate_suffix_rules():
+    from sonata_tpu.text.rule_g2p import english_word_to_ipa as g
+
+    # -ation attracts primary stress onto the suffix
+    assert g("quantization").endswith("ˈeɪʃən")
+    assert g("vectorization").endswith("ˈeɪʃən")
+    # -ular renders as jʊlɚ, not a letter-by-letter read
+    assert g("spectacular").endswith("jʊlɚ")
+    # -izer keeps the stem's lexicon pronunciation
+    assert g("tokenizer") == "tˈoʊkənaɪzɚ"
+
+
+def test_derived_polysyllables_carry_stress():
+    from sonata_tpu.text.rule_g2p import english_word_to_ipa as g
+
+    # derived from unmarked monosyllable bases → default stress applies
+    assert g("streaming") == "ˈstɹiːmɪŋ"
+    # function words stay unstressed
+    assert g("the") == "ðə"
+    assert g("was") == "wʌz"
+
+
+def test_doubled_consonants_read_once():
+    from sonata_tpu.text.rule_g2p import _scan_letters
+
+    assert _scan_letters("connect") == _scan_letters("conect")
+    # doubled vowels are digraphs, not duplicates
+    assert "iː" in _scan_letters("seen")
