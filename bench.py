@@ -71,37 +71,6 @@ def _accelerator_ready(timeout_s: float = 120.0):
     return platform[0] or None
 
 
-def prewarm_neighbor_buckets(voice) -> None:
-    """Compile the frame buckets adjacent to every cached full-pipeline
-    shape (dummy args, one blocking run each).  The frame-bucket choice
-    rides each run's random duration draw, so without this a timed or
-    production run can stall on a fresh compile when a draw lands one
-    bucket over from the warmed shape."""
-    import jax
-    import jax.numpy as jnp
-
-    from sonata_tpu.utils.buckets import FRAME_BUCKETS
-
-    for (b, t, f) in list(voice._full_cache):
-        if f not in FRAME_BUCKETS:
-            continue  # beyond-table bucket (very long utterance): no
-            # neighbor schedule to protect
-        i = FRAME_BUCKETS.index(f)
-        for nf in {FRAME_BUCKETS[max(i - 1, 0)],
-                   FRAME_BUCKETS[min(i + 1, len(FRAME_BUCKETS) - 1)]} - {f}:
-            fn = voice._full_fn(b, t, nf)
-            args = [voice.params,
-                    jnp.zeros((b, t), jnp.int32),
-                    jnp.ones((b,), jnp.int32),
-                    jax.random.PRNGKey(0),
-                    jnp.full((b,), 0.8, jnp.float32),
-                    jnp.ones((b,), jnp.float32),
-                    jnp.full((b,), 0.667, jnp.float32)]
-            if voice.multi_speaker:
-                args.append(jnp.zeros((b,), jnp.int32))
-            jax.block_until_ready(fn(*args))
-
-
 def accelerator_ready_with_retries():
     """The remote-accelerator tunnel flaps (observed down for stretches of
     rounds 1-2): retry init a few times before reporting failure, so a
@@ -174,7 +143,7 @@ def main() -> None:
     # one bucket up or down from the warmed ones — prewarm each cached
     # shape's neighbors so no compile (or 40s remote-compile stall) can
     # fall inside the timed loop, here or in the driver's single run
-    prewarm_neighbor_buckets(voice)
+    voice.prewarm_neighbor_buckets()
 
     iters = 5
     total_audio = 0.0

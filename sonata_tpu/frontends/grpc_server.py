@@ -336,6 +336,7 @@ def create_server(port: Optional[int] = None, *, mesh=None, seed: int = 0,
     bound = server.add_insecure_port(f"{host}:{port}")
     if bound == 0:
         raise OperationError(f"cannot bind {host}:{port}")
+    server.sonata_service = service  # for startup hooks (e.g. prewarm)
     return server, bound
 
 
@@ -360,6 +361,12 @@ def main(argv=None) -> int:
                     help="of the mesh devices, how many form the sequence"
                          "-parallel axis (ring attention + frame-domain "
                          "sharding); must divide --mesh-devices")
+    ap.add_argument("--prewarm", action="store_true",
+                    help="compile each preloaded voice's common "
+                         "executables (batch buckets, neighbor frame "
+                         "buckets, streaming decoders) in the background "
+                         "at startup, so first requests never wait on "
+                         "XLA compilation")
     args = ap.parse_args(argv)
 
     mesh = None
@@ -385,6 +392,24 @@ def main(argv=None) -> int:
         for cfg in args.voice:
             info = stub(pb.VoicePath(config_path=cfg))
             log.info("preloaded voice %s", info.voice_id)
+        if args.prewarm:
+            service = server.sonata_service
+
+            def _prewarm_all():
+                with service._lock:
+                    voices = list(service._voices.values())
+                for v in voices:
+                    try:
+                        n = v.voice.prewarm(streaming=True)
+                        log.info("prewarmed voice: %d full-pipeline "
+                                 "shapes compiled", n)
+                    except Exception:
+                        log.exception("prewarm failed (serving continues)")
+
+            threading.Thread(target=_prewarm_all, name="sonata_prewarm",
+                             daemon=True).start()
+    elif args.prewarm:
+        log.warning("--prewarm does nothing without --voice")
     try:
         server.wait_for_termination()
     except KeyboardInterrupt:

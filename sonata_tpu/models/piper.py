@@ -246,6 +246,73 @@ class PiperVoice(BaseModel):
     def speak_one_sentence(self, phonemes: str) -> Audio:
         return self.speak_batch([phonemes])[0]
 
+    # Representative prewarm texts: short / medium / long sentences cover
+    # the common text buckets (and, batched together, the common group
+    # shapes).
+    _PREWARM_TEXTS = [
+        "Hello there.",
+        "This server compiles its executables before the first request.",
+        "A longer sentence exercises the larger text and frame buckets so "
+        "that real traffic arriving right after startup never waits on a "
+        "fresh compilation of the synthesis pipeline.",
+    ]
+
+    def prewarm(self, texts: Optional[list[str]] = None, *,
+                streaming: bool = False, chunk_size: int = 55,
+                chunk_padding: int = 3) -> int:
+        """Compile the common executables before serving traffic.
+
+        A cold voice pays XLA compilation (tens of seconds per shape on a
+        remote chip) on the first request that hits each (batch, text,
+        frame) bucket; the reference has no equivalent because ONNX
+        sessions are shape-polymorphic.  Synthesizes a representative
+        batch until the executable cache stops growing, then compiles the
+        neighbor frame buckets (the frame estimate rides each request's
+        random duration draw, so traffic lands one bucket over routinely).
+        With ``streaming=True`` also drains one realtime stream, warming
+        the encoder/acoustics stages and the window decoders for the
+        given chunk schedule.  Returns the number of compiled
+        full-pipeline shapes.  Persistent caching pairs well with this
+        (``jax_compilation_cache_dir``): after the first boot, prewarm
+        mostly re-loads executables from disk.
+        """
+        phonemes = [p for t in (texts or self._PREWARM_TEXTS)
+                    for p in self.phonemize_text(t)]
+        for _ in range(4):
+            n_compiled = len(self._full_cache)
+            self.speak_batch(phonemes)
+            if len(self._full_cache) == n_compiled:
+                break
+        self.prewarm_neighbor_buckets()
+        if streaming:
+            for _chunk in self.stream_synthesis(phonemes[-1], chunk_size,
+                                                chunk_padding):
+                pass
+        return len(self._full_cache)
+
+    def prewarm_neighbor_buckets(self) -> None:
+        """Compile the frame buckets adjacent to every cached
+        full-pipeline shape (dummy args, one blocking run each)."""
+        from ..utils.buckets import FRAME_BUCKETS as _FB
+
+        for (b, t, f) in list(self._full_cache):
+            if f not in _FB:
+                continue  # beyond-table bucket: no neighbor schedule
+            i = _FB.index(f)
+            for nf in {_FB[max(i - 1, 0)],
+                       _FB[min(i + 1, len(_FB) - 1)]} - {f}:
+                fn = self._full_fn(b, t, nf)
+                args = [self.params,
+                        jnp.zeros((b, t), jnp.int32),
+                        jnp.ones((b,), jnp.int32),
+                        jax.random.PRNGKey(0),
+                        jnp.full((b,), 0.8, jnp.float32),
+                        jnp.ones((b,), jnp.float32),
+                        jnp.full((b,), 0.667, jnp.float32)]
+                if self.multi_speaker:
+                    args.append(jnp.zeros((b,), jnp.int32))
+                jax.block_until_ready(fn(*args))
+
     # Cap on rows per device dispatch: beyond this, padding waste and
     # compile sizes grow without amortizing any more fixed latency.
     MAX_DISPATCH_BATCH = 64

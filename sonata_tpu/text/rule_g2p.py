@@ -123,29 +123,37 @@ from .lexicon import IPA_VOWELS as _IPA_VOWEL_STARTS
 
 def _default_stress(ipa: str) -> str:
     """Insert primary stress before the first syllable when a
-    rule-generated word has two or more vowel nuclei and no stress mark
+    rule-generated word has two or more vowel nuclei and no primary mark
     yet (eSpeak marks stress on every content word; Piper voices carry
-    ˈ/ˌ in their phoneme maps)."""
-    if "ˈ" in ipa or "ˌ" in ipa:
+    ˈ/ˌ in their phoneme maps).  A lone secondary mark — a demoted
+    compound second element or a ˌ-bearing suffix — does not count: the
+    word still needs its primary."""
+    if "ˈ" in ipa:
         return ipa
     nuclei = [i for i, ch in enumerate(ipa) if ch in _IPA_VOWEL_STARTS
               and (i == 0 or ipa[i - 1] not in _IPA_VOWEL_STARTS)]
     if len(nuclei) < 2:
         return ipa  # monosyllables are left unmarked, like the lexicon
-    first = nuclei[0]
-    # place the mark before the syllable onset (the consonant run
-    # preceding the first nucleus)
-    onset = first
-    while onset > 0 and ipa[onset - 1] not in _IPA_VOWEL_STARTS + "ː":
-        onset -= 1
-    return ipa[:onset] + "ˈ" + ipa[onset:]
+    for first in nuclei:
+        # place the mark before the syllable onset (the consonant run
+        # preceding the nucleus) — unless that syllable already carries
+        # the secondary mark (then the primary belongs elsewhere)
+        onset = first
+        while onset > 0 and ipa[onset - 1] not in _IPA_VOWEL_STARTS + "ːˌ":
+            onset -= 1
+        if onset > 0 and ipa[onset - 1] == "ˌ":
+            continue
+        return ipa[:onset] + "ˈ" + ipa[onset:]
+    return ipa
 
 
 def _scan_letters(word: str) -> str:
     """Letter-to-sound scan of one orthographic word (no lexicon)."""
     # doubled consonant letters read as one sound ("connect", "happen");
-    # doubled vowels stay — they are real digraphs (ee, oo)
-    word = re.sub(r"([b-df-hj-np-tv-z])\1", r"\1", word)
+    # doubled vowels stay — they are real digraphs (ee, oo) — and "cc"
+    # stays: before a front vowel its letters are distinct sounds
+    # ("access" = /ks/), handled as a digraph below
+    word = re.sub(r"([bdfghj-np-tvwxz])\1", r"\1", word)
     out: list[str] = []
     i = 0
     # final silent 'e' lengthens the previous vowel (rough magic-e rule)
@@ -155,6 +163,12 @@ def _scan_letters(word: str) -> str:
         if body[i] == "y" and i == len(body) - 1:
             out.append("i")  # word-final y is a vowel ("twenty" → …ti)
             break
+        # "cc": /ks/ before front vowels ("access"), /k/ otherwise
+        if body.startswith("cc", i):
+            nxt = body[i + 2] if i + 2 < len(body) else ""
+            out.append("ks" if nxt in "eiy" else "k")
+            i += 2
+            continue
         # context rules: soft c/g before front vowels
         if body[i] == "c" and i + 1 < len(body) and body[i + 1] in "eiy":
             out.append("s")

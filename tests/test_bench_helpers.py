@@ -10,12 +10,10 @@ from voices import tiny_voice
 
 
 def test_prewarm_neighbor_buckets_compiles_adjacent_shapes():
-    from bench import prewarm_neighbor_buckets
-
     v = tiny_voice(seed=7)
     v.speak_batch(["ʃɔːt."])  # one key → fewer prewarm compiles
     before = set(v._full_cache)
-    prewarm_neighbor_buckets(v)
+    v.prewarm_neighbor_buckets()
     added = set(v._full_cache) - before
     assert added, "no neighbor buckets compiled"
     # every added key shares (b, t) with a warmed key and sits one frame

@@ -314,3 +314,25 @@ def test_doubled_consonants_read_once():
     assert _scan_letters("connect") == _scan_letters("conect")
     # doubled vowels are digraphs, not duplicates
     assert "iː" in _scan_letters("seen")
+
+
+def test_double_c_before_front_vowel_is_ks():
+    from sonata_tpu.text.rule_g2p import english_word_to_ipa as g
+
+    assert "ks" in g("access")
+    assert "ks" in g("vaccine")
+    # cc before a back vowel is a single /k/
+    assert "kk" not in g("accord")
+
+
+def test_secondary_only_words_still_get_primary_stress():
+    from sonata_tpu.text.rule_g2p import english_word_to_ipa as g
+
+    # compound with unmarked-monosyllable first element
+    assert g("firewater").startswith("ˈ")
+    # ˌ-bearing suffixes (-ary/-ory)
+    assert "ˈ" in g("granary")
+    assert "ˈ" in g("missionary")
+    # a ˌ-prefixed derivation never produces adjacent ˈˌ
+    assert "ˈˌ" not in g("overwork") and "ˌˈ" not in g("overwork")
+    assert "ˈ" in g("overwork")

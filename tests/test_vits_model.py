@@ -377,3 +377,15 @@ def test_bfloat16_streaming_window_decode():
     chunks = list(v.stream_synthesis("ə lɒŋɡɚ tɛst sɛntəns hɪɹ.", 12, 2))
     assert chunks and all(np.isfinite(np.asarray(c.samples.data)).all()
                           for c in chunks)
+
+
+def test_prewarm_compiles_common_shapes():
+    from voices import tiny_voice
+
+    v = tiny_voice(seed=8)
+    assert not v._full_cache
+    n = v.prewarm(texts=["Short one.", "A slightly longer warm sentence."],
+                  streaming=True, chunk_size=12, chunk_padding=2)
+    assert n == len(v._full_cache) and n > 0
+    # streaming prewarm compiled the staged path too
+    assert v._enc_cache and v._dec_cache
