@@ -102,23 +102,13 @@ def main() -> None:
         return
 
     import jax
-
-    # persistent executable cache: repeat bench runs (and the driver's)
-    # skip the 60-90s cold compile of the full model.  Lives under the
-    # user's cache home (not world-writable /tmp, where a predictable name
-    # could be pre-created/poisoned by another local user).
     import os
 
-    try:
-        cache_dir = os.environ.get("SONATA_JAX_CACHE_DIR") or os.path.join(
-            os.environ.get("XDG_CACHE_HOME")
-            or os.path.join(os.path.expanduser("~"), ".cache"),
-            "sonata_jax")
-        os.makedirs(cache_dir, mode=0o700, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass  # cache is an optimization; never fail the bench over it
+    # persistent executable cache: repeat bench runs (and the driver's)
+    # skip the 60-90s cold compile of the full model
+    from sonata_tpu.utils.jax_cache import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
 
     from sonata_tpu.models import PiperVoice
     from sonata_tpu.synth import SpeechSynthesizer

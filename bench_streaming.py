@@ -39,7 +39,9 @@ def main() -> None:
 
     from sonata_tpu.models import PiperVoice
     from sonata_tpu.synth import SpeechSynthesizer
+    from sonata_tpu.utils.jax_cache import enable_persistent_compile_cache
 
+    enable_persistent_compile_cache()
     voice = PiperVoice.random(seed=0, audio={"sample_rate": 22050,
                                              "quality": "high"})
     synth = SpeechSynthesizer(voice)

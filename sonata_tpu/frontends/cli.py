@@ -180,6 +180,11 @@ def main(argv=None) -> int:
     logging.basicConfig(
         level=os.environ.get("SONATA_LOG", "INFO").upper(),
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    # repeat CLI invocations reuse compiled executables from disk instead
+    # of re-paying the cold XLA compile on every run
+    from ..utils.jax_cache import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
     args = build_parser().parse_args(argv)
     try:
         if args.info:

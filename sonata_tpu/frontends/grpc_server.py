@@ -263,6 +263,21 @@ class SonataGrpcService:
             voices = list(self._voices.values())
         return pb.VoiceList(voices=[self._voice_info(v) for v in voices])
 
+    def prewarm_all(self) -> None:
+        """Compile every loaded voice's common executables (batch buckets,
+        neighbor frame buckets, streaming decoders).  Serving continues on
+        a per-voice failure — prewarming is a latency optimization, not a
+        correctness step."""
+        with self._lock:
+            voices = list(self._voices.values())
+        for v in voices:
+            try:
+                n = v.voice.prewarm(streaming=True)
+                log.info("prewarmed voice %s: %d full-pipeline shapes "
+                         "compiled", v.voice_id, n)
+            except Exception:
+                log.exception("prewarm failed (serving continues)")
+
     def SynthesizeUtteranceRealtime(self, request: pb.Utterance,
                                     context) -> Iterator[pb.WaveSamples]:
         v = self._get(request.voice_id, context)
@@ -344,6 +359,13 @@ def main(argv=None) -> int:
     logging.basicConfig(
         level=os.environ.get("SONATA_GRPC", "INFO").upper(),
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    # compiled executables persist across boots; with --prewarm, a re-boot
+    # loads its shapes from disk in seconds instead of re-running XLA
+    from ..utils.jax_cache import enable_persistent_compile_cache
+
+    cache_dir = enable_persistent_compile_cache()
+    if cache_dir:
+        log.info("persistent compile cache: %s", cache_dir)
     import argparse
 
     ap = argparse.ArgumentParser(prog="sonata-tpu-grpc")
@@ -393,21 +415,8 @@ def main(argv=None) -> int:
             info = stub(pb.VoicePath(config_path=cfg))
             log.info("preloaded voice %s", info.voice_id)
         if args.prewarm:
-            service = server.sonata_service
-
-            def _prewarm_all():
-                with service._lock:
-                    voices = list(service._voices.values())
-                for v in voices:
-                    try:
-                        n = v.voice.prewarm(streaming=True)
-                        log.info("prewarmed voice: %d full-pipeline "
-                                 "shapes compiled", n)
-                    except Exception:
-                        log.exception("prewarm failed (serving continues)")
-
-            threading.Thread(target=_prewarm_all, name="sonata_prewarm",
-                             daemon=True).start()
+            threading.Thread(target=server.sonata_service.prewarm_all,
+                             name="sonata_prewarm", daemon=True).start()
     elif args.prewarm:
         log.warning("--prewarm does nothing without --voice")
     try:

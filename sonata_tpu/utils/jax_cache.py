@@ -1,0 +1,40 @@
+"""Persistent XLA compile cache setup, shared by every long-lived entry
+point (gRPC server, CLI, benches).
+
+The reference pays no compilation cost — ONNX Runtime sessions load in
+milliseconds (``crates/sonata/models/piper/src/lib.rs:342-399``).  Here the
+first compile of a full-pipeline shape costs tens of seconds on a remote
+chip, so anything that boots repeatedly must reuse compiled executables
+across processes: with the cache enabled, a re-boot loads each shape from
+disk in well under a second instead of re-invoking XLA.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_persistent_compile_cache(min_compile_secs: float = 1.0) -> str | None:
+    """Point JAX's compilation cache at a per-user directory and return it.
+
+    Directory resolution: ``SONATA_JAX_CACHE_DIR``, else
+    ``$XDG_CACHE_HOME/sonata_jax``, else ``~/.cache/sonata_jax``.  The
+    directory is created mode 0700 — a world-writable location (e.g. a
+    predictable /tmp name) could be pre-created and poisoned by another
+    local user.  Returns None (and changes nothing) on any failure: the
+    cache is an optimization, never a boot blocker.
+    """
+    try:
+        import jax
+
+        cache_dir = os.environ.get("SONATA_JAX_CACHE_DIR") or os.path.join(
+            os.environ.get("XDG_CACHE_HOME")
+            or os.path.join(os.path.expanduser("~"), ".cache"),
+            "sonata_jax")
+        os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_secs))
+        return cache_dir
+    except Exception:
+        return None
