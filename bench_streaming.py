@@ -46,11 +46,13 @@ def main() -> None:
                                              "quality": "high"})
     synth = SpeechSynthesizer(voice)
 
-    # warmup: compile encode/acoustics/window-decode executables
-    for _ in range(2):
-        for _chunk in synth.synthesize_streamed(SENTENCE, chunk_size=55,
-                                                chunk_padding=3):
-            pass
+    # warmup: compile encode/acoustics/window-decode executables, including
+    # the coalesced-batch shapes the concurrent phases below will hit
+    voice.prewarm(texts=[SENTENCE], streaming=True, chunk_size=55,
+                  chunk_padding=3)
+    for _chunk in synth.synthesize_streamed(SENTENCE, chunk_size=55,
+                                            chunk_padding=3):
+        pass
 
     ttfbs = []
     for _ in range(5):

@@ -92,6 +92,7 @@ class PiperVoice(BaseModel):
         self._aco_cache: dict = {}
         self._dec_cache: dict = {}
         self._stream_coalescer: "Optional[_StreamDecodeCoalescer]" = None
+        self._stage_coalescer: "Optional[_StreamStageCoalescer]" = None
         # adaptive frame-budget estimator for the single-dispatch path:
         # running upper bound of frames per input id per unit length_scale.
         # Start optimistic — an underestimate costs one overflow retry on
@@ -290,7 +291,77 @@ class PiperVoice(BaseModel):
             for _chunk in self.stream_synthesis(phonemes[-1], chunk_size,
                                                 chunk_padding):
                 pass
+            self._prewarm_stream_batches()
         return len(self._full_cache)
+
+    def _prewarm_stream_batches(self) -> None:
+        """Compile the coalesced-batch window decoders for every streamed
+        width warmed so far.
+
+        Under concurrent load the stream coalescer groups equal-width
+        windows into b ∈ {2, 4, 8} batched decodes; a sequential warmup
+        only ever compiles b=1, so the first wave of real concurrency
+        would pay one mid-request XLA compile per batch shape (measured:
+        ~90x TTFB regression at 4 streams on a remote chip).  Runs each
+        shape once with dummy windows, blocking, so the executables are
+        resident (and in the persistent cache) before traffic arrives.
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._jit_lock:
+            seen = [k for k in self._dec_cache if isinstance(k, tuple)
+                    and k and k[0] == "wbatch"]
+            enc_seen = [k for k in self._enc_cache]
+            aco_seen = list(self._aco_cache)
+        co = self._stream_decoder
+        c = self.hp.inter_channels
+        thunks = []
+        # both coalescers pad every multi-request group to their max batch,
+        # so exactly ONE concurrent shape per stage needs warming
+        for (_, width, _b, has_sid) in seen:
+            b = co._max_batch
+
+            def warm_dec(width=width, b=b, has_sid=has_sid):
+                fn = self._decode_windows_batch_fn(width, b, has_sid)
+                args = [self.params, jnp.zeros((b, width, c),
+                                               jnp.float32)]
+                if has_sid:
+                    args.append(jnp.zeros((b,), jnp.int32))
+                jax.block_until_ready(fn(*args))
+
+            thunks.append(warm_dec)
+        # the stage coalescer batches stream STARTS too: warm the b=max
+        # encode/acoustics shapes it dispatches under concurrency
+        for (_eb, t) in enc_seen:
+            b = self._stream_stages._max_batch
+
+            def warm_stage(t=t, b=b):
+                ids = jnp.zeros((b, t), jnp.int32)
+                lens = jnp.ones((b,), jnp.int32)
+                nw = jnp.full((b,), 0.8, jnp.float32)
+                ls = jnp.ones((b,), jnp.float32)
+                ns = jnp.full((b,), 0.667, jnp.float32)
+                rng = jax.random.PRNGKey(0)
+                enc_args = [self.params, ids, lens, rng, nw, ls]
+                if self.multi_speaker:
+                    enc_args.append(jnp.zeros((b,), jnp.int32))
+                out = self._encode_fn(b, t)(*enc_args)
+                m_p, logs_p, w_ceil, x_mask = jax.block_until_ready(out)
+                for fa in aco_seen:
+                    aco_args = [self.params, m_p, logs_p, w_ceil,
+                                x_mask, rng, ns]
+                    if self.multi_speaker:
+                        aco_args.append(jnp.zeros((b,), jnp.int32))
+                    jax.block_until_ready(
+                        self._acoustics_fn(b, t, fa)(*aco_args))
+
+            thunks.append(warm_stage)
+        # compile concurrently: each thunk's first call blocks in XLA, and
+        # the compiles for distinct shapes don't depend on each other —
+        # 4 workers roughly quarter a cold boot's multi-minute warm
+        with ThreadPoolExecutor(4, thread_name_prefix="sonata_warm") as ex:
+            for res in ex.map(lambda th: th(), thunks):
+                pass
 
     def prewarm_neighbor_buckets(self) -> None:
         """Compile the frame buckets adjacent to every cached
@@ -722,7 +793,15 @@ class PiperVoice(BaseModel):
 
     def _decode_windows_batch_fn(self, width: int, b: int, has_sid: bool):
         """Jitted batched chunk decoder for coalesced concurrent streams:
-        stacked per-stream z rows + per-row starts → [B, width*hop]."""
+        stacked pre-sliced z windows [B, width, C] → [B, width*hop].
+
+        Windows are sliced out of each stream's z *before* they reach this
+        function (coalescer ``submit``), so the executable's shape depends
+        only on (width, b, has_sid) — NOT on each utterance's frame
+        bucket.  That keeps the compiled-shape set small and fully
+        prewarmable; the first round of concurrent traffic must never pay
+        a mid-request XLA compile (measured: a cold b=4 shape on a remote
+        chip stalled every stream's first chunk by tens of seconds)."""
         key = ("wbatch", width, b, has_sid)
         with self._jit_lock:
             fn = self._dec_cache.get(key)
@@ -730,12 +809,9 @@ class PiperVoice(BaseModel):
                 hp = self.hp
                 cdt = self.compute_dtype
 
-                def run(params, zs, starts, sid=None):
+                def run(params, windows, sid=None):
                     g = (params["emb_g"][sid][:, None, :]
                          if sid is not None else None)
-                    windows = jax.vmap(
-                        lambda z, s: jax.lax.dynamic_slice_in_dim(
-                            z, s, width, axis=0))(zs, starts)
                     return vits.decode(params, hp, windows, g=g,
                                        compute_dtype=cdt)
 
@@ -749,6 +825,13 @@ class PiperVoice(BaseModel):
             if self._stream_coalescer is None:
                 self._stream_coalescer = _StreamDecodeCoalescer(self)
             return self._stream_coalescer
+
+    @property
+    def _stream_stages(self) -> "_StreamStageCoalescer":
+        with self._jit_lock:
+            if self._stage_coalescer is None:
+                self._stage_coalescer = _StreamStageCoalescer(self)
+            return self._stage_coalescer
 
     def _pad_batch(self, ids_list: list[list[int]]):
         """Pad a sentence batch to (batch, text) buckets.
@@ -910,70 +993,32 @@ class PiperVoice(BaseModel):
         hop = self.hp.hop_length
 
         t_enc0 = time.perf_counter()
-        m_p, logs_p, w_ceil, x_mask, sid, b, t = self._run_encode([ids], sc)
-        # TTFB: dispatch acoustics immediately with the *estimated* frame
-        # bucket so the frame-count host sync overlaps device work instead
-        # of serializing before it; on the rare underestimate, redo
-        # acoustics with the exact bucket
-        weighted = len(ids) * max(float(sc.length_scale), 0.05)
-        f = self._estimate_frame_bucket(weighted)
-
-        # one key for both attempts: the underestimate-retry must produce
-        # identical noise (and so identical audio), matching _infer_batch
-        rng = self._next_rng()
-
-        def run_acoustics(bucket: int):
-            aco = self._acoustics_fn(b, t, bucket)
-            _, _, ns, _ = self._scale_arrays(sc, b)
-            args = [self.params, m_p, logs_p, w_ceil, x_mask,
-                    rng, ns]
-            if sid is not None:
-                args.append(sid)
-            return aco(*args)
-
-        z, y_lengths = run_acoustics(f)
-        # TTFB: the first window of a multi-chunk schedule is always
-        # (start=0, width=chunk+padding) regardless of the total frame
-        # count, so dispatch its decode NOW — it overlaps the frame-count
-        # host sync and the acoustics tail instead of serializing after
-        # them.  Gated on the estimator predicting a multi-chunk schedule
-        # with margin: a wasted speculative decode on a one-shot
-        # utterance would serialize AHEAD of the real one and make TTFB
-        # worse, so near the one-shot boundary we don't speculate.
-        # Also discarded on an acoustics retry (z was clipped).
-        sid0 = int(sid[0]) if sid is not None else None
-        pre_width = bucket_for(chunk_size + chunk_padding, FRAME_BUCKETS)
-        with self._fpi_lock:
-            est_frames = weighted * self._frames_per_id
-        one_shot_bound = 2 * chunk_size + 2 * chunk_padding
-        pre_fut = (self._stream_decoder.submit(z[0], 0, pre_width, sid0)
-                   if pre_width <= f and est_frames > 1.5 * one_shot_bound
-                   else None)
-        # sync on row 0 only (with a mesh the batch has dummy rows); by now
-        # acoustics is in flight or done
-        total_frames = int(jnp.sum(w_ceil[:1]))
-        self._observe_frames(weighted, total_frames)
-        if total_frames > f:  # underestimate: z would be clipped
-            f = bucket_for(total_frames, FRAME_BUCKETS)
-            z, y_lengths = run_acoustics(f)
-            pre_fut = None  # predispatched against the clipped z
+        # encode + acoustics ride the shared stage coalescer: N streams
+        # starting within the wait window become ONE batched encode and
+        # ONE batched acoustics dispatch (the reference gives each stream
+        # its own blocking session, grpc/src/main.rs:381-409 — linear
+        # degradation under load; here the device sees a batch)
+        z_row, total_frames, f, sid0 = self._stream_stages.start(ids, sc)
         total_frames = min(total_frames, f)
         enc_ms = (time.perf_counter() - t_enc0) * 1000.0
 
-        for plan in plan_chunks(total_frames, chunk_size, chunk_padding):
-            t0 = time.perf_counter()
+        # submit every window decode up-front: they are independent given
+        # z, so the whole stream's decodes pipeline through the coalescer
+        # (and batch with other streams') while the consumer drains chunk
+        # by chunk.  Window count is bounded by max-frames/min-chunk.
+        plans = list(plan_chunks(total_frames, chunk_size, chunk_padding))
+        submitted = []
+        for plan in plans:
             width = bucket_for(plan.width, FRAME_BUCKETS)
             start = min(plan.win_start, max(f - width, 0))
+            submitted.append(
+                (plan, start, width,
+                 self._stream_decoder.submit(z_row, start, width, sid0)))
+
+        for plan, start, width, fut in submitted:
+            t0 = time.perf_counter()
+            wav = fut.result()
             shift = plan.win_start - start  # window moved left by padding
-            # window decodes route through the shared coalescer so N
-            # concurrent streams' equal-width chunks ride one dispatch
-            # (the reference gives each stream its own blocking session,
-            # grpc/src/main.rs:381-409 — linear degradation under load)
-            if pre_fut is not None and start == 0 and width == pre_width:
-                wav = pre_fut.result()  # already in flight since encode
-            else:
-                wav = self._stream_decoder.decode(z[0], start, width, sid0)
-            pre_fut = None
             lo = (shift + plan.trim_left) * hop
             hi = (shift + plan.width - plan.trim_right) * hop
             samples = AudioSamples(wav[lo:hi])
@@ -1008,24 +1053,43 @@ class _StreamDecodeCoalescer:
         self._max_batch = max_batch
         self._max_wait = max_wait_ms / 1000.0
         self._queue: "queue.Queue" = queue.Queue()
+        # dispatch and result-fetch are separate pipeline stages: the
+        # dispatcher enqueues device programs back-to-back while the
+        # finisher blocks on each (async-prefetched) result copy.  A
+        # single thread doing both serialized every wave behind the
+        # previous wave's ~100 ms host-link fetch — under 8 concurrent
+        # streams that alone multiplied TTFB several-fold.
+        self._results: "queue.Queue" = queue.Queue()
         self.stats = {"requests": 0, "dispatches": 0}
         self._closed = False
         self._worker = threading.Thread(target=self._run,
                                         name="sonata_stream_decoder",
                                         daemon=True)
         self._worker.start()
+        self._finisher = threading.Thread(target=self._finish_loop,
+                                          name="sonata_stream_fetcher",
+                                          daemon=True)
+        self._finisher.start()
 
     def close(self) -> None:
         self._closed = True
-        self._queue.put(None)  # wake the worker
+        self._queue.put(None)   # wake the worker
+        self._results.put(None)  # wake the finisher
 
     def submit(self, z_row, start: int, width: int, sid: "Optional[int]"):
         """Enqueue a window decode; returns a Future of the [width*hop]
-        waveform.  ``z_row``: [F, C] device array."""
+        waveform.  ``z_row``: [F, C] device array.
+
+        The window is sliced out of ``z_row`` here, eagerly (a tiny
+        on-device op), so everything behind the queue handles fixed
+        [width, C] windows regardless of the utterance's frame bucket —
+        see :meth:`PiperVoice._decode_windows_batch_fn`."""
         from concurrent.futures import Future
 
+        window = jax.lax.dynamic_slice_in_dim(
+            z_row, jnp.int32(start), width, axis=0)
         fut: "Future[np.ndarray]" = Future()
-        self._queue.put((z_row, start, width, sid, fut))
+        self._queue.put((window, width, sid, fut))
         return fut
 
     def decode(self, z_row, start: int, width: int,
@@ -1068,12 +1132,12 @@ class _StreamDecodeCoalescer:
 
     @staticmethod
     def _key(item) -> tuple:
-        z_row, _start, width, sid, _fut = item
-        return (tuple(z_row.shape), width, sid is not None)
+        _window, width, sid, _fut = item
+        return (width, sid is not None)
 
     def _dispatch(self, group) -> None:
         v = self._voice_ref()
-        futures = [item[4] for item in group]
+        futures = [item[3] for item in group]
         if v is None:
             for fut in futures:
                 try:
@@ -1084,33 +1148,257 @@ class _StreamDecodeCoalescer:
             return
         try:
             n = len(group)
-            b = bucket_for(n, [x for x in BATCH_BUCKETS
-                               if x <= self._max_batch] or [self._max_batch])
+            # any multi-window group pads to ONE canonical batch size: the
+            # executable set is then exactly {b=1, b=max} — both prewarmed
+            # — so concurrency can never hit a cold compile mid-request.
+            # The padding rows' decode compute is cheap next to the
+            # XLA-compile stall a graduated bucket ladder risks per rung.
+            b = self._max_batch if n > 1 else 1
             pad = b - n
-            zs = jnp.stack([item[0] for item in group]
-                           + [group[0][0]] * pad)
-            starts = jnp.asarray([item[1] for item in group]
-                                 + [group[0][1]] * pad, dtype=jnp.int32)
-            width = group[0][2]
-            has_sid = group[0][3] is not None
-            args = [v.params, zs, starts]
+            windows = jnp.stack([item[0] for item in group]
+                                + [group[0][0]] * pad)
+            width = group[0][1]
+            has_sid = group[0][2] is not None
+            args = [v.params, windows]
             if has_sid:
                 args.append(jnp.asarray(
-                    [item[3] for item in group] + [group[0][3]] * pad,
+                    [item[2] for item in group] + [group[0][2]] * pad,
                     dtype=jnp.int32))
             fn = v._decode_windows_batch_fn(width, b, has_sid)
-            wavs = np.asarray(jax.block_until_ready(fn(*args)))
+            out = fn(*args)  # async dispatch
+            try:
+                out.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass
             self.stats["requests"] += n
             self.stats["dispatches"] += 1
+            self._results.put((out, futures))
         except Exception as e:
             for fut in futures:
                 try:
                     fut.set_exception(e)
                 except Exception:
                     pass
-            return
-        for fut, wav in zip(futures, wavs):
+
+    def _finish_loop(self) -> None:
+        while not self._closed:
             try:
-                fut.set_result(wav)
-            except Exception:
+                item = self._results.get(timeout=5.0)
+            except queue.Empty:
+                if self._voice_ref() is None:
+                    return
+                continue
+            if item is None:
+                continue
+            out, futures = item
+            try:
+                wavs = np.asarray(jax.device_get(out))
+            except Exception as e:
+                for fut in futures:
+                    try:
+                        fut.set_exception(e)
+                    except Exception:
+                        pass
+                continue
+            for fut, wav in zip(futures, wavs):
+                try:
+                    fut.set_result(wav)
+                except Exception:
+                    pass
+
+
+class _StreamStageCoalescer:
+    """Shared dispatcher for streaming encode+acoustics stages.
+
+    The window-decode coalescer (above) removed the per-chunk serialization
+    across concurrent streams, but every stream still paid its own serial
+    encode and acoustics dispatches at start — at 8 concurrent streams
+    those per-stream stages dominated TTFB.  Here stream *starts* that
+    arrive within ``max_wait_ms`` and share a text bucket become one
+    batched encode and one batched acoustics dispatch; per-row synthesis
+    scales and speaker ids ride the same row-wise arrays the batch path
+    uses, so streams with different configs still share a dispatch.
+
+    Pipeline shape mirrors the decode coalescer: a dispatcher thread
+    groups and enqueues device programs; a finisher thread blocks on each
+    group's (async-prefetched) frame counts, handles the rare
+    frame-budget retry, and resolves per-stream futures with their z row.
+    """
+
+    def __init__(self, voice: "PiperVoice", *, max_batch: int = 8,
+                 max_wait_ms: float = 8.0):
+        # max_wait is 4x the decode coalescer's: the stage runs once per
+        # stream (vs once per chunk), so a slightly longer gather window
+        # costs little TTFB but catches burst arrivals that thread
+        # scheduling spreads over a few milliseconds
+        import weakref
+
+        self._voice_ref = weakref.ref(voice)
+        self._max_batch = max_batch
+        self._max_wait = max_wait_ms / 1000.0
+        self._queue: "queue.Queue" = queue.Queue()
+        self._results: "queue.Queue" = queue.Queue()
+        self.stats = {"requests": 0, "dispatches": 0}
+        self._closed = False
+        self._worker = threading.Thread(target=self._run,
+                                        name="sonata_stream_stages",
+                                        daemon=True)
+        self._worker.start()
+        self._finisher = threading.Thread(target=self._finish_loop,
+                                          name="sonata_stage_fetcher",
+                                          daemon=True)
+        self._finisher.start()
+
+    def close(self) -> None:
+        self._closed = True
+        self._queue.put(None)
+        self._results.put(None)
+
+    def start(self, ids: list, sc: SynthesisConfig):
+        """Blocking: run encode+acoustics for one stream (possibly batched
+        with others).  Returns ``(z_row, total_frames, f, sid0)`` where
+        ``z_row`` is the [f, C] on-device latent, ``total_frames`` the true
+        frame count, ``f`` the allocated frame bucket, and ``sid0`` the
+        row's speaker id (None on single-speaker voices)."""
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+        self._queue.put((ids, sc, fut))
+        return fut.result()
+
+    # -- dispatcher -----------------------------------------------------
+    def _run(self) -> None:
+        while not self._closed:
+            try:
+                first = self._queue.get(timeout=5.0)
+            except queue.Empty:
+                if self._voice_ref() is None:
+                    return
+                continue
+            if first is None:
+                continue
+            group = [first]
+            key = self._key(first)
+            deadline = time.monotonic() + self._max_wait
+            leftovers = []
+            while len(group) < self._max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    break
+                if self._key(nxt) == key:
+                    group.append(nxt)
+                else:
+                    leftovers.append(nxt)
+            for item in leftovers:
+                self._queue.put(item)
+            self._dispatch(group)
+
+    @staticmethod
+    def _key(item) -> tuple:
+        ids, _sc, _fut = item
+        return (bucket_for(len(ids), TEXT_BUCKETS),)
+
+    def _dispatch(self, group) -> None:
+        v = self._voice_ref()
+        futures = [item[2] for item in group]
+        if v is None:
+            for fut in futures:
+                try:
+                    fut.set_exception(
+                        OperationError("voice was garbage-collected"))
+                except Exception:
+                    pass
+            return
+        try:
+            ids_list = [item[0] for item in group]
+            scs = [item[1] for item in group]
+            # same canonical-batch rule as the decode coalescer: any
+            # multi-stream group pads to max_batch rows, so only the
+            # (b=1, b=max) encode/acoustics shapes exist and prewarm
+            # covers them completely
+            if len(group) > 1:
+                pad_rows = self._max_batch - len(group)
+                ids_list = ids_list + [[0]] * pad_rows
+                scs = scs + [scs[0]] * pad_rows
+            ids, lens, b, t = v._pad_batch(ids_list)
+            speakers = None
+            if v.multi_speaker:
+                speakers = [sc.speaker[1] if sc.speaker else 0 for sc in scs]
+            sid = v._sid_array(scs[0], b, speakers)
+            nw, ls, ns, ls_host = v._scale_arrays(scs[0], b, scales=scs)
+            weighted = max(len(row) * max(ls_host[i], 0.05)
+                           for i, row in enumerate(ids_list))
+            f = v._estimate_frame_bucket(weighted)
+            # one split key per dispatch, like the fused batch path — a
+            # frame-budget retry reuses it for identical audio
+            rng_enc, rng_aco = jax.random.split(v._next_rng())
+            enc_args = [v.params, ids, lens, rng_enc, nw, ls]
+            if sid is not None:
+                enc_args.append(sid)
+            m_p, logs_p, w_ceil, x_mask = v._encode_fn(b, t)(*enc_args)
+            # per-row frame counts: prefetched so the finisher's fetch
+            # rides behind the acoustics dispatch
+            frames_vec = jnp.sum(w_ceil.reshape(b, -1), axis=1)
+            try:
+                frames_vec.copy_to_host_async()
+            except (AttributeError, RuntimeError):
                 pass
+
+            def run_acoustics(bucket: int):
+                args = [v.params, m_p, logs_p, w_ceil, x_mask, rng_aco, ns]
+                if sid is not None:
+                    args.append(sid)
+                return v._acoustics_fn(b, t, bucket)(*args)
+
+            z, _y_lengths = run_acoustics(f)
+            self.stats["requests"] += len(group)
+            self.stats["dispatches"] += 1
+            self._results.put((group, z, frames_vec, f, weighted, speakers,
+                               run_acoustics))
+        except Exception as e:
+            for fut in futures:
+                try:
+                    fut.set_exception(e)
+                except Exception:
+                    pass
+
+    # -- finisher -------------------------------------------------------
+    def _finish_loop(self) -> None:
+        while not self._closed:
+            try:
+                item = self._results.get(timeout=5.0)
+            except queue.Empty:
+                if self._voice_ref() is None:
+                    return
+                continue
+            if item is None:
+                continue
+            group, z, frames_vec, f, weighted, speakers, run_acoustics = item
+            v = self._voice_ref()
+            futures = [g[2] for g in group]
+            try:
+                frames = np.asarray(jax.device_get(frames_vec)).astype(int)
+                actual = int(frames[:len(group)].max())
+                if v is not None:
+                    v._observe_frames(weighted, actual)
+                if actual > f and v is not None:  # clipped: redo, same rng
+                    f = bucket_for(actual, FRAME_BUCKETS)
+                    z, _ = run_acoustics(f)
+                for i, (_ids, _sc, fut) in enumerate(group):
+                    sid0 = speakers[i] if speakers is not None else None
+                    try:
+                        fut.set_result((z[i], int(frames[i]), f, sid0))
+                    except Exception:
+                        pass
+            except Exception as e:
+                for fut in futures:
+                    try:
+                        fut.set_exception(e)
+                    except Exception:
+                        pass

@@ -505,8 +505,11 @@ BASE_WORDS = {
 }
 # fmt: on
 
+from .lexicon_extra import EXTRA_WORDS
+
 LEXICON: dict = {}
-LEXICON.update(BASE_WORDS)
+LEXICON.update(EXTRA_WORDS)
+LEXICON.update(BASE_WORDS)      # first bank wins on collisions
 LEXICON.update(FUNCTION_WORDS)  # function words win (unstressed forms)
 
 _VOICED_END = set("bdɡvðzʒlmnŋɹwj")  # note IPA ɡ (U+0261), not ASCII g

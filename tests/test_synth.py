@@ -340,3 +340,57 @@ def test_concurrent_streams_share_dispatches():
     assert all(r is not None and len(r) > 0 for r in results)
     stats = v._stream_coalescer.stats
     assert stats["dispatches"] < stats["requests"]
+
+
+def test_stream_stage_coalescer_batches_starts():
+    """Concurrent stream STARTS share one encode+acoustics dispatch, pad
+    to the canonical max batch, and still return per-stream latents that
+    drive correct chunk synthesis (round-2: stage coalescing)."""
+    import threading
+
+    from sonata_tpu.models.piper import _StreamStageCoalescer
+
+    v = tiny_voice(seed=7)
+    v._stage_coalescer = _StreamStageCoalescer(v, max_wait_ms=300.0)
+    sc = v.get_fallback_synthesis_config()
+    ids = v.config.phonemes_to_ids("həlˈoʊ wˈɜːld")
+    results = [None] * 3
+
+    def run(i):
+        results[i] = v._stream_stages.start(list(ids), sc)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for z_row, total_frames, f, sid0 in results:
+        assert z_row.shape[0] == f and z_row.shape[1] == v.hp.inter_channels
+        assert 0 < total_frames
+        assert sid0 is None  # single-speaker tiny voice
+    stats = v._stage_coalescer.stats
+    assert stats["dispatches"] < stats["requests"]
+    # the multi-stream group padded to the canonical batch: only the
+    # (1, t) and (max_batch, t) encode shapes may exist
+    enc_bs = {b for (b, _t) in v._enc_cache}
+    assert enc_bs <= {1, v._stage_coalescer._max_batch}
+
+
+def test_concurrent_streams_full_path_via_stage_coalescer():
+    """End-to-end: concurrent stream_synthesis calls complete and produce
+    audio with the stage coalescer active (default path)."""
+    import threading
+
+    v = tiny_voice(seed=11)
+    results = [None] * 3
+
+    def run(i):
+        chunks = list(v.stream_synthesis("wˈʌn tuː θɹˈiː fˈoːɹ", 12, 2))
+        results[i] = np.concatenate([c.samples.data for c in chunks])
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(r is not None and len(r) > 0 for r in results)
