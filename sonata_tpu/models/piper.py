@@ -434,16 +434,32 @@ class PiperVoice(BaseModel):
         # tunneled v5e even for a 16-sentence batch split in two.
         wavs: list[Optional[np.ndarray]] = [None] * n
         lengths = [0] * n
-        t_start = time.perf_counter()
+        row_ms = [0.0] * n
         pending: list[tuple[list[int], Any]] = []
         gi = 0
 
+        t_last_drain = time.perf_counter()
+
         def drain_one():
+            nonlocal t_last_drain
             chunk, ticket = pending.pop(0)
             w, wl = self._finish_batch(ticket)
+            # honest per-dispatch timing: each row carries the wall time
+            # attributable to the dispatch that produced it, amortized over
+            # that dispatch's rows — not the whole batch's average (the
+            # reference times each session.run, piper/src/lib.rs:361-380).
+            # With pipelining the device runs dispatches serially, so a
+            # ticket's interval starts at the later of its enqueue and the
+            # previous drain — raw enqueue→result would double-count the
+            # queue wait behind earlier in-flight groups.
+            now = time.perf_counter()
+            ms = (now - max(ticket["t_enqueue"], t_last_drain)) * 1000.0
+            t_last_drain = now
+            ms /= len(chunk)
             for row, i in enumerate(chunk):
                 wavs[i] = w[row]
                 lengths[i] = int(wl[row])
+                row_ms[i] = ms
 
         while gi < len(chunks) or pending:
             # until the frame estimator has a real observation, keep one
@@ -463,11 +479,10 @@ class PiperVoice(BaseModel):
                 pending.append((chunk, ticket))
             drain_one()
 
-        per_sentence_ms = (time.perf_counter() - t_start) * 1000.0 / n
         info = self.audio_output_info()
         return [
             Audio(AudioSamples(np.asarray(wavs[i][: lengths[i]])), info,
-                  inference_ms=per_sentence_ms)
+                  inference_ms=row_ms[i])
             for i in range(n)
         ]
 
@@ -939,7 +954,8 @@ class PiperVoice(BaseModel):
         out = self._full_fn(b, t, f)(*args)  # async dispatch
         self._prefetch_to_host(out)
         return {"out": out, "args": args, "b": b, "t": t, "f": f,
-                "n_real": n_real, "weighted_ids": weighted_ids}
+                "n_real": n_real, "weighted_ids": weighted_ids,
+                "t_enqueue": time.perf_counter()}
 
     @staticmethod
     def _prefetch_to_host(out) -> None:

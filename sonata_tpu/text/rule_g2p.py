@@ -106,16 +106,21 @@ def number_to_words(n: int) -> str:
     return number_to_words(m) + " million" + (" " + number_to_words(r) if r else "")
 
 
-def normalize_text(text: str) -> str:
-    """Lowercase, expand integers, drop symbols the G2P cannot speak."""
+def expand_numbers(text: str, number_words) -> str:
+    """Replace integer literals with ``number_words(n)`` renderings —
+    shared by every language pack's normalizer."""
     def _num(m: re.Match) -> str:
         try:
-            return " " + number_to_words(int(m.group(0))) + " "
+            return " " + number_words(int(m.group(0))) + " "
         except ValueError:
             return " "
 
-    text = re.sub(r"\d+", _num, text)
-    return text.lower()
+    return re.sub(r"\d+", _num, text)
+
+
+def normalize_text(text: str) -> str:
+    """Lowercase, expand integers, drop symbols the G2P cannot speak."""
+    return expand_numbers(text, number_to_words).lower()
 
 
 from .lexicon import IPA_VOWELS as _IPA_VOWEL_STARTS
@@ -225,18 +230,88 @@ def arabic_word_to_ipa(word: str) -> str:
     return "".join(_ARABIC.get(ch, "") for ch in word)
 
 
+def _word_to_ipa_de(word: str) -> str:
+    from . import rule_g2p_de
+
+    return rule_g2p_de.word_to_ipa(word)
+
+
+def _word_to_ipa_es(word: str) -> str:
+    from . import rule_g2p_es
+
+    return rule_g2p_es.word_to_ipa(word)
+
+
+def _normalize_de(text: str) -> str:
+    from . import rule_g2p_de
+
+    return rule_g2p_de.normalize_text(text)
+
+
+def _normalize_es(text: str) -> str:
+    from . import rule_g2p_es
+
+    return rule_g2p_es.normalize_text(text)
+
+
+# Language registry: language code → (normalizer, word→IPA).  The eSpeak
+# backend covers ~100 languages via compiled dictionaries
+# (reference: deps/dev/espeak-ng-data, espeak-phonemizer/build.rs:5-17);
+# the hermetic backend supports exactly the languages listed here and
+# REFUSES others rather than silently rendering them through English
+# letter-to-sound rules (which produces confidently wrong phonemes).
+_LANGUAGES: dict[str, tuple] = {
+    "en": (normalize_text, english_word_to_ipa),
+    "ar": (normalize_text, arabic_word_to_ipa),
+    "fa": (normalize_text, arabic_word_to_ipa),  # Arabic-script letter map
+    "ur": (normalize_text, arabic_word_to_ipa),
+    "de": (_normalize_de, _word_to_ipa_de),
+    "es": (_normalize_es, _word_to_ipa_es),
+}
+
+#: Env var: set to "1" to let unsupported languages fall back to English
+#: letter-to-sound rules (explicitly best-effort) instead of raising.
+BEST_EFFORT_ENV = "SONATA_G2P_BEST_EFFORT"
+
+
+def supported_languages() -> tuple[str, ...]:
+    """Language codes the hermetic backend can phonemize."""
+    return tuple(sorted(_LANGUAGES))
+
+
 def phonemize_clause(text: str, voice: str = "en-us") -> str:
     """Phonemize one clause of text into a single IPA string.
 
     Words become space-separated IPA runs, matching the shape of eSpeak
     output the downstream phoneme-id encoder expects (spaces are real
     symbols in Piper's ``phoneme_id_map``).
+
+    Raises :class:`~sonata_tpu.core.PhonemizationError` for languages the
+    hermetic backend has no rules for — silently emitting English-rule
+    phonemes for a German voice would be confidently wrong.  Set
+    ``SONATA_G2P_BEST_EFFORT=1`` to opt into the English fallback.
     """
+    import os
+
+    from ..core import PhonemizationError
+
     lang = voice.split("-")[0].lower()
+    entry = _LANGUAGES.get(lang)
+    if entry is None:
+        if os.environ.get(BEST_EFFORT_ENV) == "1":
+            entry = _LANGUAGES["en"]
+        else:
+            raise PhonemizationError(
+                f"hermetic G2P has no rules for language {lang!r} "
+                f"(voice {voice!r}); supported: "
+                f"{', '.join(supported_languages())}. Install libespeak-ng "
+                f"for full language coverage, or set {BEST_EFFORT_ENV}=1 "
+                f"to accept best-effort English letter-to-sound rules."
+            )
+    normalize, to_ipa = entry
     # \w excludes combining marks (category Mn), which would strip the very
     # diacritics the tashkeel stage inserts — include the Arabic harakat range
     words = re.findall(r"[\w'\u064B-\u0655\u0670]+",
-                       normalize_text(text), flags=re.UNICODE)
-    to_ipa = arabic_word_to_ipa if lang in ("ar", "fa", "ur") else english_word_to_ipa
+                       normalize(text), flags=re.UNICODE)
     ipa_words = [to_ipa(w) for w in words]
     return " ".join(w for w in ipa_words if w)

@@ -336,3 +336,102 @@ def test_secondary_only_words_still_get_primary_stress():
     # a ˌ-prefixed derivation never produces adjacent ˈˌ
     assert "ˈˌ" not in g("overwork") and "ˌˈ" not in g("overwork")
     assert "ˈ" in g("overwork")
+
+
+GOLDEN_CORPUS_DE = [
+    ("Hallo Welt, wie geht es dir heute?",
+     "haˈloː vɛlt viː ɡeːt ɛs dɪʁ ˈhɔʏtə"),
+    ("Ich spreche ein bisschen Deutsch",
+     "ɪç ˈʃpʁɛçə aɪn ˈbɪʃən dɔʏtʃ"),
+    ("Der Himmel über der Stadt war grau",
+     "dɛɐ ˈhɪməl ˈyːbɐ dɛɐ ʃtat vaːɐ ɡʁaʊ"),
+    ("einundzwanzig Schiffe fahren nach Hamburg",
+     "ˈaɪnʊndtsvantsɪç ˈʃɪfə ˈfaːʁən naːx ˈhambʊʁk"),
+    ("Guten Morgen, mein Freund",
+     "ˈɡʊtən ˈmɔʁɡən maɪn fʁɔʏnt"),
+]
+
+GOLDEN_CORPUS_ES = [
+    ("Hola mundo, ¿cómo estás?", "ˈola ˈmundo ˈkomo esˈtas"),
+    ("El perro corre rápidamente por la calle",
+     "el ˈpero ˈkore ˈrapidamente poɾ la ˈkaʝe"),
+    ("la canción española es muy bonita",
+     "la kanˈθion espaˈɲola es mui boˈnita"),
+    ("veintitrés años en la ciudad de México",
+     "beintiˈtɾes ˈaɲos en la θiuˈdad de ˈmeksiko"),
+    ("Buenos días, señor García", "ˈbuenos ˈdias seˈɲoɾ ɡaɾˈθia"),
+]
+
+
+def test_golden_ipa_corpus_german():
+    """German rule pack: digraphs (sch/ch/ck), diphthongs (ei/eu/au),
+    final devoicing, -er→ɐ / -en→ən reduction, initial-stress default
+    skipping unstressed prefixes."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    for text, golden in GOLDEN_CORPUS_DE:
+        assert phonemize_clause(text, voice="de") == golden, text
+
+
+def test_golden_ipa_corpus_spanish():
+    """Spanish rule pack: Castilian θ/x, ll→ʝ, ñ, tap-vs-trill r,
+    accent-driven and default (vowel/n/s → penultimate) stress."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    for text, golden in GOLDEN_CORPUS_ES:
+        assert phonemize_clause(text, voice="es") == golden, text
+
+
+def test_german_unstressed_prefixes():
+    from sonata_tpu.text.rule_g2p_de import word_to_ipa
+
+    # stress lands after be-/ge-/ver-: second syllable carries ˈ
+    for w in ("verstehen", "gefallen", "bekommen"):
+        ipa = word_to_ipa(w)
+        first_vowel = next(i for i, c in enumerate(ipa) if c in "aeiouɛɪɔʊœʏəɐ")
+        assert "ˈ" in ipa and ipa.index("ˈ") > first_vowel, (w, ipa)
+
+
+def test_spanish_stress_rules():
+    from sonata_tpu.text.rule_g2p_es import word_to_ipa
+
+    # written accent wins
+    assert word_to_ipa("cancion") != word_to_ipa("canción")
+    assert word_to_ipa("canción").endswith("ˈθion")
+    # vowel-final → penultimate
+    assert word_to_ipa("casa") == "ˈkasa"
+    # consonant-final (not n/s) → final
+    assert word_to_ipa("ciudad") == "θiuˈdad"
+    # n/s-final → penultimate
+    assert word_to_ipa("lunes") == "ˈlunes"
+
+
+def test_unsupported_language_raises():
+    import pytest
+
+    from sonata_tpu.core import PhonemizationError
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    with pytest.raises(PhonemizationError, match="no rules for language 'fr'"):
+        phonemize_clause("bonjour le monde", voice="fr")
+
+
+def test_unsupported_language_best_effort_env(monkeypatch):
+    from sonata_tpu.text.rule_g2p import BEST_EFFORT_ENV, phonemize_clause
+
+    monkeypatch.setenv(BEST_EFFORT_ENV, "1")
+    # explicit opt-in: falls back to English letter-to-sound, no raise
+    assert phonemize_clause("bonjour", voice="fr")
+
+
+def test_language_number_expansion():
+    from sonata_tpu.text.rule_g2p_de import number_to_words as de_num
+    from sonata_tpu.text.rule_g2p_es import number_to_words as es_num
+
+    assert de_num(21) == "einundzwanzig"
+    assert de_num(101) == "einhunderteins"
+    assert de_num(1001) == "eintausendeins"
+    assert es_num(23) == "veintitrés"
+    assert es_num(33) == "treinta y tres"
+    assert es_num(500) == "quinientos"
+    assert es_num(2001) == "dos mil uno"

@@ -394,3 +394,24 @@ def test_concurrent_streams_full_path_via_stage_coalescer():
     for t in threads:
         t.join()
     assert all(r is not None and len(r) > 0 for r in results)
+
+
+def test_speak_batch_per_dispatch_timing():
+    """Per-row inference_ms reflects the dispatch that produced the row
+    (reference times each session.run — piper/src/lib.rs:361-380): rows
+    sharing a dispatch group share one measured wall time; rows in
+    different groups carry different measurements — not one whole-batch
+    average fabricated uniformly."""
+    voice = tiny_voice()
+    short = ["wʌn.", "tuː.", "θɹiː."]
+    # a text-bucket jump past 2x forces a second dispatch group
+    long_ipa = ("ðɪs ɪz ə mʌtʃ lɔːŋɡɚ sɛntəns wɪθ mɛni mɔːɹ foʊniːmz "
+                "ðæn ðə ʃɔːɹt wʌnz səʊ ɪt lændz ɪn ə fɑːɹ lɑːɹdʒɚ "
+                "tɛkst bʌkɪt ænd ɡɛts ɪts oʊn dɪspætʃ.")
+    audios = voice.speak_batch(short + [long_ipa])
+    ms = [a.inference_ms for a in audios]
+    assert all(m > 0 for m in ms)
+    # the three short rows rode one dispatch: identical measured time
+    assert ms[0] == ms[1] == ms[2]
+    # the long row rode its own dispatch: its own measured time
+    assert ms[3] != ms[0]
