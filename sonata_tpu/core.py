@@ -196,3 +196,10 @@ class BaseModel:
         raise OperationError(
             "this model does not support streaming synthesis"
         )  # parity: core/src/lib.rs:124-129 default-error impl
+
+    def close(self) -> None:
+        """Release model-owned resources (threads, device buffers).
+
+        Counterpart of the reference's voice unload
+        (``capi/src/lib.rs:228``); default is a no-op for models without
+        background machinery.  Idempotent."""

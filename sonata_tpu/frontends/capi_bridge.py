@@ -50,8 +50,10 @@ def _get(handle: int) -> SpeechSynthesizer:
 
 def unload_voice(handle: int) -> None:
     with _lock:
-        if _voices.pop(handle, None) is None:
-            raise KeyError(f"invalid voice handle {handle}")
+        synth = _voices.pop(handle, None)
+    if synth is None:
+        raise KeyError(f"invalid voice handle {handle}")
+    synth.close()  # stop the voice's coalescer threads, fail queued work
 
 
 def audio_info(handle: int) -> tuple[int, int, int]:

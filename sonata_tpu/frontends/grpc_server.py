@@ -256,6 +256,29 @@ class SonataGrpcService:
         except SonataError as e:
             context.abort(_status_for(e), str(e))
 
+    def UnloadVoice(self, request: pb.VoiceIdentifier,
+                    context) -> pb.Empty:
+        """Drop a loaded voice and stop its coalescer threads (sonata-tpu
+        extension; the reference only unloads via the C API,
+        ``capi/src/lib.rs:228``).  In-flight streams on the voice fail
+        with an OperationError-mapped status rather than hanging."""
+        with self._lock:
+            v = self._voices.pop(request.voice_id, None)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"no voice with id {request.voice_id}")
+        v.voice.close()
+        log.info("unloaded voice %s", request.voice_id)
+        return pb.Empty()
+
+    def shutdown(self) -> None:
+        """Close every loaded voice (server termination path)."""
+        with self._lock:
+            voices = list(self._voices.values())
+            self._voices.clear()
+        for v in voices:
+            v.voice.close()
+
     def ListVoices(self, request: pb.Empty, context) -> pb.VoiceList:
         """sonata-tpu extension: catalog of loaded voices (the reference
         has no listing endpoint)."""
@@ -307,6 +330,7 @@ _METHODS = {
     "SynthesizeUtterance": (pb.Utterance, pb.SynthesisResult, True),
     "SynthesizeUtteranceRealtime": (pb.Utterance, pb.WaveSamples, True),
     "ListVoices": (pb.Empty, pb.VoiceList, False),
+    "UnloadVoice": (pb.VoiceIdentifier, pb.Empty, False),
 }
 
 
@@ -423,6 +447,9 @@ def main(argv=None) -> int:
         server.wait_for_termination()
     except KeyboardInterrupt:
         server.stop(grace=2.0)
+        service = getattr(server, "sonata_service", None)
+        if service is not None:  # absent on test stubs
+            service.shutdown()
     return 0
 
 

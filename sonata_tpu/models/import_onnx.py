@@ -219,6 +219,40 @@ def read_onnx_graph(
     return inits, nodes
 
 
+def recover_folded_conv_weights(inits: dict, nodes: list) -> dict:
+    """Name anonymous folded conv weights after their conv's named bias.
+
+    Graph optimizers (onnxsim, ORT offline optimization, newer
+    ``torch.onnx.export`` folding) precompute the weight-norm
+    ``g*v/||v||`` product into a single anonymous constant
+    (``onnx::Conv_123``, ``/Mul_7_output_0``) and drop the named
+    ``weight_g``/``weight_v`` initializers — but the conv's *bias* is not
+    part of weight norm, so it keeps its parameter name.  For every
+    Conv/ConvTranspose node whose weight input is an anonymous tensor and
+    whose bias is a named ``{prefix}.bias``, register the weight tensor
+    under ``{prefix}.weight`` so the state-dict mapper sees the layout it
+    expects (ONNX Conv/ConvTranspose weight layouts equal torch's).
+    """
+    out = dict(inits)
+    for n in nodes:
+        if n["op_type"] not in ("Conv", "ConvTranspose"):
+            continue
+        ins = n["inputs"]
+        if len(ins) < 3:
+            continue
+        w_name, b_name = ins[1], ins[2]
+        if not b_name.endswith(".bias"):
+            continue
+        prefix = b_name[: -len(".bias")]
+        if f"{prefix}.weight" in out or f"{prefix}.weight_v" in out:
+            continue  # named weight (or recoverable g/v pair) already there
+        anonymous = (w_name.startswith("/") or "::" in w_name
+                     or not w_name.endswith((".weight", ".weight_v")))
+        if anonymous and w_name in out:
+            out[f"{prefix}.weight"] = out[w_name]
+    return out
+
+
 def resolve_identity_aliases(inits: dict, nodes: list) -> dict:
     """Materialize tensors routed through ``Identity`` nodes.
 
@@ -290,12 +324,15 @@ def import_onnx_weights(path: Union[str, Path, "tuple", "list"],
                                     n_speakers=n_speakers)
     except FailedToLoadResource:
         # torch.onnx.export deduplicates value-identical tensors behind
-        # Identity nodes (e.g. untouched LayerNorm gammas); retry with the
-        # full graph walk resolving those aliases
+        # Identity nodes (e.g. untouched LayerNorm gammas), and graph
+        # optimizers fold weight-norm products into anonymous constants;
+        # retry with the full graph walk resolving both
         resolved = []
         for p in paths:
             inits, nodes = read_onnx_graph(p)
-            resolved.append((str(p), resolve_identity_aliases(inits, nodes)))
+            inits = resolve_identity_aliases(inits, nodes)
+            inits = recover_folded_conv_weights(inits, nodes)
+            resolved.append((str(p), inits))
         sd = to_f32(_merge_initializers(resolved))
         return state_dict_to_params(strip_prefix(sd), hp, n_vocab=n_vocab,
                                     n_speakers=n_speakers)

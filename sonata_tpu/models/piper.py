@@ -288,9 +288,19 @@ class PiperVoice(BaseModel):
                 break
         self.prewarm_neighbor_buckets()
         if streaming:
-            for _chunk in self.stream_synthesis(phonemes[-1], chunk_size,
-                                                chunk_padding):
-                pass
+            # one streamed drain per distinct text bucket: streaming
+            # coverage must match the batch path's, or the first real
+            # stream in an undrained bucket pays the cold encode mid-TTFB
+            by_bucket: dict[int, str] = {}
+            for p in phonemes:
+                tb = bucket_for(len(self.config.phonemes_to_ids(p)),
+                                TEXT_BUCKETS)
+                if tb not in by_bucket or len(p) > len(by_bucket[tb]):
+                    by_bucket[tb] = p
+            for p in by_bucket.values():
+                for _chunk in self.stream_synthesis(p, chunk_size,
+                                                    chunk_padding):
+                    pass
             self._prewarm_stream_batches()
         return len(self._full_cache)
 
@@ -298,13 +308,17 @@ class PiperVoice(BaseModel):
         """Compile the coalesced-batch window decoders for every streamed
         width warmed so far.
 
-        Under concurrent load the stream coalescer groups equal-width
-        windows into b ∈ {2, 4, 8} batched decodes; a sequential warmup
-        only ever compiles b=1, so the first wave of real concurrency
-        would pay one mid-request XLA compile per batch shape (measured:
-        ~90x TTFB regression at 4 streams on a remote chip).  Runs each
-        shape once with dummy windows, blocking, so the executables are
-        resident (and in the persistent cache) before traffic arrives.
+        Under concurrent load the stream coalescers pad every multi-request
+        group to ONE canonical batch size — the executable set is exactly
+        {b=1, b=max} per stage, never a graduated bucket ladder — so a
+        sequential warmup (which only compiles b=1) leaves precisely one
+        more shape per stage to warm here; without it the first wave of
+        real concurrency pays one mid-request XLA compile per stage
+        (measured: ~90x TTFB regression at 4 streams on a remote chip).
+        Runs each shape once with dummy windows, blocking, so the
+        executables are resident (and in the persistent cache) before
+        traffic arrives.  Best-effort: a failing warm thunk (e.g. a
+        sharding mismatch on an exotic mesh) must not abort serving.
         """
         from concurrent.futures import ThreadPoolExecutor
 
@@ -316,9 +330,8 @@ class PiperVoice(BaseModel):
         co = self._stream_decoder
         c = self.hp.inter_channels
         thunks = []
-        # both coalescers pad every multi-request group to their max batch,
-        # so exactly ONE concurrent shape per stage needs warming
         for (_, width, _b, has_sid) in seen:
+            # the decode coalescer dispatches exactly max_batch rows
             b = co._max_batch
 
             def warm_dec(width=width, b=b, has_sid=has_sid):
@@ -331,36 +344,64 @@ class PiperVoice(BaseModel):
 
             thunks.append(warm_dec)
         # the stage coalescer batches stream STARTS too: warm the b=max
-        # encode/acoustics shapes it dispatches under concurrency
-        for (_eb, t) in enc_seen:
-            b = self._stream_stages._max_batch
+        # encode/acoustics shapes it dispatches under concurrency.  Its
+        # dispatch routes through _pad_batch, which can round the batch up
+        # past max_batch to a multiple of the mesh data axis — derive the
+        # warm batch through the same call or the warmed shape would never
+        # match dispatch-time shapes on a non-dividing mesh.
+        _, _, stage_b, _ = self._pad_batch(
+            [[0]] * self._stream_stages._max_batch)
+        # acoustics frame buckets ride the adaptive estimator, which keeps
+        # refining between warm and real traffic — warm each seen bucket's
+        # neighbors too, like prewarm_neighbor_buckets does for the fused
+        # path, or the first post-warm stream lands one bucket over cold
+        aco_targets = set(aco_seen)
+        for fa in aco_seen:
+            if fa in FRAME_BUCKETS:
+                i = FRAME_BUCKETS.index(fa)
+                aco_targets.add(FRAME_BUCKETS[max(i - 1, 0)])
+                aco_targets.add(FRAME_BUCKETS[min(i + 1,
+                                                  len(FRAME_BUCKETS) - 1)])
+        for (eb, t) in enc_seen:
+            # warm both the shape already seen (b=1 drains) and the
+            # canonical coalesced-batch shape
+            for b in {eb, stage_b}:
 
-            def warm_stage(t=t, b=b):
-                ids = jnp.zeros((b, t), jnp.int32)
-                lens = jnp.ones((b,), jnp.int32)
-                nw = jnp.full((b,), 0.8, jnp.float32)
-                ls = jnp.ones((b,), jnp.float32)
-                ns = jnp.full((b,), 0.667, jnp.float32)
-                rng = jax.random.PRNGKey(0)
-                enc_args = [self.params, ids, lens, rng, nw, ls]
-                if self.multi_speaker:
-                    enc_args.append(jnp.zeros((b,), jnp.int32))
-                out = self._encode_fn(b, t)(*enc_args)
-                m_p, logs_p, w_ceil, x_mask = jax.block_until_ready(out)
-                for fa in aco_seen:
-                    aco_args = [self.params, m_p, logs_p, w_ceil,
-                                x_mask, rng, ns]
+                def warm_stage(t=t, b=b):
+                    ids = jnp.zeros((b, t), jnp.int32)
+                    lens = jnp.ones((b,), jnp.int32)
+                    nw = jnp.full((b,), 0.8, jnp.float32)
+                    ls = jnp.ones((b,), jnp.float32)
+                    ns = jnp.full((b,), 0.667, jnp.float32)
+                    rng = jax.random.PRNGKey(0)
+                    enc_args = [self.params, ids, lens, rng, nw, ls]
                     if self.multi_speaker:
-                        aco_args.append(jnp.zeros((b,), jnp.int32))
-                    jax.block_until_ready(
-                        self._acoustics_fn(b, t, fa)(*aco_args))
+                        enc_args.append(jnp.zeros((b,), jnp.int32))
+                    out = self._encode_fn(b, t)(*enc_args)
+                    m_p, logs_p, w_ceil, x_mask = jax.block_until_ready(out)
+                    for fa in sorted(aco_targets):
+                        aco_args = [self.params, m_p, logs_p, w_ceil,
+                                    x_mask, rng, ns]
+                        if self.multi_speaker:
+                            aco_args.append(jnp.zeros((b,), jnp.int32))
+                        jax.block_until_ready(
+                            self._acoustics_fn(b, t, fa)(*aco_args))
 
-            thunks.append(warm_stage)
+                thunks.append(warm_stage)
+        def best_effort(th):
+            try:
+                th()
+            except Exception as e:  # warm failure must not abort serving
+                import logging
+
+                logging.getLogger("sonata").warning(
+                    "prewarm thunk failed (continuing): %s", e)
+
         # compile concurrently: each thunk's first call blocks in XLA, and
         # the compiles for distinct shapes don't depend on each other —
         # 4 workers roughly quarter a cold boot's multi-minute warm
         with ThreadPoolExecutor(4, thread_name_prefix="sonata_warm") as ex:
-            for res in ex.map(lambda th: th(), thunks):
+            for res in ex.map(best_effort, thunks):
                 pass
 
     def prewarm_neighbor_buckets(self) -> None:
@@ -830,7 +871,14 @@ class PiperVoice(BaseModel):
                     return vits.decode(params, hp, windows, g=g,
                                        compute_dtype=cdt)
 
-                fn = jax.jit(run)
+                # donate the stacked windows: each dispatch stacks a fresh
+                # [B, width, C] buffer that nothing reads afterwards, so
+                # XLA may reuse its HBM for decoder intermediates (the
+                # upsampling stack's working set is the streaming path's
+                # peak memory).  No retry path exists here, unlike the
+                # fused batch fn whose overflow re-dispatch must reuse its
+                # args.  TPU-only effect; CPU ignores donation.
+                fn = jax.jit(run, donate_argnums=(1,))
                 self._dec_cache[key] = fn
         return fn
 
@@ -847,6 +895,24 @@ class PiperVoice(BaseModel):
             if self._stage_coalescer is None:
                 self._stage_coalescer = _StreamStageCoalescer(self)
             return self._stage_coalescer
+
+    def close(self) -> None:
+        """Unload the voice: stop the coalescer threads and fail their
+        queued work.
+
+        The reference's `libsonataUnloadSonataVoice`
+        (``capi/src/lib.rs:228``) drops the model; here the voice also
+        owns four lazily-spawned daemon threads, which without an explicit
+        close linger up to one 5 s poll interval after the last reference
+        drops.  Idempotent; a closed voice can still synthesize
+        non-streaming batches (the coalescers are streaming-only)."""
+        with self._jit_lock:
+            decoder, self._stream_coalescer = self._stream_coalescer, None
+            stages, self._stage_coalescer = self._stage_coalescer, None
+        if decoder is not None:
+            decoder.close()
+        if stages is not None:
+            stages.close()
 
     def _pad_batch(self, ids_list: list[list[int]]):
         """Pad a sentence batch to (batch, text) buckets.
@@ -1018,20 +1084,25 @@ class PiperVoice(BaseModel):
         total_frames = min(total_frames, f)
         enc_ms = (time.perf_counter() - t_enc0) * 1000.0
 
-        # submit every window decode up-front: they are independent given
-        # z, so the whole stream's decodes pipeline through the coalescer
-        # (and batch with other streams') while the consumer drains chunk
-        # by chunk.  Window count is bounded by max-frames/min-chunk.
+        # window decodes are independent given z, so they pipeline through
+        # the coalescer (and batch with other streams') while the consumer
+        # drains chunk by chunk — but only a bounded look-ahead is in
+        # flight: a stream abandoned early (gRPC client cancel drops the
+        # generator) then wastes at most LOOKAHEAD window decodes and
+        # coalescer slots instead of decoding its whole tail on-device.
+        LOOKAHEAD = 3
         plans = list(plan_chunks(total_frames, chunk_size, chunk_padding))
-        submitted = []
-        for plan in plans:
+
+        def submit(plan):
             width = bucket_for(plan.width, FRAME_BUCKETS)
             start = min(plan.win_start, max(f - width, 0))
-            submitted.append(
-                (plan, start, width,
-                 self._stream_decoder.submit(z_row, start, width, sid0)))
+            return (plan, start, width,
+                    self._stream_decoder.submit(z_row, start, width, sid0))
 
-        for plan, start, width, fut in submitted:
+        submitted = [submit(p) for p in plans[:LOOKAHEAD]]
+        next_i = len(submitted)
+        while submitted:
+            plan, start, width, fut = submitted.pop(0)
             t0 = time.perf_counter()
             wav = fut.result()
             shift = plan.win_start - start  # window moved left by padding
@@ -1041,7 +1112,33 @@ class PiperVoice(BaseModel):
             samples.crossfade(CROSSFADE_SAMPLES)  # edge taper (:838)
             ms = (time.perf_counter() - t0) * 1000.0 + enc_ms
             enc_ms = 0.0  # encoder cost attributed to the first chunk
+            if next_i < len(plans):  # top up the look-ahead before yielding
+                submitted.append(submit(plans[next_i]))
+                next_i += 1
             yield Audio(samples, info, inference_ms=ms)
+
+
+def _drain_pending_futures(q: "queue.Queue", fut_of, reason: str) -> None:
+    """Fail every future still sitting in a coalescer queue.
+
+    ``fut_of(item)`` extracts the future(s) from one queued item.  Called
+    on close after the worker threads have exited: without it a caller
+    blocked in ``fut.result()`` (no timeout) would hang forever on a
+    voice unloaded mid-request.
+    """
+    while True:
+        try:
+            item = q.get_nowait()
+        except queue.Empty:
+            return
+        if item is None:
+            continue
+        futs = fut_of(item)
+        for fut in (futs if isinstance(futs, list) else [futs]):
+            try:
+                fut.set_exception(OperationError(reason))
+            except Exception:
+                pass
 
 
 class _StreamDecodeCoalescer:
@@ -1088,9 +1185,19 @@ class _StreamDecodeCoalescer:
         self._finisher.start()
 
     def close(self) -> None:
+        """Stop both threads and fail any work still queued.
+
+        Joins the worker before draining so nothing is added to a queue
+        after its drain; requests already dispatched to the device resolve
+        normally via the finisher before it exits."""
         self._closed = True
         self._queue.put(None)   # wake the worker
         self._results.put(None)  # wake the finisher
+        self._worker.join(timeout=10.0)
+        self._finisher.join(timeout=10.0)
+        reason = "stream-decode coalescer closed (voice unloaded)"
+        _drain_pending_futures(self._queue, lambda it: it[3], reason)
+        _drain_pending_futures(self._results, lambda it: it[1], reason)
 
     def submit(self, z_row, start: int, width: int, sid: "Optional[int]"):
         """Enqueue a window decode; returns a Future of the [width*hop]
@@ -1266,9 +1373,17 @@ class _StreamStageCoalescer:
         self._finisher.start()
 
     def close(self) -> None:
+        """Stop both threads and fail any work still queued (see
+        :meth:`_StreamDecodeCoalescer.close`)."""
         self._closed = True
         self._queue.put(None)
         self._results.put(None)
+        self._worker.join(timeout=10.0)
+        self._finisher.join(timeout=10.0)
+        reason = "stream-stage coalescer closed (voice unloaded)"
+        _drain_pending_futures(self._queue, lambda it: it[2], reason)
+        _drain_pending_futures(self._results,
+                               lambda it: [g[2] for g in it[0]], reason)
 
     def start(self, ids: list, sc: SynthesisConfig):
         """Blocking: run encode+acoustics for one stream (possibly batched

@@ -82,6 +82,13 @@ class SpeechSynthesizer:
     def set_fallback_synthesis_config(self, cfg) -> None:
         self.model.set_fallback_synthesis_config(cfg)
 
+    def close(self) -> None:
+        """Release the wrapped model's resources (worker threads); the
+        synthesizer delegates like every other model method."""
+        close = getattr(self.model, "close", None)
+        if close is not None:
+            close()
+
     # -- processing helper ---------------------------------------------------
     def _post_process(self, audio: Audio,
                       output_config: Optional[AudioOutputConfig]) -> Audio:

@@ -315,3 +315,34 @@ def test_server_main_mesh_flags(monkeypatch):
     gs.main(["--mesh-devices", "8", "--seq-parallel", "2"])
     assert captured["mesh"] is not None
     assert dict(captured["mesh"].shape) == {"data": 4, "seq": 2}
+
+
+def test_unload_voice(server_and_voice, tmp_path):
+    """UnloadVoice (sonata-tpu extension) drops the voice, stops its
+    worker threads, and subsequent requests for it NOT_FOUND; unloading an
+    unknown id also NOT_FOUND."""
+    channel, _ = server_and_voice
+    vdir = tmp_path / "unload_voice"
+    vdir.mkdir()
+    cfg = str(write_tiny_voice(vdir, seed=3))
+    info = _unary(channel, "LoadVoice", pb.VoicePath(config_path=cfg),
+                  pb.VoiceInfo)
+    # stream once so the voice's coalescer threads exist
+    chunks = _stream(channel, "SynthesizeUtteranceRealtime",
+                     pb.Utterance(voice_id=info.voice_id, text="one two."),
+                     pb.WaveSamples)
+    assert chunks
+    _unary(channel, "UnloadVoice",
+           pb.VoiceIdentifier(voice_id=info.voice_id), pb.Empty)
+    with pytest.raises(grpc.RpcError) as e:
+        _unary(channel, "GetVoiceInfo",
+               pb.VoiceIdentifier(voice_id=info.voice_id), pb.VoiceInfo)
+    assert e.value.code() == grpc.StatusCode.NOT_FOUND
+    with pytest.raises(grpc.RpcError) as e:
+        _unary(channel, "UnloadVoice",
+               pb.VoiceIdentifier(voice_id=info.voice_id), pb.Empty)
+    assert e.value.code() == grpc.StatusCode.NOT_FOUND
+    # reload works after unload (fresh voice under the same id)
+    info2 = _unary(channel, "LoadVoice", pb.VoicePath(config_path=cfg),
+                   pb.VoiceInfo)
+    assert info2.voice_id == info.voice_id

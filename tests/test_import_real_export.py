@@ -99,3 +99,69 @@ def test_multispeaker_export_imports(tmp_path):
                         jax.random.PRNGKey(0), max_frames=64,
                         sid=jnp.array([2], jnp.int32))
     assert np.isfinite(np.asarray(wav)).all()
+
+
+def test_folded_export_imports(tmp_path):
+    """``do_constant_folding=True`` over a forward that actually RUNS the
+    convs (so the weight-norm subgraph is in the traced graph, the shape
+    optimizer-processed piper graphs have) still imports, numerics checked
+    against torch's own effective weights (VERDICT r2 next#3)."""
+    torch.manual_seed(0)
+    hp = tiny_voice().hp
+    n_vocab = tiny_voice().config.num_symbols
+    model = TinyPiperVits(hp, n_vocab, trace_convs=True)
+    export_vits_onnx(model, tmp_path / "folded.onnx", fold=True)
+    params = import_onnx_weights(tmp_path / "folded.onnx", hp,
+                                 n_vocab=n_vocab)
+    _check_imported(params, model, hp, n_vocab)
+
+
+def test_weightnorm_removed_export_imports(tmp_path):
+    """Real Piper exports call remove_weight_norm() before export, so the
+    file ships plain fused ``.weight`` tensors and no g/v pairs at all;
+    the importer must accept that layout and reproduce torch's fused
+    weights exactly."""
+    torch.manual_seed(0)
+    hp = tiny_voice().hp
+    n_vocab = tiny_voice().config.num_symbols
+    model = TinyPiperVits(hp, n_vocab, trace_convs=True)
+    # ground truth BEFORE stripping: torch's effective WN weight
+    m0 = model.flow.flows[0].enc.in_layers[0]
+    with torch.no_grad():
+        eff = torch._weight_norm(m0.weight_v, m0.weight_g, 0).numpy().copy()
+    export_vits_onnx(model, tmp_path / "plain.onnx", fold=True,
+                     remove_wn=True)
+    from sonata_tpu.models.import_onnx import read_onnx_initializers
+    sd = read_onnx_initializers(tmp_path / "plain.onnx")
+    assert not any(k.endswith(("weight_g", "weight_v")) for k in sd)
+    params = import_onnx_weights(tmp_path / "plain.onnx", hp,
+                                 n_vocab=n_vocab)
+    np.testing.assert_allclose(
+        np.asarray(params["flow"]["layers"][0]["wn"]["in"][0]["w"]),
+        eff.transpose(2, 1, 0), atol=1e-5)
+    ids = jnp.zeros((1, 16), jnp.int32).at[0, :8].set(1)
+    wav, _ = vits.infer(params, hp, ids, jnp.array([8], jnp.int32),
+                        jax.random.PRNGKey(0), max_frames=64)
+    assert np.isfinite(np.asarray(wav)).all()
+
+
+def test_recover_folded_conv_weights_unit():
+    """A graph whose conv weight was folded to an anonymous constant (the
+    onnxsim/ORT-offline shape) recovers the parameter name from the conv
+    node's named bias."""
+    from sonata_tpu.models.import_onnx import recover_folded_conv_weights
+
+    w = np.ones((4, 2, 3), np.float32)
+    inits = {"onnx::Conv_123": w, "dec.conv_pre.bias": np.zeros(4, np.float32)}
+    nodes = [{"op_type": "Conv", "attrs": {},
+              "inputs": ["x", "onnx::Conv_123", "dec.conv_pre.bias"],
+              "outputs": ["y"]}]
+    out = recover_folded_conv_weights(inits, nodes)
+    assert np.array_equal(out["dec.conv_pre.weight"], w)
+    # a named weight input is left alone
+    inits2 = {"dec.conv_pre.weight": w, "dec.conv_pre.bias": inits["dec.conv_pre.bias"]}
+    nodes2 = [{"op_type": "Conv", "attrs": {},
+               "inputs": ["x", "dec.conv_pre.weight", "dec.conv_pre.bias"],
+               "outputs": ["y"]}]
+    out2 = recover_folded_conv_weights(inits2, nodes2)
+    assert set(out2) == set(inits2)

@@ -415,3 +415,127 @@ def test_speak_batch_per_dispatch_timing():
     assert ms[0] == ms[1] == ms[2]
     # the long row rode its own dispatch: its own measured time
     assert ms[3] != ms[0]
+
+
+def test_prewarm_invariant_no_cold_compiles():
+    """THE property prewarm exists for: after prewarm(streaming=True), a
+    concurrent 8-stream burst plus a batched wave trigger ZERO new
+    executable-cache entries — warm-path serving never pays a mid-request
+    XLA compile (VERDICT r2 next#4)."""
+    import threading
+
+    v = tiny_voice(seed=21)
+    v.prewarm(streaming=True, chunk_size=12, chunk_padding=2)
+
+    def cache_keys():
+        # dict keys plus each jitted fn's internal shape-specialization
+        # count: a new (batch, text) shape through a cached fn is a cold
+        # compile the outer dicts cannot see
+        def sizes(d):
+            return {k: getattr(fn, "_cache_size", lambda: -1)()
+                    for k, fn in d.items()}
+
+        return (sizes(v._full_cache), sizes(v._enc_cache),
+                sizes(v._aco_cache), sizes(v._dec_cache))
+
+    warmed = cache_keys()
+
+    # burst texts come from the prewarm set: that is the coverage prewarm
+    # promises (traffic in never-warmed text buckets legitimately compiles)
+    burst = list(v.phonemize_text(v._PREWARM_TEXTS[1]))[0]
+    results = [None] * 8
+
+    def run(i):
+        chunks = list(v.stream_synthesis(burst, 12, 2))
+        results[i] = np.concatenate([c.samples.data for c in chunks])
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(r is not None and len(r) > 0 for r in results)
+    # plus a batched wave over the same prewarm texts
+    phonemes = [p for t in v._PREWARM_TEXTS for p in v.phonemize_text(t)]
+    v.speak_batch(phonemes)
+    after = cache_keys()
+    grown = [{k: s for k, s in a.items() if w.get(k) != s}
+             for w, a in zip(warmed, after)]
+    assert after == warmed, f"cold compiles after prewarm: {grown}"
+
+
+def test_voice_close_stops_coalescer_threads():
+    """close() tears down all four sonata_stream_*/stage threads and is
+    idempotent; queued-but-undispatched work fails instead of hanging
+    (VERDICT r2 next#6)."""
+    import threading
+
+    v = tiny_voice(seed=22)
+    list(v.stream_synthesis("wˈʌn tuː.", 12, 2))  # spawn the threads
+    own = [v._stream_coalescer._worker, v._stream_coalescer._finisher,
+           v._stage_coalescer._worker, v._stage_coalescer._finisher]
+    assert all(t.is_alive() for t in own)
+    v.close()
+    v.close()  # idempotent
+    lingering = [t.name for t in own if t.is_alive()]
+    assert not lingering, f"lingering threads: {lingering}"
+    # non-streaming synthesis still works on a closed voice
+    assert len(v.speak_batch(["tɛst."])[0].samples) > 0
+
+
+def test_coalescer_close_fails_queued_futures():
+    """Work sitting in a coalescer queue when it closes gets an
+    OperationError instead of leaving callers blocked forever on
+    fut.result() (advisor r2 finding)."""
+    import queue as _queue
+    from concurrent.futures import Future
+
+    from sonata_tpu.core import OperationError
+    from sonata_tpu.models.piper import _drain_pending_futures
+
+    q: "_queue.Queue" = _queue.Queue()
+    f1, f2 = Future(), Future()
+    q.put(("win", 16, None, f1))
+    q.put(None)  # sentinel must be skipped
+    q.put(("win", 16, None, f2))
+    _drain_pending_futures(q, lambda it: it[3], "closed in test")
+    for f in (f1, f2):
+        assert isinstance(f.exception(timeout=0), OperationError)
+    # list-of-futures extraction (the stage-results layout)
+    q2: "_queue.Queue" = _queue.Queue()
+    f3, f4 = Future(), Future()
+    q2.put(([("ids", None, f3), ("ids", None, f4)], "z"))
+    _drain_pending_futures(q2, lambda it: [g[2] for g in it[0]],
+                           "closed in test")
+    assert isinstance(f3.exception(timeout=0), OperationError)
+    assert isinstance(f4.exception(timeout=0), OperationError)
+
+
+def test_stream_synthesis_bounded_lookahead():
+    """stream_synthesis keeps at most LOOKAHEAD window decodes in flight:
+    an abandoned stream (client cancel) wastes bounded device work instead
+    of decoding its whole tail (advisor r2 finding)."""
+    v = tiny_voice(seed=23)
+    # long utterance → many small windows
+    phonemes = "ðɪs ɪz ə lˈɔːŋ ˈʌtɚɹəns wɪθ mˈɛni wˈɪndoʊz " * 3
+    co = v._stream_decoder
+    submitted = []
+    real_submit = co.submit
+
+    def counting_submit(*a, **kw):
+        fut = real_submit(*a, **kw)
+        submitted.append(fut)
+        return fut
+
+    co.submit = counting_submit
+    try:
+        gen = v.stream_synthesis(phonemes, 8, 2)
+        first = next(gen)
+        assert len(first.samples) > 0
+        # first pull: initial look-ahead plus at most one top-up
+        assert len(submitted) <= 4
+        gen.close()  # abandon the stream
+        n_after_close = len(submitted)
+    finally:
+        co.submit = real_submit
+    assert n_after_close <= 4  # no tail decodes after abandonment

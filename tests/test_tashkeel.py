@@ -147,3 +147,31 @@ def test_default_engine_loads_bundled_model(monkeypatch):
         assert len(out) > len("السلام عليكم")
     finally:
         monkeypatch.setattr(tk, "_GLOBAL", None)
+
+
+def test_tashkeel_eval_corpus_aligns():
+    """The hand-curated gold corpus stays usable: stripping diacritics and
+    re-diacritizing must preserve every sentence's base-letter skeleton
+    (a typo in the gold file would silently break the eval), and a
+    gold-vs-gold score is exactly zero errors."""
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    sys.path.insert(0, str(repo / "tools"))
+    try:
+        from eval_tashkeel import score, split_letters  # noqa: F401
+    finally:
+        sys.path.pop(0)
+    from sonata_tpu.models.tashkeel import strip_diacritics
+    from sonata_tpu.text import tashkeel_rules
+
+    lines = [ln.strip() for ln in
+             (repo / "tools" / "tashkeel_gold.txt").read_text(
+                 encoding="utf-8").splitlines() if ln.strip()]
+    assert len(lines) >= 50
+    for gold in lines:
+        s = score(gold, gold)
+        assert s["errors"] == 0 and s["letters"] > 0
+        # rule-engine output must align with the gold skeleton
+        score(tashkeel_rules.diacritize(strip_diacritics(gold)), gold)

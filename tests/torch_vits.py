@@ -208,9 +208,18 @@ class Generator(nn.Module):
 
 class TinyPiperVits(nn.Module):
     """Name-faithful generator tree; forward touches every parameter so a
-    genuine export serializes all of them."""
+    genuine export serializes all of them.
 
-    def __init__(self, hp, n_vocab, n_speakers=1):
+    With ``trace_convs=True`` the forward *runs* every conv module on an
+    input-dependent activation instead of summing its weight-norm g/v
+    parameters directly.  Exported with ``do_constant_folding=True`` this
+    reproduces the optimizer-processed graphs real Piper distributions
+    ship: the traced ``_weight_norm(v, g)`` subgraph has constant inputs,
+    so the exporter folds it into one anonymous effective-weight constant
+    and the named ``weight_g``/``weight_v`` initializers disappear.
+    """
+
+    def __init__(self, hp, n_vocab, n_speakers=1, trace_convs=False):
         super().__init__()
         gin = hp.gin_channels if n_speakers > 1 else 0
         self.enc_p = TextEncoder(hp, n_vocab)
@@ -219,18 +228,45 @@ class TinyPiperVits(nn.Module):
         self.dec = Generator(hp, gin)
         if n_speakers > 1:
             self.emb_g = nn.Embedding(n_speakers, hp.gin_channels)
+        self.trace_convs = trace_convs
 
     def forward(self, ids):
         out = self.enc_p.emb(ids).sum()
-        for p in self.parameters():
-            out = out + p.sum()
+        if not self.trace_convs:
+            for p in self.parameters():
+                out = out + p.sum()
+            return out
+        # input-dependent scalar: keeps conv *activations* unfoldable while
+        # the purely-constant weight-norm subgraphs still fold
+        s = out * 0.0
+        for m in self.modules():
+            if isinstance(m, (nn.Conv1d, nn.ConvTranspose1d)):
+                x = s + torch.zeros(1, m.in_channels, 32)
+                out = out + m(x).sum()
+        for name, p in self.named_parameters():
+            if not name.endswith((".weight_g", ".weight_v")):
+                out = out + p.sum()
         return out
 
 
-def export_vits_onnx(model: nn.Module, path, fold=False):
+def export_vits_onnx(model: nn.Module, path, fold=False, remove_wn=False):
     """Genuine torch.onnx.export of the generator tree (see torch_cbhg's
-    note on the bypassed onnxscript post-pass)."""
+    note on the bypassed onnxscript post-pass).
+
+    ``remove_wn=True`` strips weight norm from every module first — the
+    step real Piper exports perform — so the file carries plain fused
+    ``.weight`` initializers instead of ``weight_g``/``weight_v`` pairs.
+    """
     from torch.onnx._internal.torchscript_exporter import onnx_proto_utils
+
+    if remove_wn:
+        from torch.nn.utils import remove_weight_norm
+
+        for m in model.modules():
+            try:
+                remove_weight_norm(m)
+            except ValueError:
+                pass
 
     orig = onnx_proto_utils._add_onnxscript_fn
     onnx_proto_utils._add_onnxscript_fn = lambda mb, _ops: mb
