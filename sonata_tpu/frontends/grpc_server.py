@@ -428,25 +428,30 @@ def main(argv=None) -> int:
     server.start()
     log.info("sonata-tpu gRPC server v%s listening on %s:%d",
              __version__, args.host, port)
-    if args.voice:
-        # preload through the public RPC path for identical semantics
-        channel = grpc.insecure_channel(f"{args.host}:{port}")
-        stub = channel.unary_unary(
-            f"/{_SERVICE_PATH}/LoadVoice",
-            request_serializer=lambda m: m.encode(),
-            response_deserializer=pb.VoiceInfo.decode)
-        for cfg in args.voice:
-            info = stub(pb.VoicePath(config_path=cfg))
-            log.info("preloaded voice %s", info.voice_id)
-        if args.prewarm:
-            threading.Thread(target=server.sonata_service.prewarm_all,
-                             name="sonata_prewarm", daemon=True).start()
-    elif args.prewarm:
-        log.warning("--prewarm does nothing without --voice")
     try:
+        if args.voice:
+            # preload through the public RPC path for identical semantics
+            channel = grpc.insecure_channel(f"{args.host}:{port}")
+            stub = channel.unary_unary(
+                f"/{_SERVICE_PATH}/LoadVoice",
+                request_serializer=lambda m: m.encode(),
+                response_deserializer=pb.VoiceInfo.decode)
+            for cfg in args.voice:
+                info = stub(pb.VoicePath(config_path=cfg))
+                log.info("preloaded voice %s", info.voice_id)
+            if args.prewarm:
+                threading.Thread(target=server.sonata_service.prewarm_all,
+                                 name="sonata_prewarm", daemon=True).start()
+        elif args.prewarm:
+            log.warning("--prewarm does nothing without --voice")
         server.wait_for_termination()
     except KeyboardInterrupt:
         server.stop(grace=2.0)
+    finally:
+        # runs on EVERY exit path after server.start() — Ctrl-C,
+        # server.stop() from another thread, a SIGTERM handler, or a
+        # preload failure above — so loaded voices' coalescer threads
+        # are always joined, not only on the interactive-interrupt path
         service = getattr(server, "sonata_service", None)
         if service is not None:  # absent on test stubs
             service.shutdown()

@@ -93,6 +93,7 @@ class PiperVoice(BaseModel):
         self._dec_cache: dict = {}
         self._stream_coalescer: "Optional[_StreamDecodeCoalescer]" = None
         self._stage_coalescer: "Optional[_StreamStageCoalescer]" = None
+        self._voice_closed = False
         # adaptive frame-budget estimator for the single-dispatch path:
         # running upper bound of frames per input id per unit length_scale.
         # Start optimistic — an underestimate costs one overflow retry on
@@ -885,6 +886,9 @@ class PiperVoice(BaseModel):
     @property
     def _stream_decoder(self) -> "_StreamDecodeCoalescer":
         with self._jit_lock:
+            if self._voice_closed:
+                raise OperationError(
+                    "voice is closed; streaming is unavailable")
             if self._stream_coalescer is None:
                 self._stream_coalescer = _StreamDecodeCoalescer(self)
             return self._stream_coalescer
@@ -892,6 +896,9 @@ class PiperVoice(BaseModel):
     @property
     def _stream_stages(self) -> "_StreamStageCoalescer":
         with self._jit_lock:
+            if self._voice_closed:
+                raise OperationError(
+                    "voice is closed; streaming is unavailable")
             if self._stage_coalescer is None:
                 self._stage_coalescer = _StreamStageCoalescer(self)
             return self._stage_coalescer
@@ -905,8 +912,11 @@ class PiperVoice(BaseModel):
         owns four lazily-spawned daemon threads, which without an explicit
         close linger up to one 5 s poll interval after the last reference
         drops.  Idempotent; a closed voice can still synthesize
-        non-streaming batches (the coalescers are streaming-only)."""
+        non-streaming batches (the coalescers are streaming-only), but
+        any further STREAMING raises OperationError — close() is terminal
+        for the coalescers, never respawning their threads."""
         with self._jit_lock:
+            self._voice_closed = True
             decoder, self._stream_coalescer = self._stream_coalescer, None
             stages, self._stage_coalescer = self._stage_coalescer, None
         if decoder is not None:
@@ -1212,7 +1222,20 @@ class _StreamDecodeCoalescer:
         window = jax.lax.dynamic_slice_in_dim(
             z_row, jnp.int32(start), width, axis=0)
         fut: "Future[np.ndarray]" = Future()
+        reason = "stream-decode coalescer closed (voice unloaded)"
+        if self._closed:
+            fut.set_exception(OperationError(reason))
+            return fut
         self._queue.put((window, width, sid, fut))
+        if self._closed:
+            # enqueue-vs-drain race: close() may have drained the queue
+            # between our check and our put — drain again so this future
+            # cannot be left unresolved (fut.result() would hang forever).
+            # Re-put the wake sentinel afterwards: the drain may have
+            # eaten close()'s None before the worker saw it, which would
+            # leave the worker blocked out its full 5 s poll.
+            _drain_pending_futures(self._queue, lambda it: it[3], reason)
+            self._queue.put(None)
         return fut
 
     def decode(self, z_row, start: int, width: int,
@@ -1394,7 +1417,15 @@ class _StreamStageCoalescer:
         from concurrent.futures import Future
 
         fut: Future = Future()
+        reason = "stream-stage coalescer closed (voice unloaded)"
+        if self._closed:
+            raise OperationError(reason)
         self._queue.put((ids, sc, fut))
+        if self._closed:
+            # enqueue-vs-drain race (see _StreamDecodeCoalescer.submit);
+            # re-put the sentinel in case the drain ate close()'s wake
+            _drain_pending_futures(self._queue, lambda it: it[2], reason)
+            self._queue.put(None)
         return fut.result()
 
     # -- dispatcher -----------------------------------------------------

@@ -6,9 +6,11 @@ seq-tagging model) is auto-enabled whenever the voice's eSpeak language is
 
 Here the same rule applies (see ``PiperVoice.phonemize_text``).  The
 engine resolves, in order: an explicit model artifact (CBHG ``.onnx`` or
-native ``.npz`` tagger), the bundled default tagger, and finally the
-heuristic rule engine (:mod:`.tashkeel_rules`) — so the Arabic chain
-always diacritizes and never hard-fails.
+native ``.npz`` tagger via ``SONATA_TASHKEEL_MODEL``; the literal value
+``bundled`` selects the bundled tagger), falling back to the heuristic
+rule engine (:mod:`.tashkeel_rules`) — which is also the DEFAULT, because
+the gold-corpus eval (``TASHKEEL_EVAL.json``) scores it well above the
+bundled tagger.  The Arabic chain always diacritizes, never hard-fails.
 """
 
 from __future__ import annotations
@@ -73,10 +75,13 @@ def get_default_engine() -> TashkeelEngine:
     tashkeel instance, ``crates/frontends/python/src/lib.rs:17-18``).
 
     ``SONATA_TASHKEEL_MODEL`` names the model artifact (`.onnx` CBHG export
-    or `.npz` native tagger).  Unset ⇒ the bundled default tagger
-    (``sonata_tpu/data/tashkeel_default.npz``, trained by
-    ``tools/train_tashkeel.py`` to reproduce the heuristic rule engine);
-    if that is also absent the engine applies the rules directly.
+    or `.npz` native tagger), or the literal ``bundled`` for the bundled
+    tagger (``sonata_tpu/data/tashkeel_default.npz``).  Unset ⇒ the
+    heuristic rule engine: the gold-corpus eval (``TASHKEEL_EVAL.json``,
+    ``tools/eval_tashkeel.py``) measures the rules at DER 0.179 /
+    case-ending accuracy 0.905 vs the bundled tagger's 0.257 / 0.67, so
+    the better-scoring system is the default and the eval is the gate for
+    ever flipping it back.
     """
     global _GLOBAL
     if _GLOBAL is None:
@@ -86,12 +91,22 @@ def get_default_engine() -> TashkeelEngine:
                 from pathlib import Path
 
                 path = os.environ.get("SONATA_TASHKEEL_MODEL") or None
-                bundled = path is None
+                bundled = path == "bundled"
                 if bundled:
                     cand = (Path(__file__).resolve().parent.parent
                             / "data" / "tashkeel_default.npz")
                     if cand.exists():
                         path = str(cand)
+                    else:
+                        # the operator asked for the bundled tagger by
+                        # name; a missing file must not pass silently
+                        import logging
+
+                        logging.getLogger("sonata.tashkeel").warning(
+                            "SONATA_TASHKEEL_MODEL=bundled but %s is "
+                            "missing; falling back to the rule engine",
+                            cand)
+                        path = None
                 try:
                     _GLOBAL = TashkeelEngine(path)
                 except Exception:

@@ -124,6 +124,25 @@ def test_engine_without_model_applies_rules(monkeypatch):
     assert strip_diacritics(out) == "كتاب" and len(out) > 4
 
 
+def test_default_engine_is_rule_engine(monkeypatch):
+    """Unset env ⇒ the rule engine (the gold-corpus eval in
+    TASHKEEL_EVAL.json gates the default; rules score better)."""
+    import sonata_tpu.text.tashkeel as tk
+
+    monkeypatch.delenv("SONATA_TASHKEEL_MODEL", raising=False)
+    monkeypatch.setattr(tk, "_GLOBAL", None)
+    try:
+        eng = tk.get_default_engine()
+        assert not eng.has_model
+        from sonata_tpu.models.tashkeel import strip_diacritics
+
+        out = eng.diacritize("السلام عليكم")
+        assert strip_diacritics(out) == "السلام عليكم"
+        assert len(out) > len("السلام عليكم")
+    finally:
+        monkeypatch.setattr(tk, "_GLOBAL", None)
+
+
 def test_default_engine_loads_bundled_model(monkeypatch):
     import pathlib
 
@@ -135,7 +154,7 @@ def test_default_engine_loads_bundled_model(monkeypatch):
         import pytest
 
         pytest.skip("bundled tashkeel model not built")
-    monkeypatch.delenv("SONATA_TASHKEEL_MODEL", raising=False)
+    monkeypatch.setenv("SONATA_TASHKEEL_MODEL", "bundled")
     monkeypatch.setattr(tk, "_GLOBAL", None)
     try:
         eng = tk.get_default_engine()

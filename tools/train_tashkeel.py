@@ -1,11 +1,14 @@
-"""Train the bundled default tashkeel tagger on the rule engine's output.
+"""Train the bundled tashkeel tagger on the rule engine's output.
 
 No real diacritization corpus can be fetched in this environment (zero
 egress), so the bundled model learns to reproduce
 :mod:`sonata_tpu.text.tashkeel_rules` exactly — a deterministic,
-linguistically-simplified supervision that makes the out-of-the-box
-Arabic chain functional and exercises the full train→save→load→serve
-loop.  Production deployments should point ``SONATA_TASHKEEL_MODEL`` at a
+linguistically-simplified supervision that exercises the full
+train→save→load→serve loop.  The artifact is OPT-IN
+(``SONATA_TASHKEEL_MODEL=bundled``), not the default: the rule engine
+itself outscores it on the gold corpus (``TASHKEEL_EVAL.json``), so
+retraining this tagger does NOT change out-of-the-box Arabic output.
+Production deployments should point ``SONATA_TASHKEEL_MODEL`` at a
 real libtashkeel artifact.
 
 Run:  python tools/train_tashkeel.py  (writes
