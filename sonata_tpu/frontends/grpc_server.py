@@ -446,12 +446,14 @@ def main(argv=None) -> int:
             log.warning("--prewarm does nothing without --voice")
         server.wait_for_termination()
     except KeyboardInterrupt:
-        server.stop(grace=2.0)
+        pass
     finally:
         # runs on EVERY exit path after server.start() — Ctrl-C,
         # server.stop() from another thread, a SIGTERM handler, or a
-        # preload failure above — so loaded voices' coalescer threads
-        # are always joined, not only on the interactive-interrupt path
+        # preload failure above — so the port stops accepting work and
+        # loaded voices' coalescer threads are always joined, not only
+        # on the interactive-interrupt path
+        server.stop(grace=2.0)
         service = getattr(server, "sonata_service", None)
         if service is not None:  # absent on test stubs
             service.shutdown()

@@ -230,28 +230,16 @@ def arabic_word_to_ipa(word: str) -> str:
     return "".join(_ARABIC.get(ch, "") for ch in word)
 
 
-def _word_to_ipa_de(word: str) -> str:
-    from . import rule_g2p_de
+def _lazy(module: str, fn: str):
+    """Deferred accessor into a language-pack module, so importing the
+    registry never pays for packs the process doesn't use."""
+    def call(arg: str) -> str:
+        import importlib
 
-    return rule_g2p_de.word_to_ipa(word)
+        mod = importlib.import_module(f".{module}", __package__)
+        return getattr(mod, fn)(arg)
 
-
-def _word_to_ipa_es(word: str) -> str:
-    from . import rule_g2p_es
-
-    return rule_g2p_es.word_to_ipa(word)
-
-
-def _normalize_de(text: str) -> str:
-    from . import rule_g2p_de
-
-    return rule_g2p_de.normalize_text(text)
-
-
-def _normalize_es(text: str) -> str:
-    from . import rule_g2p_es
-
-    return rule_g2p_es.normalize_text(text)
+    return call
 
 
 # Language registry: language code → (normalizer, word→IPA).  The eSpeak
@@ -265,8 +253,14 @@ _LANGUAGES: dict[str, tuple] = {
     "ar": (normalize_text, arabic_word_to_ipa),
     "fa": (normalize_text, arabic_word_to_ipa),  # Arabic-script letter map
     "ur": (normalize_text, arabic_word_to_ipa),
-    "de": (_normalize_de, _word_to_ipa_de),
-    "es": (_normalize_es, _word_to_ipa_es),
+    "de": (_lazy("rule_g2p_de", "normalize_text"),
+           _lazy("rule_g2p_de", "word_to_ipa")),
+    "es": (_lazy("rule_g2p_es", "normalize_text"),
+           _lazy("rule_g2p_es", "word_to_ipa")),
+    "it": (_lazy("rule_g2p_it", "normalize_text"),
+           _lazy("rule_g2p_it", "word_to_ipa")),
+    "fr": (_lazy("rule_g2p_fr", "normalize_text"),
+           _lazy("rule_g2p_fr", "word_to_ipa")),
 }
 
 #: Env var: set to "1" to let unsupported languages fall back to English

@@ -406,14 +406,103 @@ def test_spanish_stress_rules():
     assert word_to_ipa("lunes") == "ˈlunes"
 
 
+GOLDEN_CORPUS_IT = [
+    ("Ciao mondo, come stai oggi?",
+     "ˈtʃao ˈmondo ˈkome stai ˈoɡːi"),
+    ("La famiglia mangia gli spaghetti in città",
+     "la faˈmiʎa ˈmandʒa ʎi spaˈɡetːi in tʃitːˈa"),
+    ("Buongiorno, il caffè è molto buono",
+     "buonˈdʒorno il kafːˈɛ ˈɛ ˈmolto ˈbuono"),
+    ("ventitré ragazzi parlano italiano",
+     "ventiˈtre raˈɡatsːi parˈlano itaˈliano"),
+    ("Grazie mille per la bella giornata",
+     "ˈɡratsie ˈmilːe per la ˈbelːa dʒorˈnata"),
+]
+
+GOLDEN_CORPUS_FR = [
+    ("Bonjour le monde, comment allez-vous?",
+     "bɔ̃ˈʒuʁ lə mɔ̃d kɔˈmɑ̃ aˈle vu"),
+    ("La maison blanche est très belle",
+     "la mɛˈzɔ̃ blɑ̃ʃ ɛ tʁɛ bɛl"),
+    ("Je parle un petit peu français",
+     "ʒə paʁl œ̃ pəˈti pø fʁɑ̃ˈsɛ"),
+    ("vingt-trois enfants jouent dans le jardin",
+     "vɛ̃ tʁwa ɑ̃ˈfɑ̃ ʒu dɑ̃ lə ʒaʁˈdɛ̃"),
+    ("Merci beaucoup, bonne nuit mon ami",
+     "mɛʁˈsi boˈku bɔn nɥi mɔ̃ aˈmi"),
+]
+
+
+def test_golden_ipa_corpus_italian():
+    """Italian rule pack: soft c/g with mute i (ciao → tʃao), gli → ʎ,
+    geminates as length, written-accent and sdrucciole stress."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    for text, golden in GOLDEN_CORPUS_IT:
+        assert phonemize_clause(text, voice="it") == golden, text
+
+
+def test_golden_ipa_corpus_french():
+    """French rule pack: nasal vowels with denasalisation (bon/bonne),
+    silent endings (-er/-ez → e, 3pl -ent silent), elision clitics,
+    function-word lexicon, final-syllable stress."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    for text, golden in GOLDEN_CORPUS_FR:
+        assert phonemize_clause(text, voice="fr") == golden, text
+
+
+def test_italian_phenomena():
+    from sonata_tpu.text.rule_g2p_it import word_to_ipa
+
+    assert word_to_ipa("pizza") == "ˈpitsːa"       # geminate affricate
+    assert word_to_ipa("zero") == "ˈdzero"          # initial z voices
+    assert word_to_ipa("casa") == "ˈkaza"           # intervocalic s
+    assert word_to_ipa("stella") == "ˈstelːa"       # initial cluster whole
+    assert word_to_ipa("città") == "tʃitːˈa"        # accent-final stress
+    assert word_to_ipa("musica") == "ˈmuzika"       # sdrucciola exception
+    assert word_to_ipa("gnocchi") == "ˈɲokːi"       # gn + ch digraphs
+    assert word_to_ipa("famiglia") == "faˈmiʎa"     # gli + vowel mute i
+
+
+def test_french_phenomena():
+    from sonata_tpu.text.rule_g2p_fr import word_to_ipa
+
+    assert word_to_ipa("bon") == "bɔ̃"               # nasal
+    assert word_to_ipa("bonne") == "bɔn"            # denasalised before nn
+    assert word_to_ipa("parler") == word_to_ipa("parlez") == "paʁˈle"
+    assert word_to_ipa("parlent") == "paʁl"         # 3pl silent
+    assert word_to_ipa("vraiment") == "vʁɛˈmɑ̃"      # -ment keeps nasal
+    assert word_to_ipa("l'homme") == "lɔm"          # elision + silent h
+    assert word_to_ipa("fille") == "fij"            # -ill- glide
+    assert word_to_ipa("ville") == "vil"            # lexicon exception
+    assert word_to_ipa("nuit") == "nɥi"             # ui diphthong
+    assert word_to_ipa("temps") == "tɑ̃"             # silent final cluster
+
+
+def test_it_fr_number_expansion():
+    from sonata_tpu.text.rule_g2p_fr import number_to_words as fr_num
+    from sonata_tpu.text.rule_g2p_it import number_to_words as it_num
+
+    assert it_num(21) == "ventuno"
+    assert it_num(28) == "ventotto"
+    assert it_num(23) == "ventitré"
+    assert it_num(1863) == "milleottocentosessantatré"
+    assert fr_num(71) == "soixante et onze"
+    assert fr_num(80) == "quatre-vingts"
+    assert fr_num(95) == "quatre-vingt-quinze"
+    assert fr_num(200) == "deux cents"
+    assert fr_num(1789) == "mille sept cent quatre-vingt-neuf"
+
+
 def test_unsupported_language_raises():
     import pytest
 
     from sonata_tpu.core import PhonemizationError
     from sonata_tpu.text.rule_g2p import phonemize_clause
 
-    with pytest.raises(PhonemizationError, match="no rules for language 'fr'"):
-        phonemize_clause("bonjour le monde", voice="fr")
+    with pytest.raises(PhonemizationError, match="no rules for language 'pl'"):
+        phonemize_clause("dzień dobry", voice="pl")
 
 
 def test_unsupported_language_best_effort_env(monkeypatch):
@@ -421,7 +510,7 @@ def test_unsupported_language_best_effort_env(monkeypatch):
 
     monkeypatch.setenv(BEST_EFFORT_ENV, "1")
     # explicit opt-in: falls back to English letter-to-sound, no raise
-    assert phonemize_clause("bonjour", voice="fr")
+    assert phonemize_clause("dobry", voice="pl")
 
 
 def test_language_number_expansion():
