@@ -261,6 +261,10 @@ _LANGUAGES: dict[str, tuple] = {
            _lazy("rule_g2p_it", "word_to_ipa")),
     "fr": (_lazy("rule_g2p_fr", "normalize_text"),
            _lazy("rule_g2p_fr", "word_to_ipa")),
+    "pt": (_lazy("rule_g2p_pt", "normalize_text"),
+           _lazy("rule_g2p_pt", "word_to_ipa")),
+    "pl": (_lazy("rule_g2p_pl", "normalize_text"),
+           _lazy("rule_g2p_pl", "word_to_ipa")),
 }
 
 #: Env var: set to "1" to let unsupported languages fall back to English

@@ -495,14 +495,96 @@ def test_it_fr_number_expansion():
     assert fr_num(1789) == "mille sept cent quatre-vingt-neuf"
 
 
+GOLDEN_CORPUS_PT = [
+    ("Olá mundo, como você está?",
+     "oˈla ˈmũdu ˈkomu voˈse esˈta"),
+    ("O coração não sabe mentir",
+     "u koɾaˈsɐ̃w ˈnɐ̃w ˈsabi mẽˈtʃiɾ"),
+    ("Bom dia, muito obrigado",
+     "bõ ˈdʒiɐ ˈmujtu obɾiˈɡadu"),
+    ("vinte e três pessoas na cidade",
+     "ˈvĩtʃi i ˈtɾes peˈsoɐs nɐ siˈdadʒi"),
+    ("A gente fala português do Brasil",
+     "ɐ ˈʒẽtʃi ˈfalɐ poɾtuˈɡes du bɾaˈzil"),
+]
+
+GOLDEN_CORPUS_PL = [
+    ("Dzień dobry, jak się masz?",
+     "dʑɛɲ ˈdɔbrɨ jak ɕɛ maʃ"),
+    ("Dziękuję bardzo, wszystko dobrze",
+     "dʑɛ̃ˈkujɛ ˈbardzɔ ˈvʃɨstkɔ ˈdɔbʒɛ"),
+    ("Kocham cię całym sercem",
+     "ˈkɔxam tɕɛ ˈtsawɨm ˈsɛrtsɛm"),
+    ("dwadzieścia trzy książki na stole",
+     "dvaˈdʑɛɕtɕa tʃɨ ˈkɕɔ̃ʒki na ˈstɔlɛ"),
+    ("Przepraszam, nie rozumiem",
+     "pʃɛˈpraʃam ɲɛ rɔˈzumjɛm"),
+]
+
+
+def test_golden_ipa_corpus_portuguese():
+    """Brazilian Portuguese rule pack: nasal diphthongs (ão → ɐ̃w),
+    ti/di palatalization, final-vowel raising, ʁ/ɾ contrast,
+    ending-driven and written-accent/til stress."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    for text, golden in GOLDEN_CORPUS_PT:
+        assert phonemize_clause(text, voice="pt-br") == golden, text
+
+
+def test_golden_ipa_corpus_polish():
+    """Polish rule pack: digraph set (sz/cz/rz/dz), kreska softs and
+    i-palatalization spellings, nasal ą/ę with final-ę denasalisation,
+    rz-devoicing after voiceless stops, fixed penultimate stress."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    for text, golden in GOLDEN_CORPUS_PL:
+        assert phonemize_clause(text, voice="pl") == golden, text
+
+
+def test_portuguese_phenomena():
+    from sonata_tpu.text.rule_g2p_pt import word_to_ipa
+
+    assert word_to_ipa("coração") == "koɾaˈsɐ̃w"   # til attracts stress
+    assert word_to_ipa("também") == "tɐ̃ˈbẽj"      # final -ém → ẽj
+    assert word_to_ipa("banho") == "ˈbaɲu"        # nh digraph, no nasal
+    assert word_to_ipa("carro") != word_to_ipa("caro")  # ʁ vs ɾ
+    assert word_to_ipa("livros") == "ˈlivɾus"     # plural-final raising
+    assert word_to_ipa("cidade") == "siˈdadʒi"    # di palatalization
+
+
+def test_polish_phenomena():
+    from sonata_tpu.text.rule_g2p_pl import word_to_ipa
+
+    assert word_to_ipa("przy") == "pʃɨ"           # rz devoices after p
+    assert word_to_ipa("dobrze") == "ˈdɔbʒɛ"      # rz voiced elsewhere
+    assert word_to_ipa("chleb") == "xlɛp"         # final devoicing
+    assert word_to_ipa("łódź") == "wutɕ"          # ł→w, ó→u, final dź→tɕ
+    assert word_to_ipa("miasto") == "ˈmjastɔ"     # i+V glide
+    assert word_to_ipa("proszę") == "ˈprɔʃɛ"      # final ę denasalises
+
+
+def test_pt_pl_number_expansion():
+    from sonata_tpu.text.rule_g2p_pl import number_to_words as pl_num
+    from sonata_tpu.text.rule_g2p_pt import number_to_words as pt_num
+
+    assert pt_num(23) == "vinte e três"
+    assert pt_num(100) == "cem"
+    assert pt_num(345) == "trezentos e quarenta e cinco"
+    assert pl_num(15) == "piętnaście"
+    assert pl_num(2000) == "dwa tysiące"
+    assert pl_num(5000) == "pięć tysięcy"
+    assert pl_num(234) == "dwieście trzydzieści cztery"
+
+
 def test_unsupported_language_raises():
     import pytest
 
     from sonata_tpu.core import PhonemizationError
     from sonata_tpu.text.rule_g2p import phonemize_clause
 
-    with pytest.raises(PhonemizationError, match="no rules for language 'pl'"):
-        phonemize_clause("dzień dobry", voice="pl")
+    with pytest.raises(PhonemizationError, match="no rules for language 'cs'"):
+        phonemize_clause("dobrý den", voice="cs")
 
 
 def test_unsupported_language_best_effort_env(monkeypatch):
@@ -510,7 +592,7 @@ def test_unsupported_language_best_effort_env(monkeypatch):
 
     monkeypatch.setenv(BEST_EFFORT_ENV, "1")
     # explicit opt-in: falls back to English letter-to-sound, no raise
-    assert phonemize_clause("dobry", voice="pl")
+    assert phonemize_clause("dobrý", voice="cs")
 
 
 def test_language_number_expansion():
