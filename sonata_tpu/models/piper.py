@@ -331,19 +331,24 @@ class PiperVoice(BaseModel):
         co = self._stream_decoder
         c = self.hp.inter_channels
         thunks = []
-        for (_, width, _b, has_sid) in seen:
-            # the decode coalescer dispatches exactly max_batch rows
-            b = co._max_batch
+        # every width must be warm at BOTH canonical batch sizes: the
+        # sequential drain itself coalesces its look-ahead windows, so a
+        # width can enter the cache at b=max only — and the first lone
+        # straggler at that width would then pay a b=1 cold compile
+        # mid-request (the exact stall prewarm exists to prevent)
+        widths = {(width, has_sid) for (_, width, _b, has_sid) in seen}
+        for (width, has_sid) in widths:
+            for b in {1, co._max_batch}:
 
-            def warm_dec(width=width, b=b, has_sid=has_sid):
-                fn = self._decode_windows_batch_fn(width, b, has_sid)
-                args = [self.params, jnp.zeros((b, width, c),
-                                               jnp.float32)]
-                if has_sid:
-                    args.append(jnp.zeros((b,), jnp.int32))
-                jax.block_until_ready(fn(*args))
+                def warm_dec(width=width, b=b, has_sid=has_sid):
+                    fn = self._decode_windows_batch_fn(width, b, has_sid)
+                    args = [self.params, jnp.zeros((b, width, c),
+                                                   jnp.float32)]
+                    if has_sid:
+                        args.append(jnp.zeros((b,), jnp.int32))
+                    jax.block_until_ready(fn(*args))
 
-            thunks.append(warm_dec)
+                thunks.append(warm_dec)
         # the stage coalescer batches stream STARTS too: warm the b=max
         # encode/acoustics shapes it dispatches under concurrency.  Its
         # dispatch routes through _pad_batch, which can round the batch up

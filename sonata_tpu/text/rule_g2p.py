@@ -230,6 +230,42 @@ def arabic_word_to_ipa(word: str) -> str:
     return "".join(_ARABIC.get(ch, "") for ch in word)
 
 
+def place_stress(units: list, flags: list, target: int, *,
+                 liquids: tuple = ("r", "l"),
+                 stops: tuple = tuple("pbtdkɡfv"),
+                 s_cluster: bool = False,
+                 stop_at_length: bool = False) -> str:
+    """Insert the primary-stress mark before the syllable onset of the
+    nucleus at unit index ``target``.
+
+    Shared by the unit-scanner language packs (it/fr/pt/pl/tr/ro/nl):
+    ``units`` are emitted phoneme strings, ``flags`` mark vowel units, so
+    the mark can never split a multi-char phoneme.  The onset walk takes
+    every consonant unit back to the previous nucleus, then splits
+    over-long runs: an obstruent+liquid cluster (``liquids``/``stops``)
+    may start the stressed syllable, ``s_cluster`` additionally allows
+    s+stop onsets (and keeps bare word-internal s+C pairs whole), and
+    ``stop_at_length`` treats a length-marked unit (Cː geminate) as the
+    previous syllable's coda.  Word-initial clusters always stay whole.
+    """
+    onset = target
+    while onset > 0 and not flags[onset - 1]:
+        if stop_at_length and units[onset - 1].endswith("ː"):
+            break
+        onset -= 1
+    if target - onset > 1 and onset > 0:
+        run = units[onset:target]
+        if run[-1] in liquids and run[-2] in stops:
+            onset = target - 2
+        elif s_cluster and run[-1] in ("p", "t", "k") and run[-2] == "s":
+            onset = target - 2
+        elif s_cluster and run[-2] in ("s", "z") and len(run) == 2:
+            pass
+        else:
+            onset = target - 1
+    return "".join(units[:onset]) + "ˈ" + "".join(units[onset:])
+
+
 def _lazy(module: str, fn: str):
     """Deferred accessor into a language-pack module, so importing the
     registry never pays for packs the process doesn't use."""
@@ -265,6 +301,12 @@ _LANGUAGES: dict[str, tuple] = {
            _lazy("rule_g2p_pt", "word_to_ipa")),
     "pl": (_lazy("rule_g2p_pl", "normalize_text"),
            _lazy("rule_g2p_pl", "word_to_ipa")),
+    "tr": (_lazy("rule_g2p_tr", "normalize_text"),
+           _lazy("rule_g2p_tr", "word_to_ipa")),
+    "ro": (_lazy("rule_g2p_ro", "normalize_text"),
+           _lazy("rule_g2p_ro", "word_to_ipa")),
+    "nl": (_lazy("rule_g2p_nl", "normalize_text"),
+           _lazy("rule_g2p_nl", "word_to_ipa")),
 }
 
 #: Env var: set to "1" to let unsupported languages fall back to English

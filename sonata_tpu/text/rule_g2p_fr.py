@@ -331,16 +331,9 @@ def word_to_ipa(word: str) -> str:
     target = nuclei[-1]
     if units[target] == "ə" and len(nuclei) >= 2:
         target = nuclei[-2]
-    onset = target
-    while onset > 0 and not flags[onset - 1]:
-        onset -= 1
-    if target - onset > 1:
-        run = units[onset:target]
-        if run[-1] in ("ʁ", "l") and run[-2] in tuple("pbtdkɡfv"):
-            onset = target - 2
-        else:
-            onset = target - 1
-    return "".join(units[:onset]) + "ˈ" + "".join(units[onset:])
+    from .rule_g2p import place_stress
+
+    return place_stress(units, flags, target, liquids=("ʁ", "l"))
 
 
 _ONES = ["zéro", "un", "deux", "trois", "quatre", "cinq", "six", "sept",

@@ -200,25 +200,12 @@ def word_to_ipa(word: str) -> str:
         target = len(positions) - 2  # penultimate default
     if target < 0:
         target = 0
-    # walk the onset back over whole units: affricates/geminates are
-    # single units, so the mark can never split one
-    onset = positions[target]
-    while onset > 0 and not vowel_flags[onset - 1] \
-            and not units[onset - 1].endswith("ː"):
-        # a geminate (Cː) closes the PREVIOUS syllable (al.lo): stop
-        onset -= 1
-    if positions[target] - onset > 1 and onset > 0:
-        # word-initial clusters (onset == 0) stay whole: ˈstelːa; a
-        # word-internal run splits so only a legal obstruent+liquid
-        # cluster (or s+C) starts the stressed syllable
-        run = units[onset:positions[target]]
-        if run[-1] in ("r", "l") and run[-2] in tuple("pbtdkɡfv"):
-            onset = positions[target] - 2
-        elif run[-2] in ("s", "z") and len(run) == 2:
-            pass  # s-impura clusters (s+C) start the syllable whole
-        else:
-            onset = positions[target] - 1
-    return "".join(units[:onset]) + "ˈ" + "".join(units[onset:])
+    from .rule_g2p import place_stress
+
+    # stop_at_length: a geminate (Cː) closes the PREVIOUS syllable;
+    # s_cluster: s-impura clusters start the stressed syllable whole
+    return place_stress(units, vowel_flags, positions[target],
+                        stop_at_length=True, s_cluster=True)
 
 
 _ONES = ["zero", "uno", "due", "tre", "quattro", "cinque", "sei", "sette",

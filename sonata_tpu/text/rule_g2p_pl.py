@@ -148,17 +148,10 @@ def word_to_ipa(word: str) -> str:
     if len(nuclei) < 2:
         return ipa
     target = nuclei[-2]  # fixed penultimate stress
-    onset = target
-    while onset > 0 and not flags[onset - 1]:
-        onset -= 1
-    if target - onset > 1 and onset > 0:
-        run = units[onset:target]
-        if run[-1] in ("r", "l", "w", "j") and \
-                run[-2] in tuple("pbtdkɡfv"):
-            onset = target - 2
-        else:
-            onset = target - 1
-    return "".join(units[:onset]) + "ˈ" + "".join(units[onset:])
+    from .rule_g2p import place_stress
+
+    return place_stress(units, flags, target,
+                        liquids=("r", "l", "w", "j"))
 
 
 _ONES = ["zero", "jeden", "dwa", "trzy", "cztery", "pięć", "sześć",

@@ -199,17 +199,10 @@ def word_to_ipa(word: str) -> str:
         target = len(positions) - 1  # -r/-l/-z/-i/-u/nasal-final → final
     if target < 0:
         target = 0
-    tu = positions[target]
-    onset = tu
-    while onset > 0 and not flags[onset - 1]:
-        onset -= 1
-    if tu - onset > 1 and onset > 0:
-        run = units[onset:tu]
-        if run[-1] in ("ɾ", "l") and run[-2] in tuple("pbtdkɡfv"):
-            onset = tu - 2
-        else:
-            onset = tu - 1
-    return "".join(units[:onset]) + "ˈ" + "".join(units[onset:])
+    from .rule_g2p import place_stress
+
+    return place_stress(units, flags, positions[target],
+                        liquids=("ɾ", "l"))
 
 
 _ONES = ["zero", "um", "dois", "três", "quatro", "cinco", "seis", "sete",

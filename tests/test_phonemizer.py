@@ -577,6 +577,135 @@ def test_pt_pl_number_expansion():
     assert pl_num(234) == "dwieście trzydzieści cztery"
 
 
+GOLDEN_CORPUS_TR = [
+    ("Merhaba dünya, nasılsın bugün?",
+     "ˈmeɾhaba dynˈja nasɯlˈsɯn buˈɡyn"),
+    ("İstanbul çok güzel bir şehir",
+     "istanˈbul tʃok ɡyˈzel biɾ ʃeˈhiɾ"),
+    ("yirmi üç kitap okudum",
+     "jiɾˈmi ytʃ kiˈtap okuˈdum"),
+    ("Günaydın, iyi günler dilerim",
+     "ɡynajˈdɯn iˈji ɡynˈleɾ dileˈɾim"),
+]
+
+GOLDEN_CORPUS_RO = [
+    ("Bună ziua, ce mai faci?", "ˈbunə ˈziwa tʃe maj fatʃʲ"),
+    ("România este o țară frumoasă",
+     "romɨˈnia ˈeste o ˈtsarə fruˈmwasə"),
+    ("douăzeci și trei de copii",
+     "dowəˈzetʃʲ ʃi trej de koˈpij"),
+    ("Mulțumesc foarte mult, noapte bună",
+     "multsuˈmesk ˈfwarte mult ˈnwapte ˈbunə"),
+]
+
+GOLDEN_CORPUS_NL = [
+    ("Hallo wereld, hoe gaat het vandaag?",
+     "ˈɦɑloː ˈʋeːrɛlt ɦu xaːt ət ˈvɑndaːx"),
+    ("Het weer is vandaag erg mooi",
+     "ət ʋeːr ɪs ˈvɑndaːx ɛrx moːj"),
+    ("drieëntwintig boeken op de tafel",
+     "ˈdriəntʋɪntəx ˈbukən ɔp də ˈtaːfəl"),
+    ("Goedemorgen, tot ziens", "xudəˈmɔrxən tɔt zins"),
+]
+
+
+def test_golden_ipa_corpus_turkish():
+    """Turkish rule pack: dotless ı, rounded front ö/ü, soft-g length,
+    Turkish-specific İ/I lowercasing, final-syllable stress with the
+    adverb exception set."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    for text, golden in GOLDEN_CORPUS_TR:
+        assert phonemize_clause(text, voice="tr") == golden, text
+
+
+def test_golden_ipa_corpus_romanian():
+    """Romanian rule pack: central ə/ɨ, soft c/g with che/chi hards,
+    semivocalic diphthongs, final asyllabic -i, -zeci stem stress."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    for text, golden in GOLDEN_CORPUS_RO:
+        assert phonemize_clause(text, voice="ro") == golden, text
+
+
+def test_golden_ipa_corpus_dutch():
+    """Dutch rule pack: ij/ei/ui/ou diphthongs, open-syllable
+    lengthening, sch → sx, final -ig → əx, prefix-e reduction,
+    initial-stress default."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    for text, golden in GOLDEN_CORPUS_NL:
+        assert phonemize_clause(text, voice="nl") == golden, text
+
+
+def test_turkish_phenomena():
+    from sonata_tpu.text.rule_g2p_tr import normalize_text, word_to_ipa
+
+    assert word_to_ipa("dağ") == "daː"            # soft g lengthens
+    assert word_to_ipa("çocuk") == "tʃoˈdʒuk"     # ç and c
+    assert "ɯ" in word_to_ipa("kapı")             # dotless ı
+    # Turkish casing: I lowers to dotless ı, İ to dotted i
+    assert normalize_text("IĞDIR Iğdır") == "ığdır ığdır"
+    assert normalize_text("Iraklı İzmirli") == "ıraklı izmirli"
+
+
+def test_romanian_phenomena():
+    from sonata_tpu.text.rule_g2p_ro import word_to_ipa
+
+    assert word_to_ipa("george") == "ˈdʒordʒe"    # mute e in geo
+    assert word_to_ipa("chema") == "ˈkema"        # che hard
+    assert word_to_ipa("țară") == "ˈtsarə"        # ț and ă
+    assert word_to_ipa("mâna") == "ˈmɨna"         # â → ɨ
+    assert word_to_ipa("ani") == "anʲ"            # asyllabic final i
+    assert word_to_ipa("oameni") == "ˈwamenʲ"     # oa → wa, stem stress
+
+
+def test_dutch_phenomena():
+    from sonata_tpu.text.rule_g2p_nl import word_to_ipa
+
+    assert word_to_ipa("water") == "ˈʋaːtər"      # open-syllable length
+    assert word_to_ipa("school") == "sxoːl"       # sch → sx
+    assert word_to_ipa("huis") == "ɦœys"          # ui → œy
+    assert word_to_ipa("tijd") == "tɛit"          # ij → ɛi, final devoice
+    assert word_to_ipa("gezellig") == "xəˈzɛləx"  # prefix ə, -ig → əx
+    assert word_to_ipa("verstaan") == "vərˈstaːn"  # s+stop onset
+    # be-/ge- words whose remainder is all schwa are NOT prefixed
+    assert word_to_ipa("beter") == "ˈbeːtər"
+    assert word_to_ipa("geven") == "ˈxeːvən"
+
+
+def test_dutch_numeral_one_vs_article():
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    # digit 1 expands to the accented numeral één (/eːn/), not the
+    # indefinite-article spelling een (/ən/)
+    assert phonemize_clause("1 boek", voice="nl") == "eːn buk"
+    assert phonemize_clause("een boek", voice="nl") == "ən buk"
+
+
+def test_romanian_legacy_cedilla():
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    # pre-Unicode-5.2 cedilla forms (both cases) map to comma-below
+    assert phonemize_clause("Ţară", voice="ro") == "ˈtsarə"
+    assert phonemize_clause("Şi", voice="ro") == "ʃi"
+
+
+def test_tr_ro_nl_number_expansion():
+    from sonata_tpu.text.rule_g2p_nl import number_to_words as nl_num
+    from sonata_tpu.text.rule_g2p_ro import number_to_words as ro_num
+    from sonata_tpu.text.rule_g2p_tr import number_to_words as tr_num
+
+    assert tr_num(23) == "yirmi üç"
+    assert tr_num(1923) == "bin dokuz yüz yirmi üç"
+    assert ro_num(22) == "douăzeci și doi"
+    assert ro_num(200) == "două sute"
+    assert ro_num(2000) == "două mii"
+    assert nl_num(23) == "drieëntwintig"
+    assert nl_num(58) == "achtenvijftig"
+    assert nl_num(345) == "driehonderdvijfenveertig"
+
+
 def test_unsupported_language_raises():
     import pytest
 
