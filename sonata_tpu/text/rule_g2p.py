@@ -307,6 +307,12 @@ _LANGUAGES: dict[str, tuple] = {
            _lazy("rule_g2p_ro", "word_to_ipa")),
     "nl": (_lazy("rule_g2p_nl", "normalize_text"),
            _lazy("rule_g2p_nl", "word_to_ipa")),
+    "cs": (_lazy("rule_g2p_cs", "normalize_text"),
+           _lazy("rule_g2p_cs", "word_to_ipa")),
+    "hu": (_lazy("rule_g2p_hu", "normalize_text"),
+           _lazy("rule_g2p_hu", "word_to_ipa")),
+    "ru": (_lazy("rule_g2p_ru", "normalize_text"),
+           _lazy("rule_g2p_ru", "word_to_ipa")),
 }
 
 #: Env var: set to "1" to let unsupported languages fall back to English

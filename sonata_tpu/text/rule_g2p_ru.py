@@ -1,0 +1,205 @@
+"""Russian letter-to-sound rules for the hermetic G2P backend.
+
+Russian Cyrillic maps near-phonemically to consonants, but vowel
+quality depends on lexical stress (akanie: unstressed о → a), which no
+rule system can fully recover — eSpeak itself carries a large Russian
+stress dictionary (``ru_dict``, the largest dict in
+``/root/reference/deps/dev/espeak-ng-data``).  This hermetic stand-in
+combines the letter/palatalization system (exact) with a stressed-word
+lexicon for frequent words and a penultimate default elsewhere, so
+consonants are right and vowels are right wherever stress is known.
+
+Covered phenomena: the full consonant map (ж/ш/щ/ц/ч), palatalization
+via soft vowels and ь (Cʲ), iotated vowels word-initial / after vowels
+(я → ja), akanie (unstressed о → a) and ikanie (unstressed е/я → ɪ)
+applied AFTER stress assignment, final obstruent devoicing, and the
+в→f assimilation before voiceless obstruents.
+"""
+
+from __future__ import annotations
+
+# stress positions (1-based nucleus index) for frequent words where the
+# penultimate default is wrong; eSpeak resolves these from ru_dict
+_STRESS: dict[str, int] = {
+    "хорошо": 3, "говорит": 3, "говорить": 3, "человек": 3,
+    "молоко": 3, "голова": 3, "борода": 3, "города": 3, "язык": 2,
+    "утро": 1, "вечер": 1, "город": 1, "слово": 1, "небо": 1,
+    "время": 1, "место": 1, "дело": 1, "море": 1, "поле": 1,
+    "мама": 1, "папа": 1, "книга": 1, "школа": 1, "мир": 1,
+    "привет": 2, "спасибо": 2, "пожалуйста": 2, "здравствуйте": 2,
+    "сегодня": 2, "погода": 2, "работа": 2, "собака": 2, "дорога": 2,
+    "свобода": 2, "природа": 2, "минута": 2, "машина": 2,
+    "вода": 2, "рука": 2, "нога": 2, "глаза": 2, "окно": 2,
+    "объект": 2, "земля": 2, "вопрос": 2, "ответ": 2, "россия": 2,
+}
+
+_PLAIN = {"а": "a", "о": "o", "у": "u", "ы": "ɨ", "э": "e"}
+_IOTATED = {"я": "a", "е": "e", "ё": "o", "ю": "u", "и": "i"}
+_CONS = {"б": "b", "в": "v", "г": "ɡ", "д": "d", "ж": "ʒ", "з": "z",
+         "й": "j", "к": "k", "л": "l", "м": "m", "н": "n", "п": "p",
+         "р": "r", "с": "s", "т": "t", "ф": "f", "х": "x", "ц": "ts",
+         "ч": "tʃ", "ш": "ʃ", "щ": "ɕ"}
+# letters that never palatalize (always-hard consonants)
+_ALWAYS_HARD = {"ж", "ш", "ц"}
+_DEVOICE = {"b": "p", "bʲ": "pʲ", "d": "t", "dʲ": "tʲ", "ɡ": "k",
+            "v": "f", "vʲ": "fʲ", "z": "s", "zʲ": "sʲ", "ʒ": "ʃ"}
+_VOICELESS_LETTERS = set("пткфсшщцчх")
+
+
+def _scan(word: str) -> tuple[list[str], list[bool]]:
+    """Scan one lowercase word → (units, vowel_flags).  Vowels are
+    emitted in their STRESSED quality; word_to_ipa applies reduction
+    after stress assignment."""
+    out: list[str] = []
+    flags: list[bool] = []
+    i = 0
+    n = len(word)
+
+    def emit(s: str, vowel: bool = False) -> None:
+        out.append(s)
+        flags.append(vowel)
+
+    while i < n:
+        ch = word[i]
+        nxt = word[i + 1] if i + 1 < n else ""
+        if ch in _CONS:
+            c = _CONS[ch]
+            if ch not in _ALWAYS_HARD and ch != "й" and nxt and \
+                    nxt in "еёюяиь":
+                c += "ʲ"
+            # в assimilates to f before voiceless obstruents (всё → fsʲo)
+            if ch == "в" and nxt in _VOICELESS_LETTERS:
+                c = "f"
+            emit(c)
+            i += 1
+            continue
+        if ch in _PLAIN:
+            emit(_PLAIN[ch], True)
+            i += 1
+            continue
+        if ch in _IOTATED:
+            prev = word[i - 1] if i > 0 else ""
+            iotate = (i == 0 or prev in "аоуыэяеёюиьъ")
+            if ch == "и":
+                # и never iotates after a consonant; after ь it does
+                if prev == "ь":
+                    emit("j")
+                emit("i", True)
+            elif iotate:
+                emit("j")
+                emit(_IOTATED[ch], True)
+            else:
+                emit(_IOTATED[ch], True)
+            i += 1
+            continue
+        # ъ hard sign: separates (объект → objekt); ь handled via nxt
+        i += 1
+    if out and out[-1] in _DEVOICE:
+        out[-1] = _DEVOICE[out[-1]]
+    return out, flags
+
+
+# spelling-vs-sound exceptions the scanner cannot derive: г → [v] in
+# the frozen сегодня, and the что/конечно [ʃ] class
+_SPELLING = {"сегодня": "севодня", "что": "што", "чтобы": "штобы",
+             "конечно": "конешно", "скучно": "скушно"}
+
+
+def word_to_ipa(word: str) -> str:
+    orig = word
+    word = _SPELLING.get(word, word)
+    # genitive -ого/-его endings read г as [v] (нового → novava)
+    if word.endswith(("ого", "его")) and len(word) > 3:
+        word = word[:-2] + "во"
+    units, flags = _scan(word)
+    nuclei = [k for k, f in enumerate(flags) if f]
+    if not nuclei:
+        return "".join(units)
+    if len(nuclei) == 1:
+        return "".join(units)
+    stress_pos = _STRESS.get(orig)
+    if stress_pos is not None:
+        target_n = min(stress_pos - 1, len(nuclei) - 1)
+    elif word.endswith("дцать"):
+        target_n = len(nuclei) - 2  # the -дцать numerals stay penult
+    elif word.endswith(("ть", "л", "ла", "ло", "ли")) and \
+            len(nuclei) >= 2:
+        target_n = len(nuclei) - 1  # verbs lean final/near-final
+    elif word.endswith("ой"):
+        target_n = len(nuclei) - 1  # -ой adjectives stress the ending
+    else:
+        target_n = len(nuclei) - 2  # penultimate default
+    # vowel reduction AFTER stress: unstressed о → a (akanie),
+    # unstressed е → ɪ (ikanie); я (the 'a' after j or a soft
+    # consonant) reduces to ɪ likewise
+    for k, u in enumerate(nuclei):
+        if k == target_n:
+            continue
+        if units[u] == "o":
+            units[u] = "a"
+        elif units[u] == "e":
+            units[u] = "ɪ"
+        elif units[u] == "a" and u > 0 and (
+                units[u - 1] == "j" or units[u - 1].endswith("ʲ")):
+            units[u] = "ɪ"
+    from .rule_g2p import place_stress
+
+    return place_stress(units, flags, nuclei[target_n],
+                        liquids=("r", "l", "rʲ", "lʲ", "j"))
+
+
+_ONES = ["ноль", "один", "два", "три", "четыре", "пять", "шесть",
+         "семь", "восемь", "девять", "десять", "одиннадцать",
+         "двенадцать", "тринадцать", "четырнадцать", "пятнадцать",
+         "шестнадцать", "семнадцать", "восемнадцать", "девятнадцать"]
+_TENS = ["", "", "двадцать", "тридцать", "сорок", "пятьдесят",
+         "шестьдесят", "семьдесят", "восемьдесят", "девяносто"]
+_HUNDREDS = ["", "сто", "двести", "триста", "четыреста", "пятьсот",
+             "шестьсот", "семьсот", "восемьсот", "девятьсот"]
+
+
+def number_to_words(num: int) -> str:
+    if num < 0:
+        return "минус " + number_to_words(-num)
+    if num < 20:
+        return _ONES[num]
+    if num < 100:
+        t, o = divmod(num, 10)
+        return _TENS[t] + (" " + _ONES[o] if o else "")
+    if num < 1000:
+        h, r = divmod(num, 100)
+        return _HUNDREDS[h] + (" " + number_to_words(r) if r else "")
+    if num < 1_000_000:
+        k, r = divmod(num, 1000)
+        if k == 1:
+            head = "тысяча"
+        else:
+            kw = number_to_words(k)
+            # тысяча is feminine: один/два agree as одна/две
+            if kw.endswith("один"):
+                kw = kw[:-4] + "одна"
+            elif kw.endswith("два"):
+                kw = kw[:-3] + "две"
+            if k % 10 in (2, 3, 4) and k % 100 not in (12, 13, 14):
+                head = kw + " тысячи"
+            elif k % 10 == 1 and k % 100 != 11:
+                head = kw + " тысяча"
+            else:
+                head = kw + " тысяч"
+        return head + (" " + number_to_words(r) if r else "")
+    m, r = divmod(num, 1_000_000)
+    if m == 1:
+        head = "миллион"
+    elif m % 10 == 1 and m % 100 != 11:
+        head = number_to_words(m) + " миллион"  # двадцать один миллион
+    elif m % 10 in (2, 3, 4) and m % 100 not in (12, 13, 14):
+        head = number_to_words(m) + " миллиона"
+    else:
+        head = number_to_words(m) + " миллионов"
+    return head + (" " + number_to_words(r) if r else "")
+
+
+def normalize_text(text: str) -> str:
+    from .rule_g2p import expand_numbers
+
+    return expand_numbers(text, number_to_words).lower()

@@ -706,14 +706,129 @@ def test_tr_ro_nl_number_expansion():
     assert nl_num(345) == "driehonderdvijfenveertig"
 
 
+GOLDEN_CORPUS_CS = [
+    ("Dobrý den, jak se máš?", "ˈdobriː dɛn jak sɛ maːʃ"),
+    ("Děkuji, mám se dobře", "ˈɟɛkuji maːm sɛ ˈdobr̝ɛ"),
+    ("dvacet tři knih na stole", "ˈdvatsɛt tr̝i kɲix na ˈstolɛ"),
+    ("Praha je krásné město", "ˈpraɦa jɛ ˈkraːsnɛː ˈmɲɛsto"),
+]
+
+GOLDEN_CORPUS_HU = [
+    ("Szia világ, hogy vagy ma?", "ˈsiɒ ˈvilaːɡ hoɟ vɒɟ mɒ"),
+    ("Köszönöm szépen, jól vagyok",
+     "ˈkøsønøm ˈseːpɛn joːl ˈvɒɟok"),
+    ("huszonhárom könyv az asztalon",
+     "ˈhusonhaːrom køɲv ɒz ˈɒstɒlon"),
+    ("A magyar nyelv nagyon szép", "ɒ ˈmɒɟɒr ɲɛlv ˈnɒɟon seːp"),
+]
+
+
+def test_golden_ipa_corpus_czech():
+    """Czech rule pack: háček consonants incl. ř, ě-softening families,
+    di/ti/ni softening, length marks, final devoicing, initial stress."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    for text, golden in GOLDEN_CORPUS_CS:
+        assert phonemize_clause(text, voice="cs") == golden, text
+
+
+def test_golden_ipa_corpus_hungarian():
+    """Hungarian rule pack: digraph inventory (sz/zs/cs/gy/ny/ty/ly)
+    with doubled-digraph length, ɒ/aː contrast, initial stress."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    for text, golden in GOLDEN_CORPUS_HU:
+        assert phonemize_clause(text, voice="hu") == golden, text
+
+
+def test_czech_phenomena():
+    from sonata_tpu.text.rule_g2p_cs import word_to_ipa
+
+    assert word_to_ipa("dítě") == "ˈɟiːcɛ"     # di + tě softening
+    assert word_to_ipa("město") == "ˈmɲɛsto"   # mě → mɲɛ
+    assert word_to_ipa("běžet") == "ˈbjɛʒɛt"   # bě → bjɛ
+    assert word_to_ipa("chléb") == "xlɛːp"     # ch → x, final devoice
+    assert word_to_ipa("vůz") == "vuːs"        # ů long, final z → s
+    assert word_to_ipa("řeka") == "ˈr̝ɛka"      # ř
+
+
+def test_hungarian_phenomena():
+    from sonata_tpu.text.rule_g2p_hu import word_to_ipa
+
+    assert word_to_ipa("magyar") == "ˈmɒɟɒr"   # gy → ɟ, a → ɒ
+    assert word_to_ipa("asszony") == "ˈɒsːoɲ"  # ssz doubled digraph
+    assert word_to_ipa("szép") == "seːp"       # sz → s, é → eː
+    assert word_to_ipa("sör") == "ʃør"         # bare s → ʃ
+    assert word_to_ipa("hölgy") == "hølɟ"      # ö, lgy cluster
+
+
+def test_cs_hu_number_expansion():
+    from sonata_tpu.text.rule_g2p_cs import number_to_words as cs_num
+    from sonata_tpu.text.rule_g2p_hu import number_to_words as hu_num
+
+    assert cs_num(23) == "dvacet tři"
+    assert cs_num(2000) == "dva tisíce"
+    assert cs_num(345) == "tři sta čtyřicet pět"
+    assert hu_num(23) == "huszonhárom"
+    assert hu_num(1956) == "ezerkilencszázötvenhat"
+    assert hu_num(100) == "száz"
+    assert hu_num(200) == "kétszáz"   # kettő compounds as két
+    assert hu_num(2000) == "kétezer"
+
+
+GOLDEN_CORPUS_RU = [
+    ("Привет мир, как дела?", "prʲiˈvʲet mʲir kak dʲɪˈla"),
+    ("Спасибо большое, всё хорошо",
+     "spaˈsʲiba balʲˈʃojɪ fsʲo xaraˈʃo"),
+    ("двадцать три книги на столе",
+     "ˈdvadtsatʲ trʲi ˈknʲiɡʲi na ˈstolʲɪ"),
+    ("Сегодня хорошая погода",
+     "sʲɪˈvodnʲɪ xaraˈʃajɪ paˈɡoda"),
+]
+
+
+def test_golden_ipa_corpus_russian():
+    """Russian rule pack: palatalization via soft vowels/ь, iotated
+    vowels, akanie/ikanie reduction after stress assignment, final
+    devoicing, в→f assimilation, stress lexicon + heuristics."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    for text, golden in GOLDEN_CORPUS_RU:
+        assert phonemize_clause(text, voice="ru") == golden, text
+
+
+def test_russian_phenomena():
+    from sonata_tpu.text.rule_g2p_ru import word_to_ipa
+
+    assert word_to_ipa("привет") == "prʲiˈvʲet"  # final т stays hard
+    assert word_to_ipa("хлеб") == "xlʲep"        # final devoicing
+    assert word_to_ipa("всё") == "fsʲo"          # в → f assimilation
+    assert word_to_ipa("язык") == "jɪˈzɨk"       # iotated я + ikanie, ы
+    assert word_to_ipa("вода") == "vaˈda"        # lexical stress, akanie
+    assert word_to_ipa("большой") == "balʲˈʃoj"  # -ой ending stress
+    assert word_to_ipa("нового") == "naˈvova"    # genitive г → [v]
+    assert word_to_ipa("что") == "ʃto"           # spelling exception
+
+
+def test_russian_number_expansion():
+    from sonata_tpu.text.rule_g2p_ru import number_to_words
+
+    assert number_to_words(23) == "двадцать три"
+    assert number_to_words(2000) == "две тысячи"   # feminine agreement
+    assert number_to_words(21000) == "двадцать одна тысяча"
+    assert number_to_words(5000) == "пять тысяч"
+    assert number_to_words(1945) == "тысяча девятьсот сорок пять"
+    assert number_to_words(21_000_000) == "двадцать один миллион"
+
+
 def test_unsupported_language_raises():
     import pytest
 
     from sonata_tpu.core import PhonemizationError
     from sonata_tpu.text.rule_g2p import phonemize_clause
 
-    with pytest.raises(PhonemizationError, match="no rules for language 'cs'"):
-        phonemize_clause("dobrý den", voice="cs")
+    with pytest.raises(PhonemizationError, match="no rules for language 'sv'"):
+        phonemize_clause("god dag", voice="sv")
 
 
 def test_unsupported_language_best_effort_env(monkeypatch):
@@ -721,7 +836,7 @@ def test_unsupported_language_best_effort_env(monkeypatch):
 
     monkeypatch.setenv(BEST_EFFORT_ENV, "1")
     # explicit opt-in: falls back to English letter-to-sound, no raise
-    assert phonemize_clause("dobrý", voice="cs")
+    assert phonemize_clause("hej", voice="sv")
 
 
 def test_language_number_expansion():
