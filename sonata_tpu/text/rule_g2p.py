@@ -313,6 +313,17 @@ _LANGUAGES: dict[str, tuple] = {
            _lazy("rule_g2p_hu", "word_to_ipa")),
     "ru": (_lazy("rule_g2p_ru", "normalize_text"),
            _lazy("rule_g2p_ru", "word_to_ipa")),
+    "el": (_lazy("rule_g2p_el", "normalize_text"),
+           _lazy("rule_g2p_el", "word_to_ipa")),
+    "fi": (_lazy("rule_g2p_fi", "normalize_text"),
+           _lazy("rule_g2p_fi", "word_to_ipa")),
+    "id": (_lazy("rule_g2p_id", "normalize_text"),
+           _lazy("rule_g2p_id", "word_to_ipa")),
+    "ms": (_lazy("rule_g2p_id", "normalize_text_ms"),  # EYD spelling
+           _lazy("rule_g2p_id", "word_to_ipa")),       # shared; Malay
+                                                       # numerals differ
+    "sw": (_lazy("rule_g2p_sw", "normalize_text"),
+           _lazy("rule_g2p_sw", "word_to_ipa")),
 }
 
 #: Env var: set to "1" to let unsupported languages fall back to English

@@ -821,6 +821,120 @@ def test_russian_number_expansion():
     assert number_to_words(21_000_000) == "двадцать один миллион"
 
 
+GOLDEN_CORPUS_EL = [
+    ("Καλημέρα κόσμε, τι κάνεις;", "kaliˈmera ˈkozme ti ˈkanis"),
+    ("Ευχαριστώ πολύ, είμαι καλά", "efxarisˈto poˈli ˈime kaˈla"),
+    ("είκοσι τρία παιδιά στην αυλή",
+     "ˈikosi ˈtria peðiˈa stin avˈli"),
+]
+
+GOLDEN_CORPUS_FI = [
+    ("Hei maailma, mitä kuuluu?", "ˈhei ˈmɑːilmɑ ˈmitæ ˈkuːluː"),
+    ("Kiitos paljon, hyvää päivää",
+     "ˈkiːtos ˈpɑljon ˈhyvæː ˈpæivæː"),
+    ("kaksikymmentäkolme kirjaa pöydällä",
+     "ˈkɑksikymːentækolme ˈkirjɑː ˈpøydælːæ"),
+]
+
+GOLDEN_CORPUS_ID = [
+    ("Selamat pagi dunia, apa kabar?",
+     "səˈlamat ˈpaɡi duˈnia ˈapa ˈkabar"),
+    ("Terima kasih banyak, sampai jumpa",
+     "təˈrima ˈkasih ˈbaɲak samˈpai ˈdʒumpa"),
+    ("dua puluh tiga buku di atas meja",
+     "ˈdua ˈpuluh ˈtiɡa ˈbuku di ˈatas məˈdʒa"),
+]
+
+GOLDEN_CORPUS_SW = [
+    ("Habari ya asubuhi dunia?", "haˈbari ja asuˈbuhi duˈnia"),
+    ("Asante sana, karibu tena", "aˈsante ˈsana kaˈribu ˈtena"),
+    ("vitabu ishirini na vitatu mezani",
+     "viˈtabu iʃiˈrini na viˈtatu meˈzani"),
+]
+
+
+def test_golden_ipa_corpus_greek():
+    """Greek rule pack: merged vowel digraphs, αυ/ευ voicing, voiced
+    stop digraphs (μπ/ντ/γκ), σ-voicing, written-accent stress."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    for text, golden in GOLDEN_CORPUS_EL:
+        assert phonemize_clause(text, voice="el") == golden, text
+
+
+def test_golden_ipa_corpus_finnish():
+    """Finnish rule pack: doubled letters as length, ä/ö/y fronts,
+    ng/nk velars, fixed initial stress."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    for text, golden in GOLDEN_CORPUS_FI:
+        assert phonemize_clause(text, voice="fi") == golden, text
+
+
+def test_golden_ipa_corpus_indonesian():
+    """Indonesian rule pack: ng/ny/sy/kh digraphs, c/j affricates,
+    schwa heuristic, penultimate stress skipping schwa."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    for text, golden in GOLDEN_CORPUS_ID:
+        assert phonemize_clause(text, voice="id") == golden, text
+    # Malay shares the pack
+    assert phonemize_clause("terima kasih", voice="ms") == \
+        "təˈrima ˈkasih"
+
+
+def test_golden_ipa_corpus_swahili():
+    """Swahili rule pack: digraphs incl. ng', every vowel a nucleus,
+    fixed penultimate stress."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    for text, golden in GOLDEN_CORPUS_SW:
+        assert phonemize_clause(text, voice="sw") == golden, text
+
+
+def test_el_fi_id_sw_phenomena():
+    from sonata_tpu.text.rule_g2p_el import word_to_ipa as el
+    from sonata_tpu.text.rule_g2p_fi import word_to_ipa as fi
+    from sonata_tpu.text.rule_g2p_id import word_to_ipa as idw
+    from sonata_tpu.text.rule_g2p_sw import word_to_ipa as sw
+
+    assert el("μπορώ") == "boˈro"        # μπ → b
+    assert el("αυτός") == "afˈtos"       # αυ → af before voiceless
+    assert el("γλώσσα") == "ˈɣlosa"      # σσ collapses
+    assert el("λαϊκός") == "laiˈkos"     # dialytika ϊ is hiatus
+    assert el("ρολόι") == "roˈloi"       # accented first vowel: hiatus
+    assert el("υιοθεσία") == "ioθeˈsia"  # υι → i
+    assert fi("kenkä") == "ˈkeŋkæ"       # nk → ŋk
+    assert fi("hyvää") == "ˈhyvæː"       # doubled vowel length
+    assert idw("nyanyi") == "ˈɲaɲi"      # ny digraph
+    assert idw("cinta") == "ˈtʃinta"     # c → tʃ
+    assert sw("ng'ombe") == "ˈŋombe"     # ng' → ŋ
+    assert sw("chakula") == "tʃaˈkula"   # penult stress
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    # typographic apostrophe folds to ASCII before tokenization
+    assert phonemize_clause("ng’ombe", voice="sw") == "ˈŋombe"
+    # Malay numerals differ from Indonesian (lapan vs delapan)
+    assert phonemize_clause("8", voice="ms") == "ˈlapan"
+    assert phonemize_clause("8", voice="id") == "dəˈlapan"
+
+
+def test_el_fi_id_sw_numbers():
+    from sonata_tpu.text.rule_g2p_el import number_to_words as eln
+    from sonata_tpu.text.rule_g2p_fi import number_to_words as fin
+    from sonata_tpu.text.rule_g2p_id import number_to_words as idn
+    from sonata_tpu.text.rule_g2p_sw import number_to_words as swn
+
+    assert eln(23) == "είκοσι τρία"
+    assert eln(101) == "εκατόν ένα"
+    assert fin(23) == "kaksikymmentäkolme"
+    assert fin(1917) == "tuhat yhdeksänsataaseitsemäntoista"
+    assert idn(23) == "dua puluh tiga"
+    assert idn(1945) == "seribu sembilan ratus empat puluh lima"
+    assert swn(23) == "ishirini na tatu"
+    assert swn(105) == "mia moja na tano"
+
+
 def test_unsupported_language_raises():
     import pytest
 
