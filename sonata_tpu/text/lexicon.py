@@ -581,7 +581,7 @@ def derive(word: str) -> Optional[str]:
         ("ers", lambda b: b + "ɚz"),
         ("er", lambda b: b + "ɚ"),
         ("est", lambda b: b + "ɪst"),
-        ("ly", lambda b: b + "li"),
+        ("ly", lambda b: (b[:-1] if b.endswith("l") else b) + "li"),
         ("ness", lambda b: b + "nəs"),
         ("ment", lambda b: b + "mənt"),
         ("ful", lambda b: b + "fəl"),
@@ -617,8 +617,13 @@ def derive(word: str) -> Optional[str]:
     # the second element's primary mark demotes to secondary.
     if len(word) >= 8:
         for cut in range(len(word) - 4, 3, -1):
+            second = word[cut:]
+            if second == "ally":
+                # "-ically" adverbs are suffixation, not compounding:
+                # automatic+ally must not render as the noun "ally"
+                continue
             a = LEXICON.get(word[:cut])
-            b = LEXICON.get(word[cut:])
+            b = LEXICON.get(second)
             if a is not None and b is not None:
                 return a + b.replace("ˈ", "ˌ")
     return None

@@ -49,15 +49,21 @@ _RULES: list[tuple[str, str]] = [
 # (these suffixes attract primary stress onto themselves or leave the stem
 # unstressed, which default stress would get wrong).
 _SUFFIXES: list[tuple[str, str]] = [
+    # a leading "<" sentinel means "primary stress lands on the STEM's
+    # last syllable" (the -ic(al) family): mathematical → mæθəmˈæɾɪkəl
     ("ization", "aɪzˈeɪʃən"), ("ification", "ɪfɪkˈeɪʃən"),
     ("ation", "ˈeɪʃən"), ("ition", "ˈɪʃən"), ("ution", "ˈuːʃən"),
+    ("icity", "ˈɪsɪti"), ("ibility", "əbˈɪlɪti"),
+    ("ability", "əbˈɪlɪti"), ("bility", "bˈɪlɪti"),
     ("cious", "ʃəs"), ("tious", "ʃəs"), ("geous", "dʒəs"),
     ("cial", "ʃəl"), ("tial", "ʃəl"), ("cian", "ʃən"),
     ("ience", "iəns"), ("ient", "iənt"),
     ("ology", "ˈɑːlədʒi"), ("ography", "ˈɑːɡɹəfi"),
     ("ular", "jʊlɚ"),
-    ("ical", "ɪkəl"), ("ualize", "juəlaɪz"), ("ual", "juəl"),
-    ("ious", "iəs"), ("ous", "əs"), ("ive", "ɪv"),
+    ("ically", "<ɪkli"), ("ical", "<ɪkəl"), ("icist", "<ɪsɪst"),
+    ("ualize", "juəlaɪz"), ("ual", "juəl"),
+    ("ious", "iəs"), ("ous", "əs"),
+    ("ative", "<əɾɪv"), ("itive", "<ɪɾɪv"), ("ive", "ɪv"),
     ("able", "əbəl"), ("ible", "əbəl"),
     ("ture", "tʃɚ"), ("sure", "ʒɚ"),
     ("ary", "ˌɛɹi"), ("ory", "ˌɔːɹi"),
@@ -67,6 +73,46 @@ _SUFFIXES: list[tuple[str, str]] = [
     ("ify", "ɪfaɪ"), ("ity", "ɪti"),
     ("al", "əl"), ("le", "əl"), ("el", "əl"),
 ]
+
+_VOWEL_UNITS = ("aɪ", "aʊ", "eɪ", "oʊ", "ɔɪ", "iː", "uː", "ɑː",
+                     "ɔː", "ɜː", "a", "e", "i", "o", "u", "æ", "ɛ",
+                     "ɪ", "ɒ", "ɔ", "ʊ", "ʌ", "ə", "ɚ")
+
+
+def _stress_stem_last(ipa: str) -> str:
+    """Insert ˈ before the onset of the LAST syllable of a stem's IPA
+    (the -ic(al)/-ative family attracts stress there)."""
+    ipa = ipa.replace("ˈ", "").replace("ˌ", "")
+    last = -1
+    k = 0
+    while k < len(ipa):
+        for v in _VOWEL_UNITS:
+            if ipa.startswith(v, k):
+                last = k
+                k += len(v)
+                break
+        else:
+            k += 1
+    if last < 0:
+        return ipa
+
+    def is_vowelish(k: int) -> bool:
+        return any(ipa.startswith(v, k) for v in _VOWEL_UNITS) \
+            or ipa[k] in "ːˈˌ"
+
+    # take at most a LEGAL onset: one consonant (affricates dʒ/tʃ count
+    # whole), or obstruent+liquid / s+stop pairs — walking back
+    # arbitrary clusters would put the mark inside codas (kəˈmpiːt)
+    onset = last
+    if onset > 0 and not is_vowelish(onset - 1):
+        onset -= 1
+        if onset > 0 and not is_vowelish(onset - 1):
+            pair = ipa[onset - 1] + ipa[onset]
+            if pair in ("dʒ", "tʃ") or \
+                    (pair[0] in "pbtdkɡf" and pair[1] in "ɹrl") or \
+                    (pair[0] == "s" and pair[1] in "ptk"):
+                onset -= 1
+    return ipa[:onset] + "ˈ" + ipa[onset:]
 
 _ONES = ["zero", "one", "two", "three", "four", "five", "six", "seven",
          "eight", "nine", "ten", "eleven", "twelve", "thirteen", "fourteen",
@@ -212,17 +258,38 @@ def english_word_to_ipa(word: str) -> str:
         return _default_stress(hit)
     # suffix-anchored endings before the raw letter scan: the stem scans
     # letter-by-letter, the ending renders from the table (and may carry
-    # the stress mark the suffix attracts)
+    # the stress mark the suffix attracts).  A trailing plural/3sg -s
+    # rides along (congratulations = congratulation + z).
+    suffix_word = word
+    if word.endswith("ies") and len(word) > 5:
+        suffix_word = word[:-3] + "y"  # responsibilities → ...ity
+    elif word.endswith("s") and not word.endswith("ss") and len(word) > 4:
+        suffix_word = word[:-1]
+    candidates = [(word, False)]
+    if suffix_word != word:
+        candidates.append((suffix_word, True))
     for suf, sipa in _SUFFIXES:
-        stem = word[: -len(suf)]
-        if (word.endswith(suf) and len(stem) >= 3
-                and any(v in stem for v in "aeiouy")):
-            base = derive(stem) or derive(stem + "e") or _scan_letters(stem)
-            # a stem resolved from the lexicon keeps only its own
-            # secondary prominence when the suffix carries the primary
-            if "ˈ" in sipa:
-                base = base.replace("ˈ", "ˌ")
-            return _default_stress(base + sipa)
+        for w, plur in candidates:
+            stem = w[: -len(suf)]
+            if (w.endswith(suf) and len(stem) >= 3
+                    and any(v in stem for v in "aeiouy")):
+                base = derive(stem) or derive(stem + "e") \
+                    or _scan_letters(stem)
+                if sipa.startswith("<"):
+                    # the suffix attracts stress onto the stem's last
+                    # syllable (the -ic(al)/-ative family)
+                    base = _stress_stem_last(base)
+                    sipa = sipa[1:]
+                elif "ˈ" in sipa:
+                    # a stem resolved from the lexicon keeps only its own
+                    # secondary prominence when the suffix carries primary
+                    base = base.replace("ˈ", "ˌ")
+                out = base + sipa
+                if plur:
+                    from .lexicon import _plural
+
+                    out = _plural(out)  # s/z/ɪz allomorphy
+                return _default_stress(out)
     return _default_stress(_scan_letters(word))
 
 

@@ -338,6 +338,22 @@ def test_secondary_only_words_still_get_primary_stress():
     assert "ˈ" in g("overwork")
 
 
+def test_latinate_suffix_stress():
+    """The -ic(al)/-icity/-bility/-ative families place stress relative
+    to the suffix (round-4 syllabification pass, ROADMAP item)."""
+    from sonata_tpu.text.rule_g2p import english_word_to_ipa as g
+
+    assert g("electricity").endswith("ˈɪsɪti")     # -icity self-stress
+    assert g("responsibility").endswith("bˈɪlɪti")
+    assert "ˈmæt" in g("mathematical")             # stress before -ical
+    assert g("basically") == "ˈbeɪsɪkli"           # base + ically
+    assert g("automatically").endswith("ˈmætɪkli")
+    assert g("competitive") == "kəmˈpiːtɪɾɪv"      # legal-onset walk
+    # plural rides along the suffix match
+    assert g("congratulations").endswith("ˈeɪʃənz")
+    assert g("operations").endswith("ˈeɪʃənz")
+
+
 GOLDEN_CORPUS_DE = [
     ("Hallo Welt, wie geht es dir heute?",
      "haˈloː vɛlt viː ɡeːt ɛs dɪʁ ˈhɔʏtə"),
