@@ -391,6 +391,18 @@ _LANGUAGES: dict[str, tuple] = {
                                                        # numerals differ
     "sw": (_lazy("rule_g2p_sw", "normalize_text"),
            _lazy("rule_g2p_sw", "word_to_ipa")),
+    "sk": (_lazy("rule_g2p_sk", "normalize_text"),
+           _lazy("rule_g2p_sk", "word_to_ipa")),
+    "hr": (_lazy("rule_g2p_hr", "normalize_text"),
+           _lazy("rule_g2p_hr", "word_to_ipa")),
+    "sr": (_lazy("rule_g2p_hr", "normalize_text"),  # shared BCMS pack
+           _lazy("rule_g2p_hr", "word_to_ipa")),
+    "bs": (_lazy("rule_g2p_hr", "normalize_text"),
+           _lazy("rule_g2p_hr", "word_to_ipa")),
+    "uk": (_lazy("rule_g2p_uk", "normalize_text"),
+           _lazy("rule_g2p_uk", "word_to_ipa")),
+    "bg": (_lazy("rule_g2p_bg", "normalize_text"),
+           _lazy("rule_g2p_bg", "word_to_ipa")),
 }
 
 #: Env var: set to "1" to let unsupported languages fall back to English

@@ -951,6 +951,88 @@ def test_el_fi_id_sw_numbers():
     assert swn(105) == "mia moja na tano"
 
 
+GOLDEN_CORPUS_SK = [
+    ("Ahoj svet, ako sa máš?", "ˈaɦoj svet ˈako sa maːʃ"),
+    ("Ďakujem pekne, dobrý deň", "ˈɟakujem ˈpekɲe ˈdobriː ɟeɲ"),
+]
+
+GOLDEN_CORPUS_HR = [
+    ("Zdravo svijete, kako si danas?",
+     "ˈzdravo ˈsvijete ˈkako si ˈdanas"),
+    ("Hvala lijepa, dobar dan", "ˈxvala ˈlijepa ˈdobar dan"),
+]
+
+GOLDEN_CORPUS_UK = [
+    ("Привіт світ, як справи?", "prɪˈʋʲit sʋʲit jak ˈspraʋɪ"),
+    ("Дякую, все добре сьогодні",
+     "ˈdʲakuju ʋsɛ ˈdobrɛ sʲoˈɦodnʲi"),
+]
+
+GOLDEN_CORPUS_BG = [
+    ("Здравей свят, как си днес?", "zdraˈvɛj svʲat kak si dnɛs"),
+    ("Благодаря много, добър ден",
+     "blaɡodaˈrʲa ˈmnoɡo doˈbɤr dɛn"),
+]
+
+
+def test_golden_ipa_corpus_slavic_batch():
+    """Slovak (ď/ť/ň/ľ softening, ô → uo, initial stress),
+    Serbo-Croatian (č/ć contrast, lj/nj, syllabic r, shared by
+    hr/sr/bs), Ukrainian (ɦ, ɪ, no akanie, palatalization), and
+    Bulgarian (ɤ, regressive final devoicing)."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    for voice, corpus in (("sk", GOLDEN_CORPUS_SK),
+                          ("hr", GOLDEN_CORPUS_HR),
+                          ("uk", GOLDEN_CORPUS_UK),
+                          ("bg", GOLDEN_CORPUS_BG)):
+        for text, golden in corpus:
+            assert phonemize_clause(text, voice=voice) == golden, \
+                (voice, text)
+    # sr and bs share the BCMS pack; Serbian Cyrillic transliterates
+    assert phonemize_clause("hvala", voice="sr") == "ˈxvala"
+    assert phonemize_clause("hvala", voice="bs") == "ˈxvala"
+    assert phonemize_clause("Здраво свете", voice="sr") == \
+        "ˈzdravo ˈsvete"
+    assert phonemize_clause("љубав", voice="sr") == "ˈʎubav"
+
+
+def test_slavic_batch_phenomena():
+    from sonata_tpu.text.rule_g2p_bg import word_to_ipa as bg
+    from sonata_tpu.text.rule_g2p_hr import word_to_ipa as hr
+    from sonata_tpu.text.rule_g2p_sk import word_to_ipa as sk
+    from sonata_tpu.text.rule_g2p_uk import word_to_ipa as uk
+
+    assert sk("kôň") == "kuoɲ"           # ô → uo
+    assert sk("dieťa") == "ˈɟieca"       # de/di softening + ť
+    assert sk("vŕba") == "ˈvrːba"        # syllabic ŕ is a nucleus
+    assert sk("dážď") == "daːʃc"         # regressive cluster devoicing
+    assert hr("prst") == "prst"          # syllabic r nucleus
+    assert hr("ljubav") == "ˈʎubav"      # lj digraph
+    assert uk("м'ята") == "ˈmjata"       # apostrophe blocks softening
+    assert uk("ґанок") == "ˈɡanok"       # ґ vs г
+    assert uk("мова") == "ˈmoʋa"         # no akanie: о stays o
+    assert bg("дъжд") == "dɤʃt"          # regressive final devoicing
+    assert bg("къща") == "ˈkɤʃta"        # ъ → ɤ, щ → ʃt
+
+
+def test_slavic_batch_numbers():
+    from sonata_tpu.text.rule_g2p_bg import number_to_words as bgn
+    from sonata_tpu.text.rule_g2p_hr import number_to_words as hrn
+    from sonata_tpu.text.rule_g2p_sk import number_to_words as skn
+    from sonata_tpu.text.rule_g2p_uk import number_to_words as ukn
+
+    assert skn(23) == "dvadsať tri"
+    assert skn(2000) == "dvetisíc"
+    assert hrn(23) == "dvadeset i tri"
+    assert ukn(2000) == "дві тисячі"
+    assert ukn(21000) == "двадцять одна тисяча"
+    assert bgn(23) == "двадесет и три"
+    assert bgn(101) == "сто и едно"
+    assert bgn(123) == "сто двадесет и три"  # и only before the last
+    assert bgn(2_000_000) == "два милиона"
+
+
 def test_unsupported_language_raises():
     import pytest
 
