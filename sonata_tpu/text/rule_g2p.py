@@ -403,6 +403,16 @@ _LANGUAGES: dict[str, tuple] = {
            _lazy("rule_g2p_uk", "word_to_ipa")),
     "bg": (_lazy("rule_g2p_bg", "normalize_text"),
            _lazy("rule_g2p_bg", "word_to_ipa")),
+    "sv": (_lazy("rule_g2p_sv", "normalize_text"),
+           _lazy("rule_g2p_sv", "word_to_ipa")),
+    "no": (_lazy("rule_g2p_no", "normalize_text"),
+           _lazy("rule_g2p_no", "word_to_ipa")),
+    "nb": (_lazy("rule_g2p_no", "normalize_text"),  # bokmål alias
+           _lazy("rule_g2p_no", "word_to_ipa")),
+    "da": (_lazy("rule_g2p_da", "normalize_text"),
+           _lazy("rule_g2p_da", "word_to_ipa")),
+    "is": (_lazy("rule_g2p_is", "normalize_text"),
+           _lazy("rule_g2p_is", "word_to_ipa")),
 }
 
 #: Env var: set to "1" to let unsupported languages fall back to English

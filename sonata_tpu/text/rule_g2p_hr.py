@@ -111,10 +111,17 @@ def number_to_words(num: int) -> str:
         k, r = divmod(num, 1000)
         if k == 1:
             head = "tisuću"
-        elif k in (2, 3, 4):
-            head = _ONES[k] + " tisuće"
         else:
-            head = number_to_words(k) + " tisuća"
+            kw = number_to_words(k)
+            # tisuća is feminine: jedan/dva agree as jedna/dvije
+            if kw.endswith("jedan"):
+                kw = kw[:-5] + "jedna"
+            elif kw.endswith("dva"):
+                kw = kw[:-3] + "dvije"
+            if k % 10 in (2, 3, 4) and k % 100 not in (12, 13, 14):
+                head = kw + " tisuće"  # paucal
+            else:
+                head = kw + " tisuća"
         return head + (" " + number_to_words(r) if r else "")
     m, r = divmod(num, 1_000_000)
     head = ("milijun" if m == 1

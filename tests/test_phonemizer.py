@@ -1033,14 +1033,77 @@ def test_slavic_batch_numbers():
     assert bgn(2_000_000) == "два милиона"
 
 
+GOLDEN_CORPUS_NORDIC = {
+    "sv": [("Hej världen, hur mår du?", "hɛj ˈvɛrldən hʉːr moːr dʉː"),
+           ("Tack så mycket, god dag", "tak soː ˈmʏkːɛt ɡuːd dɑːɡ")],
+    "no": [("Hei verden, hvordan har du det?",
+            "hæɪ ˈvɛrdən ˈvɔrdɑːn hɑːr dʉː deː"),
+           ("Takk skal du ha, god dag", "tak skɑːl dʉː hɑː ɡuː dɑːɡ")],
+    "da": [("Hej verden, hvordan går det?",
+            "hɑj ˈvɛɐdɛn ˈvoɐdan ɡɔːɐ deː"),
+           ("Mange tak, god dag", "ˈmaŋə taɡ ɡoːð dæː")],
+    "is": [("Halló heimur, hvað segir þú?",
+            "ˈhalou ˈheimʏr kvað ˈsɛjɪr θu"),
+           ("Takk fyrir, góðan daginn",
+            "tʰak ˈfɪrɪr ˈɡouðan ˈtajɪn")],
+}
+
+
+def test_golden_ipa_corpus_nordic():
+    """Swedish (soft k/g/sk, sj-sound ɧ, tj → ɕ), Norwegian (kj → ç,
+    silent hv-h, diphthongs), Danish (soft d → ð, soft g, r-vocalizing,
+    broad lenition), Icelandic (accented-vowel diphthongs, þ/ð, hv →
+    kv, ll → tl pre-stopping, initial stress)."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    for voice, corpus in GOLDEN_CORPUS_NORDIC.items():
+        for text, golden in corpus:
+            assert phonemize_clause(text, voice=voice) == golden, \
+                (voice, text)
+    # nb aliases the Norwegian pack
+    assert phonemize_clause("takk", voice="nb") == "tak"
+
+
+def test_nordic_phenomena():
+    from sonata_tpu.text.rule_g2p_da import word_to_ipa as da
+    from sonata_tpu.text.rule_g2p_is import word_to_ipa as isl
+    from sonata_tpu.text.rule_g2p_no import word_to_ipa as no
+    from sonata_tpu.text.rule_g2p_sv import word_to_ipa as sv
+
+    assert sv("stjärna") == "ˈɧɛrna"    # stj → ɧ, final -a short
+    assert sv("kjol") == "ɕuːl"         # kj → ɕ
+    assert sv("sju") == "ɧʉː"           # sj → ɧ
+    assert no("ski") == "ʃiː"           # sk before i → ʃ
+    assert no("kjøre") == "ˈçøːrɛ"      # kj → ç
+    assert da("mad") == "mað"           # soft final d
+    assert da("gade") == "ˈɡaðə"        # intervocalic d → ð
+    assert isl("þakka") == "ˈθaka"      # þ
+    assert isl("hvað") == "kvað"        # hv → kv
+    assert isl("fjall") == "fjatl"      # ll pre-stopping
+
+
+def test_nordic_numbers():
+    from sonata_tpu.text.rule_g2p_da import number_to_words as dan
+    from sonata_tpu.text.rule_g2p_is import number_to_words as isn
+    from sonata_tpu.text.rule_g2p_no import number_to_words as non
+    from sonata_tpu.text.rule_g2p_sv import number_to_words as svn
+
+    assert svn(23) == "tjugotre"
+    assert svn(345) == "trehundrafyrtiofem"
+    assert non(23) == "tjuetre"
+    assert dan(25) == "femogtyve"    # ones-before-tens
+    assert dan(50) == "halvtreds"    # vigesimal tens
+    assert isn(23) == "tuttugu og þrír"
+
+
 def test_unsupported_language_raises():
     import pytest
 
     from sonata_tpu.core import PhonemizationError
     from sonata_tpu.text.rule_g2p import phonemize_clause
 
-    with pytest.raises(PhonemizationError, match="no rules for language 'sv'"):
-        phonemize_clause("god dag", voice="sv")
+    with pytest.raises(PhonemizationError, match="no rules for language 'vi'"):
+        phonemize_clause("xin chào", voice="vi")
 
 
 def test_unsupported_language_best_effort_env(monkeypatch):
@@ -1048,7 +1111,7 @@ def test_unsupported_language_best_effort_env(monkeypatch):
 
     monkeypatch.setenv(BEST_EFFORT_ENV, "1")
     # explicit opt-in: falls back to English letter-to-sound, no raise
-    assert phonemize_clause("hej", voice="sv")
+    assert phonemize_clause("chào", voice="vi")
 
 
 def test_language_number_expansion():
