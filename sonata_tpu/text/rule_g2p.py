@@ -413,6 +413,14 @@ _LANGUAGES: dict[str, tuple] = {
            _lazy("rule_g2p_da", "word_to_ipa")),
     "is": (_lazy("rule_g2p_is", "normalize_text"),
            _lazy("rule_g2p_is", "word_to_ipa")),
+    "sl": (_lazy("rule_g2p_sl", "normalize_text"),
+           _lazy("rule_g2p_sl", "word_to_ipa")),
+    "ca": (_lazy("rule_g2p_ca", "normalize_text"),
+           _lazy("rule_g2p_ca", "word_to_ipa")),
+    "cy": (_lazy("rule_g2p_cy", "normalize_text"),
+           _lazy("rule_g2p_cy", "word_to_ipa")),
+    "ka": (_lazy("rule_g2p_ka", "normalize_text"),
+           _lazy("rule_g2p_ka", "word_to_ipa")),
 }
 
 #: Env var: set to "1" to let unsupported languages fall back to English

@@ -137,8 +137,19 @@ def number_to_words(num: int) -> str:
         return head + (" og " + number_to_words(r) if r else "")
     if num < 1_000_000:
         k, r = divmod(num, 1000)
-        head = "þúsund" if k == 1 else \
-            (_neuter(k) if k < 20 else number_to_words(k)) + " þúsund"
+        if k == 1:
+            head = "þúsund"
+        elif k < 20:
+            head = _neuter(k) + " þúsund"
+        else:
+            # compound counts agree in neuter too: tuttugu og eitt
+            kw = number_to_words(k)
+            for masc, neut in (("einn", "eitt"), ("tveir", "tvö"),
+                               ("þrír", "þrjú"), ("fjórir", "fjögur")):
+                if kw.endswith(masc):
+                    kw = kw[: -len(masc)] + neut
+                    break
+            head = kw + " þúsund"
         return head + (" og " + number_to_words(r) if r else "")
     m, r = divmod(num, 1_000_000)
     head = ("ein milljón" if m == 1

@@ -15,8 +15,6 @@ initial-stress default with be-/for- unstressed prefixes.
 
 from __future__ import annotations
 
-_FRONT = "eiyæø"
-
 _LEXICON: dict[str, str] = {
     "og": "ɔ", "jeg": "jæɪ", "det": "deː", "er": "æːr", "en": "eːn",
     "et": "ɛt", "ikke": "ˈɪkɛ", "som": "sɔm", "på": "poː",

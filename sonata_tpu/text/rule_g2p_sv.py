@@ -89,6 +89,8 @@ def _scan(word: str) -> tuple[list[str], list[bool]]:
             emit("j" if nxt and nxt in _FRONT and nxt != "j" else "ɡ")
             i += 1
             continue
+        if ch == "é":
+            emit("eː", True); i += 1; continue  # idé, kafé
         if ch == "å":
             emit("oː" if long_ctx(1) else "ɔ", True); i += 1; continue
         if ch == "ä":

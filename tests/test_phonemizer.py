@@ -1096,6 +1096,71 @@ def test_nordic_numbers():
     assert isn(23) == "tuttugu og þrír"
 
 
+GOLDEN_CORPUS_SCCK = {
+    "sl": [("Zdravo svet, kako si danes?",
+            "ˈzdravɔ svɛt ˈkakɔ si ˈdanɛs"),
+           ("Hvala lepa, dobro jutro",
+            "ˈxvala ˈlɛpa ˈdɔbrɔ ˈjutrɔ")],
+    "ca": [("Hola món, com estàs avui?",
+            "ˈolə mon kom əsˈtas əˈbuj"),
+           ("Moltes gràcies, bon dia",
+            "ˈmoltəs ˈɡɾasiəs bon ˈdiə")],
+    "cy": [("Helo byd, sut wyt ti heddiw?",
+            "ˈhelo bɨd sɨt wɨt ti heˈðiu"),
+           ("Diolch yn fawr, bore da",
+            "ˈdiolx ɨn ˈvaur ˈbore da")],
+    "ka": [("გამარჯობა მსოფლიო, როგორ ხარ?",
+            "ɡamardʒɔba msɔpʰliɔ rɔɡɔr xar"),
+           ("დიდი მადლობა, კარგად", "didi madlɔba kʼarɡad")],
+}
+
+
+def test_golden_ipa_corpus_sl_ca_cy_ka():
+    """Slovenian (l/v vocalization, syllabic ər), Catalan (central
+    reduction a/e → ə and o → u, ll/ny, soft c/g, silent final -r),
+    Welsh (ll → ɬ, dd → ð, w/y vowel values, penult stress), Georgian
+    (1:1 mkhedruli incl. ejectives, no stress marks)."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    for voice, corpus in GOLDEN_CORPUS_SCCK.items():
+        for text, golden in corpus:
+            assert phonemize_clause(text, voice=voice) == golden, \
+                (voice, text)
+
+
+def test_sl_ca_cy_ka_phenomena():
+    from sonata_tpu.text.rule_g2p_ca import word_to_ipa as ca
+    from sonata_tpu.text.rule_g2p_cy import word_to_ipa as cy
+    from sonata_tpu.text.rule_g2p_ka import word_to_ipa as ka
+    from sonata_tpu.text.rule_g2p_sl import word_to_ipa as sl
+
+    assert sl("bil") == "biw"            # final l vocalizes
+    assert sl("trg") == "tərɡ"           # syllabic r with schwa
+    assert ca("caixa") == "ˈkaʃə"        # ix → ʃ, final reduction
+    assert ca("puig") == "putʃ"          # final -ig → tʃ
+    assert ca("parlar") == "pəɾˈla"      # silent final -r
+    assert ca("avui") == "əˈbuj"         # falling diphthong final
+    assert cy("llanelli") == "ɬaˈneɬi"   # ll → ɬ, penult
+    assert cy("cwm") == "kum"            # vocalic w
+    assert ka("კარგი") == "kʼarɡi"       # ejective kʼ
+    assert ka("ქართული") == "kʰartʰuli"  # aspirated pair
+
+
+def test_sl_ca_cy_ka_numbers():
+    from sonata_tpu.text.rule_g2p_ca import number_to_words as can
+    from sonata_tpu.text.rule_g2p_cy import number_to_words as cyn
+    from sonata_tpu.text.rule_g2p_ka import number_to_words as kan
+    from sonata_tpu.text.rule_g2p_sl import number_to_words as sln
+
+    assert sln(25) == "petindvajset"     # ones-before-tens
+    assert can(23) == "vint-i-tres"
+    assert can(32) == "trenta-dos"
+    assert cyn(23) == "dau deg tri"      # decimal system
+    assert kan(21) == "ოცდაერთი"          # vigesimal
+    assert kan(45) == "ორმოცდახუთი"
+    assert kan(101) == "ას ერთი"
+
+
 def test_unsupported_language_raises():
     import pytest
 
