@@ -421,6 +421,14 @@ _LANGUAGES: dict[str, tuple] = {
            _lazy("rule_g2p_cy", "word_to_ipa")),
     "ka": (_lazy("rule_g2p_ka", "normalize_text"),
            _lazy("rule_g2p_ka", "word_to_ipa")),
+    "kk": (_lazy("rule_g2p_kk", "normalize_text"),
+           _lazy("rule_g2p_kk", "word_to_ipa")),
+    "lb": (_lazy("rule_g2p_lb", "normalize_text"),
+           _lazy("rule_g2p_lb", "word_to_ipa")),
+    "vi": (_lazy("rule_g2p_vi", "normalize_text"),
+           _lazy("rule_g2p_vi", "word_to_ipa")),
+    "ne": (_lazy("rule_g2p_ne", "normalize_text"),
+           _lazy("rule_g2p_ne", "word_to_ipa")),
 }
 
 #: Env var: set to "1" to let unsupported languages fall back to English
@@ -463,9 +471,15 @@ def phonemize_clause(text: str, voice: str = "en-us") -> str:
                 f"to accept best-effort English letter-to-sound rules."
             )
     normalize, to_ipa = entry
-    # \w excludes combining marks (category Mn), which would strip the very
-    # diacritics the tashkeel stage inserts — include the Arabic harakat range
-    words = re.findall(r"[\w'\u064B-\u0655\u0670]+",
-                       normalize(text), flags=re.UNICODE)
+    # \w excludes combining marks (category Mn): include the Arabic
+    # harakat (the tashkeel stage inserts them), the Devanagari
+    # matras/virama/anusvara (Nepali syllables are meaningless without
+    # them — but NOT the danda punctuation U+0964/65), and the general
+    # combining range U+0300-036F so NFD-normalized Vietnamese keeps
+    # its tone marks
+    words = re.findall(
+        r"[\w'\u0300-\u036F\u064B-\u0655\u0670"
+        r"\u0900-\u0963\u0966-\u097F]+",
+        normalize(text), flags=re.UNICODE)
     ipa_words = [to_ipa(w) for w in words]
     return " ".join(w for w in ipa_words if w)

@@ -1161,14 +1161,91 @@ def test_sl_ca_cy_ka_numbers():
     assert kan(101) == "ას ერთი"
 
 
+GOLDEN_CORPUS_KLVN = {
+    "kk": [("Сәлем әлем, қалайсың?", "sæˈlem æˈlem qɑlɑjˈsəŋ"),
+           ("Рахмет, бәрі жақсы", "rɑxˈmet bæˈrɪ ʒɑqˈsə")],
+    "lb": [("Moien Welt, wéi geet et?", "ˈmojən velt vej ɡeːt et"),
+           ("Merci villmools, äddi", "ˈmɛʁsi ˈfilmoːls ˈædi")],
+    "vi": [("Xin chào thế giới", "sin˧ tʃaːw˨˩ tʰe˧˥ zəːj˧˥"),
+           ("Cảm ơn bạn rất nhiều",
+            "kaːm˧˩˧ əːn˧ ɓaːn˨˩ˀ zət˧˥ ɲiəw˨˩")],
+    "ne": [("नमस्ते संसार", "ˈnʌmʌste ˈsʌnsaːr"),
+           ("धन्यवाद, नेपाली भाषा राम्रो छ",
+            "ˈdʱʌnjʌwaːd ˈnepaːliː ˈbʱaːsaː ˈraːmro tʃʰʌ")],
+}
+
+
+def test_golden_ipa_corpus_kk_lb_vi_ne():
+    """Kazakh (vowel-harmony letter pairs, q/ʁ/ŋ, final stress),
+    Luxembourgish (éi/ou/ue diphthongs, ë → ə, ʁ), Vietnamese (NFD
+    tone extraction, Chao tone letters, northern onset values), and
+    Nepali (Devanagari abugida with matras/virama, word-final schwa
+    deletion sparing single-syllable words)."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    for voice, corpus in GOLDEN_CORPUS_KLVN.items():
+        for text, golden in corpus:
+            assert phonemize_clause(text, voice=voice) == golden, \
+                (voice, text)
+
+
+def test_vietnamese_tones():
+    from sonata_tpu.text.rule_g2p_vi import word_to_ipa as vi
+
+    assert vi("ma") == "maː˧"      # ngang
+    assert vi("mà") == "maː˨˩"     # huyền
+    assert vi("má") == "maː˧˥"     # sắc
+    assert vi("mả") == "maː˧˩˧"    # hỏi
+    assert vi("mã") == "maː˧ˀ˥"    # ngã
+    assert vi("mạ") == "maː˨˩ˀ"    # nặng
+    assert vi("được") == "ɗɯək˨˩ˀ"  # ươ nucleus + quality marks
+    assert vi("nghiêng") == "ŋiəŋ˧"  # ngh onset, iê, ng coda
+    assert vi("gìn") == "zin˨˩"      # gi onset + real nucleus/coda
+    assert vi("hoa") == "hwaː˧"      # o medial glide
+    assert vi("tuần") == "twən˨˩"    # u medial + â nucleus
+    assert vi("mua") == "muə˧"       # ua stays a nucleus (no medial)
+    # NFD-normalized input keeps its tones through the tokenizer
+    import unicodedata
+
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    assert phonemize_clause(unicodedata.normalize("NFD", "chào"),
+                            voice="vi") == "tʃaːw˨˩"
+
+
+def test_nepali_script_handling():
+    from sonata_tpu.text.rule_g2p_ne import word_to_ipa as ne
+
+    assert ne("नेपाल") == "ˈnepaːl"      # matras
+    assert ne("नमस्ते") == "ˈnʌmʌste"    # virama conjunct st
+    assert ne("छ") == "tʃʰʌ"            # single syllable keeps schwa
+    assert ne("काठमाडौं") == "ˈkaːʈʰʌmaːɖʌun"  # retroflex + anusvara
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+
+    # the danda terminator is punctuation, not a word character
+    assert phonemize_clause("नमस्ते संसार।", voice="ne") == \
+        "ˈnʌmʌste ˈsʌnsaːr"
+
+
+def test_kk_lb_numbers():
+    from sonata_tpu.text.rule_g2p_kk import number_to_words as kkn
+    from sonata_tpu.text.rule_g2p_lb import number_to_words as lbn
+    from sonata_tpu.text.rule_g2p_vi import number_to_words as vin
+
+    assert kkn(23) == "жиырма үш"
+    assert lbn(25) == "fënnefanzwanzeg"
+    assert vin(21) == "hai mươi mốt"   # mốt sandhi
+    assert vin(105) == "một trăm lẻ năm"  # lẻ + lăm
+
+
 def test_unsupported_language_raises():
     import pytest
 
     from sonata_tpu.core import PhonemizationError
     from sonata_tpu.text.rule_g2p import phonemize_clause
 
-    with pytest.raises(PhonemizationError, match="no rules for language 'vi'"):
-        phonemize_clause("xin chào", voice="vi")
+    with pytest.raises(PhonemizationError, match="no rules for language 'zh'"):
+        phonemize_clause("你好世界", voice="zh")
 
 
 def test_unsupported_language_best_effort_env(monkeypatch):
@@ -1176,7 +1253,7 @@ def test_unsupported_language_best_effort_env(monkeypatch):
 
     monkeypatch.setenv(BEST_EFFORT_ENV, "1")
     # explicit opt-in: falls back to English letter-to-sound, no raise
-    assert phonemize_clause("chào", voice="vi")
+    assert phonemize_clause("nihao", voice="zh")
 
 
 def test_language_number_expansion():
