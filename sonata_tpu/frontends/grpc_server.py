@@ -407,6 +407,11 @@ def main(argv=None) -> int:
                     help="of the mesh devices, how many form the sequence"
                          "-parallel axis (ring attention + frame-domain "
                          "sharding); must divide --mesh-devices")
+    ap.add_argument("--model-parallel", type=int, default=1,
+                    help="of the mesh devices, how many form the tensor"
+                         "-parallel axis (HiFi-GAN decoder channels "
+                         "sharded across chips); seq-parallel * "
+                         "model-parallel must divide --mesh-devices")
     ap.add_argument("--prewarm", action="store_true",
                     help="compile each preloaded voice's common "
                          "executables (batch buckets, neighbor frame "
@@ -419,9 +424,11 @@ def main(argv=None) -> int:
     if args.mesh_devices:
         from ..parallel import make_mesh
 
-        mesh = make_mesh(args.mesh_devices, seq_parallel=args.seq_parallel)
-    elif args.seq_parallel > 1:
-        ap.error("--seq-parallel requires --mesh-devices")
+        mesh = make_mesh(args.mesh_devices,
+                         seq_parallel=args.seq_parallel,
+                         model_parallel=args.model_parallel)
+    elif args.seq_parallel > 1 or args.model_parallel > 1:
+        ap.error("--seq-parallel/--model-parallel require --mesh-devices")
 
     server, port = create_server(args.port, host=args.host, mesh=mesh,
                                  continuous_batching=args.continuous_batching)

@@ -679,12 +679,18 @@ class PiperVoice(BaseModel):
             return jax.jit(run)
         import inspect
 
-        from ..parallel.mesh import data_sharding, replicated
+        from ..parallel.mesh import (
+            data_sharding, param_shardings, replicated)
 
         ds, rep = data_sharding(self.mesh), replicated(self.mesh)
+        # arg 0 is always the params pytree: its per-leaf shardings carry
+        # the tensor-parallel decoder annotations (model axis); plain
+        # replication when model_parallel == 1
+        ps = param_shardings(self.mesh, self.params)
         n_args = len(inspect.signature(run).parameters)
-        in_shardings = tuple(ds if i in batch_args else rep
-                             for i in range(n_args))
+        in_shardings = tuple(
+            ps if i == 0 else (ds if i in batch_args else rep)
+            for i in range(n_args))
         return jax.jit(run, in_shardings=in_shardings, out_shardings=ds)
 
     def _encode_fn(self, b: int, t: int):

@@ -293,6 +293,14 @@ def _use_seq_parallel(mesh, frames: int, hp: VitsHyperParams) -> bool:
     largest receptive-field reach — derived from hp, not hard-coded)."""
     if mesh is None:
         return False
+    if mesh.shape.get("model", 1) > 1:
+        # tensor parallelism owns the flow/decoder when the model axis is
+        # active: the sp shard_maps take params with replicated in_specs,
+        # which would force an all-gather of the model-sharded decoder
+        # weights on every dispatch and then compute the full channel
+        # range redundantly on each tp chip — worse than either axis
+        # alone.  Ring attention (text domain) still rides the seq axis.
+        return False
     seq = mesh.shape.get("seq", 1)
     if seq <= 1 or frames % seq:
         return False

@@ -5,10 +5,12 @@ the reference's rayon thread fan-out, SURVEY §2.4/§5)."""
 from . import checkpoint
 from .mesh import (
     DATA_AXIS,
+    MODEL_AXIS,
     SEQ_AXIS,
     data_sharding,
     initialize_distributed,
     make_mesh,
+    param_shardings,
     replicated,
 )
 from .ring import ring_attention, ring_attention_sharded
@@ -16,10 +18,12 @@ from .ring import ring_attention, ring_attention_sharded
 __all__ = [
     "checkpoint",
     "DATA_AXIS",
+    "MODEL_AXIS",
     "SEQ_AXIS",
     "data_sharding",
     "initialize_distributed",
     "make_mesh",
+    "param_shardings",
     "replicated",
     "ring_attention",
     "ring_attention_sharded",

@@ -4,10 +4,16 @@ The reference's entire parallelism story is host threads (rayon fan-out over
 sentences, SURVEY §2.4); its distributed story is "none" (§5).  Here the
 equivalent axes are real hardware axes:
 
-- ``data`` — sentence batches sharded across chips over ICI (the TPU
+- ``data``  — sentence batches sharded across chips over ICI (the TPU
   counterpart of the rayon ``par_iter``),
-- ``seq``  — sequence (context) parallelism for long inputs via ring
-  attention (:mod:`.ring`).
+- ``seq``   — sequence (context) parallelism for long inputs via ring
+  attention (:mod:`.ring`),
+- ``model`` — tensor parallelism: the HiFi-GAN decoder's channel
+  dimension (where the synthesis FLOPs live) shards across chips; the
+  conv output-channel annotations below let XLA's SPMD partitioner
+  run each upsampling stage as a channel-split matmul on every chip
+  and insert the all-reduces only where channels mix back down
+  (conv_post).
 
 Multi-host: ``initialize_distributed`` wraps ``jax.distributed.initialize``
 so a pod slice forms one mesh; batches ride ICI inside a slice and DCN
@@ -28,15 +34,18 @@ log = logging.getLogger("sonata.parallel")
 
 DATA_AXIS = "data"
 SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
 
 
 def make_mesh(n_devices: Optional[int] = None, *,
               seq_parallel: int = 1,
+              model_parallel: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
-    """Build a ``(data, seq)`` mesh over the first ``n_devices`` devices.
+    """Build a ``(data, seq, model)`` mesh over ``n_devices`` devices.
 
-    ``seq_parallel`` splits the device pool between batch parallelism and
-    sequence parallelism; 1 means a pure data mesh.
+    ``seq_parallel`` and ``model_parallel`` split the device pool
+    between batch, sequence, and tensor parallelism; both default to 1
+    (a pure data mesh).
     """
     devs = list(devices if devices is not None else jax.devices())
     if n_devices is not None:
@@ -46,11 +55,14 @@ def make_mesh(n_devices: Optional[int] = None, *,
                 f"{len(devs)} devices are available")
         devs = devs[:n_devices]
     n = len(devs)
-    if n % seq_parallel != 0:
+    inner = seq_parallel * model_parallel
+    if n % inner != 0:
         raise ValueError(
-            f"{n} devices not divisible by seq_parallel={seq_parallel}")
-    grid = np.array(devs).reshape(n // seq_parallel, seq_parallel)
-    return Mesh(grid, (DATA_AXIS, SEQ_AXIS))
+            f"{n} devices not divisible by seq_parallel={seq_parallel} "
+            f"* model_parallel={model_parallel}")
+    grid = np.array(devs).reshape(n // inner, seq_parallel,
+                                  model_parallel)
+    return Mesh(grid, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
 
 
 def data_sharding(mesh: Mesh) -> NamedSharding:
@@ -60,6 +72,55 @@ def data_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def param_shardings(mesh: Mesh, params) -> "object":
+    """Per-leaf shardings for the VITS params pytree: tensor-parallel
+    decoder, replicated everything else.
+
+    The HiFi-GAN decoder dominates synthesis FLOPs; its conv kernels are
+    ``[k, Cin, Cout]`` (transposed-conv ups included) and its biases
+    ``[Cout]``.  The annotation follows the Megatron column/row pairing
+    where the graph allows it: ``conv_pre``/``ups`` and each resblock's
+    ``convs1`` shard their output channels (column), each resblock's
+    ``convs2`` shards its input channels (row) so the pair needs one
+    partial-sum reduce instead of an activation re-shard per conv.
+    Around the residual adds and stage boundaries XLA's SPMD partitioner
+    inserts whatever reshard the propagation demands — the collective
+    schedule is the compiler's, these annotations only express where the
+    channel parallelism lives.  With ``model_parallel == 1`` the result
+    is the plain replicated tree.
+    """
+    import jax.tree_util as jtu
+
+    if mesh.shape.get(MODEL_AXIS, 1) <= 1:
+        rep = replicated(mesh)
+        return jtu.tree_map(lambda _: rep, params)
+    rep = replicated(mesh)
+    col = NamedSharding(mesh, P(None, None, MODEL_AXIS))
+    row = NamedSharding(mesh, P(None, MODEL_AXIS, None))
+    bias = NamedSharding(mesh, P(MODEL_AXIS))
+    tp = mesh.shape[MODEL_AXIS]
+
+    def leaf_sharding(path, leaf):
+        keys = [getattr(p, "key", getattr(p, "idx", None)) for p in path]
+        if "dec" not in keys or "conv_post" in keys:
+            return rep
+        name = keys[-1]
+        ndim = getattr(leaf, "ndim", 0)
+        if "convs2" in keys:
+            # row-parallel half of the Megatron pair: contract over the
+            # sharded Cin that convs1 produced
+            if name == "w" and ndim == 3 and leaf.shape[1] % tp == 0:
+                return row
+            return rep  # bias adds after the reduce: replicated
+        if name == "w" and ndim == 3 and leaf.shape[2] % tp == 0:
+            return col
+        if name == "b" and ndim == 1 and leaf.shape[0] % tp == 0:
+            return bias
+        return rep
+
+    return jtu.tree_map_with_path(leaf_sharding, params)
 
 
 def initialize_distributed(coordinator_address: Optional[str] = None,

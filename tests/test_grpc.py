@@ -314,7 +314,12 @@ def test_server_main_mesh_flags(monkeypatch):
     monkeypatch.setattr(gs, "create_server", fake_create)
     gs.main(["--mesh-devices", "8", "--seq-parallel", "2"])
     assert captured["mesh"] is not None
-    assert dict(captured["mesh"].shape) == {"data": 4, "seq": 2}
+    assert dict(captured["mesh"].shape) == {"data": 4, "seq": 2,
+                                            "model": 1}
+    gs.main(["--mesh-devices", "8", "--seq-parallel", "2",
+             "--model-parallel", "2"])
+    assert dict(captured["mesh"].shape) == {"data": 2, "seq": 2,
+                                            "model": 2}
 
 
 def test_unload_voice(server_and_voice, tmp_path):
