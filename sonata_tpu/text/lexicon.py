@@ -502,6 +502,46 @@ BASE_WORDS = {
     "gradient": "ɡɹˈeɪdiənt", "inference": "ˈɪnfɚɹəns",
     "transformer": "tɹænsfˈɔːɹmɚ", "attention": "ətˈɛnʃən",
     "embedding": "ɛmbˈɛdɪŋ", "softmax": "sˈɔːftmæks",
+    # everyday-core gaps surfaced by a 900-word frequency sweep (round 4)
+    "act": "ækt", "actually": "ˈæktʃuəli", "age": "eɪdʒ",
+    "almost": "ˈɔːlmoʊst", "alone": "əlˈoʊn", "already": "ɔːlɹˈɛdi",
+    "annoy": "ənˈɔɪ", "apart": "əpˈɑːɹt", "asleep": "əslˈiːp",
+    "awake": "əwˈeɪk", "away": "əwˈeɪ", "bath": "bæθ",
+    "beauty": "bjˈuːɾi", "bench": "bɛntʃ", "bite": "baɪt",
+    "born": "bɔːɹn", "brave": "bɹeɪv", "cap": "kæp",
+    "castle": "kˈæsəl", "character": "kˈɛɹəktɚ", "clever": "klˈɛvɚ",
+    "cotton": "kˈɑːtən", "crack": "kɹæk", "cream": "kɹiːm",
+    "crown": "kɹaʊn", "dear": "dɪɹ", "direct": "dɚɹˈɛkt",
+    "dollar": "dˈɑːlɚ", "eager": "ˈiːɡɚ", "either": "ˈiːðɚ",
+    "even": "ˈiːvən", "excite": "ɪksˈaɪt", "express": "ɪkspɹˈɛs",
+    "fair": "fɛɹ", "fancy": "fˈænsi", "far": "fɑːɹ", "fat": "fæt",
+    "feed": "fiːd", "fence": "fɛns", "fix": "fɪks", "flag": "flæɡ",
+    "forward": "fˈɔːɹwɚd", "fun": "fʌn", "gate": "ɡeɪt",
+    "gentle": "dʒˈɛntəl", "glad": "ɡlæd", "goes": "ɡoʊz",
+    "hall": "hɔːl", "hang": "hæŋ", "hole": "hoʊl", "huge": "hjuːdʒ",
+    "human": "hjˈuːmən", "hunt": "hʌnt", "hurry": "hˈɜːɹi",
+    "inch": "ɪntʃ", "indeed": "ɪndˈiːd", "kick": "kɪk", "kiss": "kɪs",
+    "knock": "nɑːk", "lack": "læk", "lady": "lˈeɪdi", "lay": "leɪ",
+    "lift": "lɪft", "lot": "lɑːt", "mad": "mæd", "mail": "meɪl",
+    "mark": "mɑːɹk", "marry": "mˈɛɹi", "matter": "mˈæɾɚ",
+    "mean": "miːn", "mile": "maɪl", "mine": "maɪn", "miss": "mɪs",
+    "mount": "maʊnt", "near": "nɪɹ", "nest": "nɛst", "none": "nʌn",
+    "object": "ˈɑːbdʒɛkt", "ought": "ɔːt", "plain": "pleɪn",
+    "pool": "puːl", "pride": "pɹaɪd", "probable": "pɹˈɑːbəbəl",
+    "proper": "pɹˈɑːpɚ", "put": "pʊt", "ran": "ɹæn", "rise": "ɹaɪz",
+    "roll": "ɹoʊl", "rub": "ɹʌb", "rush": "ɹʌʃ", "sail": "seɪl",
+    "seat": "siːt", "sense": "sɛns", "shade": "ʃeɪd",
+    "shake": "ʃeɪk", "shine": "ʃaɪn", "shore": "ʃɔːɹ",
+    "sight": "saɪt", "slip": "slɪp", "smoke": "smoʊk",
+    "spell": "spɛl", "spot": "spɑːt", "spread": "spɹɛd",
+    "steel": "stiːl", "stick": "stɪk", "still": "stɪl",
+    "stretch": "stɹɛtʃ", "sudden": "sˈʌdən", "tail": "teɪl",
+    "tear": "tɪɹ", "those": "ðoʊz", "thus": "ðʌs", "tie": "taɪ",
+    "till": "tɪl", "tiny": "tˈaɪni", "together": "təɡˈɛðɚ",
+    "tonight": "tənˈaɪt", "usual": "jˈuːʒuəl", "view": "vjuː",
+    "well": "wɛl", "wild": "waɪld", "wise": "waɪz",
+    "wonder": "wˈʌndɚ", "wood": "wʊd", "worry": "wˈɜːɹi",
+    "worth": "wɜːθ", "yard": "jɑːɹd", "yet": "jɛt",
 }
 # fmt: on
 
