@@ -55,6 +55,20 @@ def test_tensor_parallel_param_shardings():
                jtu.tree_leaves(sh["dp"]))
 
 
+def test_tensor_parallel_streaming_matches_unsharded():
+    """Streaming (stage coalescer + window decoders) on a dp+sp+tp mesh
+    produces the same audio as a single device."""
+    mesh = make_mesh(8, seq_parallel=2, model_parallel=2)
+    v0 = tiny_voice(seed=32)
+    vm = PiperVoice(v0.config, v0.params, seed=32, mesh=mesh)
+    text = "wˈʌn tuː θɹiː fˈoːɹ."
+    plain = np.concatenate(
+        [c.samples.data for c in v0.stream_synthesis(text, 12, 2)])
+    tp = np.concatenate(
+        [c.samples.data for c in vm.stream_synthesis(text, 12, 2)])
+    assert np.allclose(plain, tp, atol=2e-4)
+
+
 def test_tensor_parallel_batch_matches_unsharded():
     """dp+sp+tp 3-axis mesh produces the same audio as a single device
     (the TP all-reduces are numerically transparent at f32 tolerance)."""
