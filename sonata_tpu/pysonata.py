@@ -36,7 +36,8 @@ from .synth import AudioOutputConfig, SpeechSynthesizer
 __all__ = [
     "Sonata", "PiperModel", "PiperScales", "AudioOutputConfig",
     "WaveSamples", "LazySpeechStream", "ParallelSpeechStream",
-    "RealtimeSpeechStream", "phonemize_text", "SonataError",
+    "RealtimeSpeechStream", "phonemize_text", "supported_languages",
+    "SonataError",
 ]
 
 # python frontend defaults (lib.rs:379-380)
@@ -199,6 +200,15 @@ class Sonata:
                            audio_output_config: Optional[AudioOutputConfig]
                            = None) -> None:
         self._synth.synthesize_to_file(path, text, audio_output_config)
+
+
+def supported_languages() -> tuple[str, ...]:
+    """Language codes the hermetic G2P backend can phonemize (the
+    eSpeak backend, when libespeak-ng is installed, covers ~100 more);
+    see ``docs/LANGUAGES.md`` for each pack's conventions."""
+    from .text.rule_g2p import supported_languages as _sl
+
+    return _sl()
 
 
 def phonemize_text(text: str, language: str = "en-us",

@@ -76,3 +76,15 @@ def test_free_phonemize_text():
     with_sep = pysonata.phonemize_text("chez", language="en",
                                        separator="|")
     assert "|" in with_sep[0]
+
+
+def test_supported_languages():
+    langs = pysonata.supported_languages()
+    assert len(langs) >= 40
+    for code in ("en", "de", "ru", "vi", "sw", "ar"):
+        assert code in langs
+    # every listed code phonemizes the universal greeting "hello" (its
+    # letters/words may be odd per language, but no pack may raise)
+    for code in langs:
+        out = pysonata.phonemize_text("hello", language=code)
+        assert isinstance(out, list), code
