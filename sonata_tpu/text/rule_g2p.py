@@ -354,8 +354,11 @@ def _lazy(module: str, fn: str):
 _LANGUAGES: dict[str, tuple] = {
     "en": (normalize_text, english_word_to_ipa),
     "ar": (normalize_text, arabic_word_to_ipa),
-    "fa": (normalize_text, arabic_word_to_ipa),  # Arabic-script letter map
-    "ur": (normalize_text, arabic_word_to_ipa),
+    "fa": (_lazy("rule_g2p_fa", "normalize_text"),
+           _lazy("rule_g2p_fa", "word_to_ipa")),
+    "ur": (_lazy("rule_g2p_fa", "normalize_text_ur"),  # shared script
+           _lazy("rule_g2p_fa", "word_to_ipa_ur")),    # pack, Urdu
+                                                       # numerals
     "de": (_lazy("rule_g2p_de", "normalize_text"),
            _lazy("rule_g2p_de", "word_to_ipa")),
     "es": (_lazy("rule_g2p_es", "normalize_text"),

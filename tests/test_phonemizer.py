@@ -1238,6 +1238,35 @@ def test_kk_lb_numbers():
     assert vin(105) == "một trăm lẻ năm"  # lẻ + lăm
 
 
+def test_persian_urdu_pack():
+    """fa/ur get their own script pack (پ چ ژ گ, Persian letter values,
+    epenthetic vowels over the unwritten-vowel gap, vocalic و/ی) instead
+    of the bare Arabic letter map."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+    from sonata_tpu.text.rule_g2p_fa import (
+        number_to_words, word_to_ipa, word_to_ipa_ur)
+
+    assert word_to_ipa("سلام") == "selɒːm"     # initial-cluster break
+    assert word_to_ipa("کتاب") == "ketɒːb"
+    assert word_to_ipa("ممنون") == "memnuːn"   # و between consonants
+    assert word_to_ipa("فارسی") == "fɒːrsiː"   # final vocalic ی
+    assert word_to_ipa("ایران") == "iːrɒːn"    # initial ای
+    assert word_to_ipa("خانه") == "xɒːne"      # final ه → e
+    assert word_to_ipa("پدر") == "peder"       # sonorant-final break
+    assert word_to_ipa("ژاله").startswith("ʒ")  # Persian-only letter
+    assert word_to_ipa_ur("ٹھیک") == "ʈʰiːk"   # retroflex + aspiration
+    assert word_to_ipa_ur("لڑکا") == "leɽkaː"  # ڑ
+    assert word_to_ipa_ur("ہاں") == "haː̃"      # ghunna nasalizes vowel
+    assert word_to_ipa_ur("میں") == "miː̃"      # nasal survives ی → iː
+    assert word_to_ipa("باْر") == "bɒːr"        # sukun never crashes
+    assert phonemize_clause("23", voice="ur") == "biːs tiːn"  # ur nums
+    assert number_to_words(23) == "بیست و سه"
+    assert phonemize_clause("سلام دنیا، خیلی ممنون", voice="fa") == \
+        "selɒːm denjɒː xiːliː memnuːn"
+    assert phonemize_clause("۲۳ کتاب", voice="fa") == \
+        "biːst uː se ketɒːb"  # Persian digits expand
+
+
 def test_unsupported_language_raises():
     import pytest
 
