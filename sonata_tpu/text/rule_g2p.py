@@ -432,6 +432,8 @@ _LANGUAGES: dict[str, tuple] = {
            _lazy("rule_g2p_vi", "word_to_ipa")),
     "ne": (_lazy("rule_g2p_ne", "normalize_text"),
            _lazy("rule_g2p_ne", "word_to_ipa")),
+    "zh": (_lazy("rule_g2p_zh", "normalize_text"),  # pinyin input;
+           _lazy("rule_g2p_zh", "word_to_ipa")),    # hanzi raises
 }
 
 #: Env var: set to "1" to let unsupported languages fall back to English

@@ -1267,14 +1267,45 @@ def test_persian_urdu_pack():
         "biːst uː se ketɒːb"  # Persian digits expand
 
 
+def test_mandarin_pinyin_pack():
+    """zh accepts pinyin (diacritics or tone digits) and renders broad
+    Mandarin IPA with Chao tone letters; hanzi raises a clear error
+    (pronunciation needs the dictionary eSpeak carries)."""
+    import pytest
+
+    from sonata_tpu.core import PhonemizationError
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+    from sonata_tpu.text.rule_g2p_zh import number_to_words, word_to_ipa
+
+    assert word_to_ipa("nǐ") == "ni˨˩˦"
+    assert word_to_ipa("hao3") == "xau˨˩˦"      # tone digits too
+    assert word_to_ipa("zhōng") == "ʈʂʊŋ˥"      # retroflex series
+    assert word_to_ipa("shì") == "ʂɨ˥˩"         # apical vowel
+    assert word_to_ipa("xuéxí") == "ɕɥɛ˧˥ɕi˧˥"  # ü after palatal
+    assert word_to_ipa("yuè") == "ɥɛ˥˩"         # yu- spelling
+    assert word_to_ipa("ni3hao3") == "ni˨˩˦xau˨˩˦"  # digit-run split
+    assert number_to_words(105) == "yī bǎi líng wǔ"
+    assert number_to_words(111) == "yī bǎi yī shí yī"   # mid-number teen
+    assert number_to_words(10050) == "yī wàn líng wǔ shí"  # wàn gap
+    assert word_to_ipa("bcd") == ""  # a bare initial is not a syllable
+    import unicodedata
+
+    assert word_to_ipa(unicodedata.normalize("NFD", "zhuāngshì")) == \
+        "ʈʂwaŋ˥ʂɨ˥˩"  # NFD input parses identically
+    assert phonemize_clause("nǐ hǎo shì jiè", voice="zh") == \
+        "ni˨˩˦ xau˨˩˦ ʂɨ˥˩ tɕjɛ˥˩"
+    with pytest.raises(PhonemizationError, match="hanzi"):
+        phonemize_clause("你好世界", voice="zh")
+
+
 def test_unsupported_language_raises():
     import pytest
 
     from sonata_tpu.core import PhonemizationError
     from sonata_tpu.text.rule_g2p import phonemize_clause
 
-    with pytest.raises(PhonemizationError, match="no rules for language 'zh'"):
-        phonemize_clause("你好世界", voice="zh")
+    with pytest.raises(PhonemizationError, match="no rules for language 'ja'"):
+        phonemize_clause("こんにちは", voice="ja")
 
 
 def test_unsupported_language_best_effort_env(monkeypatch):
@@ -1282,7 +1313,7 @@ def test_unsupported_language_best_effort_env(monkeypatch):
 
     monkeypatch.setenv(BEST_EFFORT_ENV, "1")
     # explicit opt-in: falls back to English letter-to-sound, no raise
-    assert phonemize_clause("nihao", voice="zh")
+    assert phonemize_clause("konnichiwa", voice="ja")
 
 
 def test_language_number_expansion():
