@@ -31,6 +31,11 @@ _STRESS: dict[str, int] = {
     "свобода": 2, "природа": 2, "минута": 2, "машина": 2,
     "вода": 2, "рука": 2, "нога": 2, "глаза": 2, "окно": 2,
     "объект": 2, "земля": 2, "вопрос": 2, "ответ": 2, "россия": 2,
+    "москва": 2, "страна": 2, "музыка": 1, "история": 2,
+    "математика": 3, "университет": 5, "метро": 2, "улица": 1,
+    "театр": 2, "музей": 2, "поезд": 1, "площадь": 1,
+    "столица": 2, "литература": 4, "библиотека": 4,
+    "интернет": 3, "институт": 3, "совет": 2, "момент": 2,
 }
 
 _PLAIN = {"а": "a", "о": "o", "у": "u", "ы": "ɨ", "э": "e"}
@@ -120,6 +125,18 @@ def word_to_ipa(word: str) -> str:
     stress_pos = _STRESS.get(orig)
     if stress_pos is not None:
         target_n = min(stress_pos - 1, len(nuclei) - 1)
+    elif "ё" in orig:
+        # ё is ALWAYS the stressed vowel in Russian orthography
+        target_n = sum(1 for ch in orig[:orig.index("ё")]
+                       if ch in "аеёиоуыэюя")
+        target_n = min(target_n, len(nuclei) - 1)
+    elif orig.endswith(("он", "ин", "ан")) and len(nuclei) >= 3 and \
+            not orig.endswith(("ован", "исан", "азан", "иван")):
+        # polysyllabic loanword nouns with these codas lean final
+        # (телефон, магазин, ресторан); -ет/-ут/-ал are left out (verb
+        # inflections: будет, работал), and the passive-participle
+        # endings -ован/-исан/-азан/-иван are excluded too (напИсан)
+        target_n = len(nuclei) - 1
     elif word.endswith("дцать"):
         target_n = len(nuclei) - 2  # the -дцать numerals stay penult
     elif word.endswith(("ть", "л", "ла", "ло", "ли")) and \

@@ -824,6 +824,9 @@ def test_russian_phenomena():
     assert word_to_ipa("большой") == "balʲˈʃoj"  # -ой ending stress
     assert word_to_ipa("нового") == "naˈvova"    # genitive г → [v]
     assert word_to_ipa("что") == "ʃto"           # spelling exception
+    assert word_to_ipa("самолёт") == "samaˈlʲot"  # ё is always stressed
+    assert word_to_ipa("телефон") == "tʲɪlʲɪˈfon"  # loanword -он final
+    assert word_to_ipa("будет") == "ˈbudʲɪt"     # verbs stay penult
 
 
 def test_russian_number_expansion():
