@@ -237,8 +237,16 @@ class PiperVoice(BaseModel):
             self._synth_config = config.copy()
 
     def phonemize_text(self, text: str) -> Phonemes:
-        # Arabic: diacritize first (piper/src/lib.rs:253-258,270-281)
+        # Arabic: diacritize first (piper/src/lib.rs:253-258,270-281).
+        # Digits expand to MSA number words BEFORE diacritization so the
+        # inserted words receive harakat like any other Arabic word —
+        # expanding after (in the normalizer) would feed the letter map
+        # vowel-less consonant skeletons.
         if self._tashkeel is not None:
+            from ..text.rule_g2p import (
+                arabic_number_to_words, expand_numbers)
+
+            text = expand_numbers(text, arabic_number_to_words)
             text = self._tashkeel.diacritize(text)
         return text_to_phonemes(
             text, voice=self.config.espeak_voice,

@@ -297,6 +297,64 @@ def arabic_word_to_ipa(word: str) -> str:
     return "".join(_ARABIC.get(ch, "") for ch in word)
 
 
+_AR_ONES = ["صفر", "واحد", "اثنان", "ثلاثة", "أربعة", "خمسة", "ستة",
+            "سبعة", "ثمانية", "تسعة", "عشرة"]
+_AR_TENS = ["", "عشرة", "عشرون", "ثلاثون", "أربعون", "خمسون",
+            "ستون", "سبعون", "ثمانون", "تسعون"]
+_AR_HUNDREDS = ["", "مئة", "مئتان", "ثلاثمئة", "أربعمئة", "خمسمئة",
+                "ستمئة", "سبعمئة", "ثمانمئة", "تسعمئة"]
+
+
+def arabic_number_to_words(num: int) -> str:
+    """MSA numerals: ones before tens joined with و (ثلاثة وعشرون)."""
+    if num < 0:
+        return "سالب " + arabic_number_to_words(-num)
+    if num <= 10:
+        return _AR_ONES[num]
+    if num < 20:
+        o = num - 10
+        head = "أحد" if o == 1 else ("اثنا" if o == 2 else _AR_ONES[o])
+        return head + " عشر"
+    if num < 100:
+        t, o = divmod(num, 10)
+        if o == 0:
+            return _AR_TENS[t]
+        return _AR_ONES[o] + " و" + _AR_TENS[t]
+    if num < 1000:
+        h, r = divmod(num, 100)
+        head = _AR_HUNDREDS[h]
+        return head + (" و" + arabic_number_to_words(r) if r else "")
+    if num < 1_000_000:
+        k, r = divmod(num, 1000)
+        if k == 1:
+            head = "ألف"
+        elif k == 2:
+            head = "ألفان"
+        elif k <= 10:
+            head = _AR_ONES[k] + " آلاف"
+        else:
+            head = arabic_number_to_words(k) + " ألف"
+        return head + (" و" + arabic_number_to_words(r) if r else "")
+    m, r = divmod(num, 1_000_000)
+    if m == 1:
+        head = "مليون"
+    elif m == 2:
+        head = "مليونان"  # dual, like ألفان
+    elif m <= 10:
+        head = _AR_ONES[m] + " ملايين"  # 3-10 plural
+    else:
+        head = arabic_number_to_words(m) + " مليون"
+    return head + (" و" + arabic_number_to_words(r) if r else "")
+
+
+def normalize_text_ar(text: str) -> str:
+    """Arabic normalizer: digits (ASCII or Arabic-Indic — \\d matches
+    any Unicode Nd and int() parses them) become MSA number words; the
+    generic English expansion fed the Arabic letter map English words,
+    which mapped to silence."""
+    return expand_numbers(text, arabic_number_to_words).lower()
+
+
 def place_stress(units: list, flags: list, target: int, *,
                  liquids: tuple = ("r", "l"),
                  stops: tuple = tuple("pbtdkɡfv"),
@@ -353,7 +411,7 @@ def _lazy(module: str, fn: str):
 # letter-to-sound rules (which produces confidently wrong phonemes).
 _LANGUAGES: dict[str, tuple] = {
     "en": (normalize_text, english_word_to_ipa),
-    "ar": (normalize_text, arabic_word_to_ipa),
+    "ar": (normalize_text_ar, arabic_word_to_ipa),
     "fa": (_lazy("rule_g2p_fa", "normalize_text"),
            _lazy("rule_g2p_fa", "word_to_ipa")),
     "ur": (_lazy("rule_g2p_fa", "normalize_text_ur"),  # shared script

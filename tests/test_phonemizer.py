@@ -1301,6 +1301,33 @@ def test_mandarin_pinyin_pack():
         phonemize_clause("你好世界", voice="zh")
 
 
+def test_arabic_numbers_get_diacritized():
+    """In the ar voice path, digits expand to MSA number words BEFORE
+    the tashkeel stage, so they carry short vowels like any other word
+    (the post-normalizer expansion gave vowel-less skeletons)."""
+    from tests.voices import tiny_voice
+
+    v = tiny_voice(seed=19, espeak={"voice": "ar"})
+    ipa = v.phonemize_text("٢٣")[0]
+    # θalaaːθaa waʕaʃiruwn-style output: short vowels present
+    assert "a" in ipa.replace("aː", "") and "θ" in ipa
+    assert not any(c.isdigit() for c in ipa)
+
+
+def test_every_language_expands_digits():
+    """Every registered language renders digit input through its OWN
+    number grammar: output is non-empty IPA with no digits left, for a
+    set of shapes that exercise teens/hundreds/thousands."""
+    from sonata_tpu.text.rule_g2p import (
+        phonemize_clause, supported_languages)
+
+    for code in supported_languages():
+        for num in ("7", "15", "23", "105", "1984"):
+            out = phonemize_clause(num, voice=code)
+            assert out, (code, num)
+            assert not any(c.isdigit() for c in out), (code, num, out)
+
+
 def test_unsupported_language_raises():
     import pytest
 
