@@ -152,6 +152,36 @@ def number_to_words(n: int) -> str:
     return number_to_words(m) + " million" + (" " + number_to_words(r) if r else "")
 
 
+def south_asian_number_words(num: int, *, ones: list, tens: dict,
+                             hundred: str, thousand: str, lakh: str,
+                             minus: str) -> str:
+    """Shared analytic numeral skeleton for the lakh-system languages
+    (Nepali, Hindi): exact 0-20, tens + ones, hundreds, thousands,
+    lakhs.  Real usage fuses 21-99 irregularly — that needs the
+    dictionaries eSpeak carries; analytic stays intelligible."""
+    def words(n: int) -> str:
+        if n <= 20:
+            return ones[n]
+        if n < 100:
+            t, o = divmod(n, 10)
+            return tens[t] + (" " + ones[o] if o else "")
+        if n < 1000:
+            h, r = divmod(n, 100)
+            head = ones[h] + " " + hundred
+            return head + (" " + words(r) if r else "")
+        if n < 100_000:
+            k, r = divmod(n, 1000)
+            head = words(k) + " " + thousand
+            return head + (" " + words(r) if r else "")
+        lk, r = divmod(n, 100_000)
+        head = words(lk) + " " + lakh
+        return head + (" " + words(r) if r else "")
+
+    if num < 0:
+        return minus + " " + words(-num)
+    return words(num)
+
+
 def expand_numbers(text: str, number_words) -> str:
     """Replace integer literals with ``number_words(n)`` renderings —
     shared by every language pack's normalizer."""
@@ -492,6 +522,10 @@ _LANGUAGES: dict[str, tuple] = {
            _lazy("rule_g2p_ne", "word_to_ipa")),
     "zh": (_lazy("rule_g2p_zh", "normalize_text"),  # pinyin input;
            _lazy("rule_g2p_zh", "word_to_ipa")),    # hanzi raises
+    "ko": (_lazy("rule_g2p_ko", "normalize_text"),
+           _lazy("rule_g2p_ko", "word_to_ipa")),
+    "hi": (_lazy("rule_g2p_hi", "normalize_text"),  # Devanagari via
+           _lazy("rule_g2p_hi", "word_to_ipa")),    # the ne machinery
 }
 
 #: Env var: set to "1" to let unsupported languages fall back to English

@@ -29,6 +29,10 @@ _CONS = {"à¤•": "k", "à¤–": "kÊ°", "à¤—": "É¡", "à¤˜": "É¡Ê±", "à¤™": "Å‹",
          "à¤¯": "j", "à¤°": "r", "à¤²": "l", "à¤µ": "w", "à¤¶": "s",
          "à¤·": "s", "à¤¸": "s", "à¤¹": "É¦"}
 _VIRAMA = "à¥"
+_NUKTA = "à¤¼"
+# nukta letters carry Perso-Arabic loan values (à¤œà¤¼ â†’ z, à¤«à¤¼ â†’ f â€¦)
+_NUKTA_CONS = {"à¤œ": "z", "à¤«": "f", "à¤•": "q", "à¤–": "x", "à¤—": "É£",
+               "à¤¡": "É½", "à¤¢": "É½Ê±"}
 _ANUSVARA = "à¤‚"
 _CANDRABINDU = "à¤"
 _VISARGA = "à¤ƒ"
@@ -55,8 +59,15 @@ def _scan(word: str) -> tuple[list[str], list[bool]]:
             continue
         c = _CONS.get(ch)
         if c is not None:
-            emit(c)
             nxt = chars[i + 1] if i + 1 < n else ""
+            if nxt == _NUKTA:
+                # nukta letters (Perso-Arabic loan sounds): swap the
+                # consonant value and keep scanning from the char AFTER
+                # the nukta so the following matra still applies
+                c = _NUKTA_CONS.get(ch, c)
+                i += 1
+                nxt = chars[i + 1] if i + 1 < n else ""
+            emit(c)
             if nxt in _MATRAS:
                 emit(_MATRAS[nxt], True)
                 i += 2
@@ -110,29 +121,16 @@ _ONES = ["à¤¶à¥‚à¤¨à¥à¤¯", "à¤à¤•", "à¤¦à¥à¤ˆ", "à¤¤à¥€à¤¨", "à¤šà¤¾à¤°", "à¤
          "à¤¸à¥‹à¤¹à¥à¤°", "à¤¸à¤¤à¥à¤°", "à¤…à¤ à¤¾à¤°", "à¤‰à¤¨à¥à¤¨à¤¾à¤‡à¤¸", "à¤¬à¥€à¤¸"]
 
 
+_TENS = {2: "à¤¬à¥€à¤¸", 3: "à¤¤à¥€à¤¸", 4: "à¤šà¤¾à¤²à¥€à¤¸", 5: "à¤ªà¤šà¤¾à¤¸",
+         6: "à¤¸à¤¾à¤ à¥€", 7: "à¤¸à¤¤à¥à¤¤à¤°à¥€", 8: "à¤…à¤¸à¥€", 9: "à¤¨à¤¬à¥à¤¬à¥‡"}
+
+
 def number_to_words(num: int) -> str:
-    if num < 0:
-        return "à¤®à¤¾à¤‡à¤¨à¤¸ " + number_to_words(-num)
-    if num <= 20:
-        return _ONES[num]
-    if num < 100:
-        # Nepali tens-units fuse irregularly; a regular analytic
-        # rendering stays intelligible: à¤¤à¥€à¤¸, à¤šà¤¾à¤²à¥€à¤¸â€¦ + digit
-        t, o = divmod(num, 10)
-        tens = {2: "à¤¬à¥€à¤¸", 3: "à¤¤à¥€à¤¸", 4: "à¤šà¤¾à¤²à¥€à¤¸", 5: "à¤ªà¤šà¤¾à¤¸",
-                6: "à¤¸à¤¾à¤ à¥€", 7: "à¤¸à¤¤à¥à¤¤à¤°à¥€", 8: "à¤…à¤¸à¥€", 9: "à¤¨à¤¬à¥à¤¬à¥‡"}[t]
-        return tens + (" " + _ONES[o] if o else "")
-    if num < 1000:
-        h, r = divmod(num, 100)
-        head = _ONES[h] + " à¤¸à¤¯"
-        return head + (" " + number_to_words(r) if r else "")
-    if num < 100_000:
-        k, r = divmod(num, 1000)
-        head = number_to_words(k) + " à¤¹à¤œà¤¾à¤°"
-        return head + (" " + number_to_words(r) if r else "")
-    lakh, r = divmod(num, 100_000)
-    head = number_to_words(lakh) + " à¤²à¤¾à¤–"  # South Asian lakh system
-    return head + (" " + number_to_words(r) if r else "")
+    from .rule_g2p import south_asian_number_words
+
+    return south_asian_number_words(
+        num, ones=_ONES, tens=_TENS, hundred="à¤¸à¤¯", thousand="à¤¹à¤œà¤¾à¤°",
+        lakh="à¤²à¤¾à¤–", minus="à¤®à¤¾à¤‡à¤¨à¤¸")
 
 
 def normalize_text(text: str) -> str:

@@ -1314,6 +1314,29 @@ def test_arabic_numbers_get_diacritized():
     assert not any(c.isdigit() for c in ipa)
 
 
+def test_korean_hindi_packs():
+    """Korean: algorithmic jamo decomposition with liaison and nasal
+    assimilation; Hindi: the Nepali Devanagari machinery with the ə
+    inherent vowel and Hindi numerals."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+    from sonata_tpu.text.rule_g2p_hi import word_to_ipa as hi
+    from sonata_tpu.text.rule_g2p_ko import number_to_words as kon
+    from sonata_tpu.text.rule_g2p_ko import word_to_ipa as ko
+
+    assert ko("안녕하세요") == "annjʌŋhasejo"
+    assert ko("감사합니다") == "kamsahamnita"   # ㅂ+ㄴ → m (assimilation)
+    assert ko("좋은") == "tɕohɯn"              # liaison over null onset
+    assert kon(1984) == "천구백팔십사"
+    assert hi("नमस्ते") == "ˈnəməste"           # ə inherent vowel
+    assert hi("दुनिया") == "ˈdunijaː"
+    assert hi("है") == "ɦɛː"                    # ऐ monophthongizes
+    assert hi("ज़रूरी") == "ˈzəruːriː"           # nukta ज़ → z + matra
+    assert phonemize_clause("23", voice="hi") == "biːs tiːn"
+    assert phonemize_clause("1000", voice="hi") == "ek ˈɦəzaːr"
+    assert phonemize_clause("23", voice="ko") == "isipsam"
+    assert kon(100_000_000) == "일억"            # 일 kept before 억
+
+
 def test_every_language_expands_digits():
     """Every registered language renders digit input through its OWN
     number grammar: output is non-empty IPA with no digits left, for a
