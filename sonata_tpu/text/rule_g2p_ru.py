@@ -18,6 +18,8 @@ applied AFTER stress assignment, final obstruent devoicing, and the
 
 from __future__ import annotations
 
+import re
+
 # stress positions (1-based nucleus index) for frequent words where the
 # penultimate default is wrong; eSpeak resolves these from ru_dict
 _STRESS: dict[str, int] = {
@@ -130,6 +132,14 @@ def word_to_ipa(word: str) -> str:
         target_n = sum(1 for ch in orig[:orig.index("ё")]
                        if ch in "аеёиоуыэюя")
         target_n = min(target_n, len(nuclei) - 1)
+    elif (m := re.search(
+            "ц(и(?:я|и|ю|ей|ям|ях|ями))$", orig)) and \
+            len(nuclei) >= 3:
+        # -ция nouns (any case form) stress the syllable before the
+        # suffix (инфорМАция, стАнциями): subtract the suffix's own
+        # vowel count, which varies by case (ия=2, иями=3)
+        sv = sum(1 for ch in m.group(1) if ch in "аеёиоуыэюя")
+        target_n = max(0, len(nuclei) - sv - 1)
     elif orig.endswith(("он", "ин", "ан")) and len(nuclei) >= 3 and \
             not orig.endswith(("ован", "исан", "азан", "иван")):
         # polysyllabic loanword nouns with these codas lean final

@@ -15,6 +15,8 @@ a non-palatalization separator (м'ята → mjata), and no akanie.
 
 from __future__ import annotations
 
+import re
+
 _STRESS: dict[str, int] = {
     "привіт": 2, "дякую": 1, "будь": 1, "ласка": 1, "добре": 1,
     "сьогодні": 2, "завтра": 1, "вчора": 1, "мова": 1, "країна": 2,
@@ -84,6 +86,13 @@ def word_to_ipa(word: str) -> str:
     stress_pos = _STRESS.get(word)
     if stress_pos is not None:
         target_n = min(stress_pos - 1, len(nuclei) - 1)
+    elif (m := re.search(
+            "ц(і(?:я|ї|ю|єю|ям|ях|ями))$", word)) and \
+            len(nuclei) >= 3:
+        # -ція nouns (any case form) stress the syllable before the
+        # suffix; the suffix vowel count varies by case (ія=2, ією=3)
+        sv = sum(1 for ch in m.group(1) if ch in "аеиіоуюяєї")
+        target_n = max(0, len(nuclei) - sv - 1)
     elif word.endswith(("ти", "ла", "ло", "ли")):
         target_n = len(nuclei) - 1  # verb endings lean final
     else:

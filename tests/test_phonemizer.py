@@ -827,6 +827,8 @@ def test_russian_phenomena():
     assert word_to_ipa("самолёт") == "samaˈlʲot"  # ё is always stressed
     assert word_to_ipa("телефон") == "tʲɪlʲɪˈfon"  # loanword -он final
     assert word_to_ipa("будет") == "ˈbudʲɪt"     # verbs stay penult
+    assert word_to_ipa("информация") == "infarˈmatsijɪ"  # -ция rule
+    assert word_to_ipa("станциями") == "ˈstantsijɪmʲi"  # oblique plural
 
 
 def test_russian_number_expansion():
@@ -1015,6 +1017,8 @@ def test_slavic_batch_phenomena():
     assert uk("м'ята") == "ˈmjata"       # apostrophe blocks softening
     assert uk("ґанок") == "ˈɡanok"       # ґ vs г
     assert uk("мова") == "ˈmoʋa"         # no akanie: о stays o
+    assert uk("інформація") == "inforˈmatsʲija"   # -ція rule
+    assert uk("інформацією") == "inforˈmatsʲijɛju"  # 3-vowel suffix
     assert bg("дъжд") == "dɤʃt"          # regressive final devoicing
     assert bg("къща") == "ˈkɤʃta"        # ъ → ɤ, щ → ʃt
 
