@@ -21,6 +21,8 @@ _STRESS: dict[str, int] = {
     "добър": 2, "голям": 2, "малък": 1, "хубав": 1, "вода": 2,
     "човек": 2, "жена": 2, "дете": 2, "книга": 1, "маса": 1,
     "щастие": 1, "ябълка": 1, "момче": 2, "момиче": 2,
+    "софия": 1, "луна": 2, "звезда": 2, "сърце": 2, "любов": 2,
+    "живот": 2, "народ": 2, "площад": 2, "история": 2, "училище": 2,
 }
 
 _PLAIN = {"а": "a", "е": "ɛ", "и": "i", "о": "o", "у": "u", "ъ": "ɤ"}

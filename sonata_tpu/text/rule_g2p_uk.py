@@ -22,6 +22,8 @@ _STRESS: dict[str, int] = {
     "сьогодні": 2, "завтра": 1, "вчора": 1, "мова": 1, "країна": 2,
     "україна": 3, "людина": 2, "дитина": 2, "робота": 2, "вода": 2,
     "голова": 3, "добрий": 1, "гарний": 1, "великий": 2, "маленький": 2,
+    "земля": 2, "школа": 1, "любов": 2, "життя": 2, "народ": 2,
+    "вулиця": 1, "новий": 2, "старий": 2,
 }
 
 _PLAIN = {"а": "a", "е": "ɛ", "и": "ɪ", "і": "i", "о": "o", "у": "u"}
