@@ -526,6 +526,8 @@ _LANGUAGES: dict[str, tuple] = {
            _lazy("rule_g2p_ko", "word_to_ipa")),
     "hi": (_lazy("rule_g2p_hi", "normalize_text"),  # Devanagari via
            _lazy("rule_g2p_hi", "word_to_ipa")),    # the ne machinery
+    "he": (_lazy("rule_g2p_he", "normalize_text"),
+           _lazy("rule_g2p_he", "word_to_ipa")),
 }
 
 #: Env var: set to "1" to let unsupported languages fall back to English
@@ -575,7 +577,7 @@ def phonemize_clause(text: str, voice: str = "en-us") -> str:
     # combining range U+0300-036F so NFD-normalized Vietnamese keeps
     # its tone marks
     words = re.findall(
-        r"[\w'\u0300-\u036F\u064B-\u0655\u0670"
+        r"[\w'\u0300-\u036F\u05B0-\u05C7\u064B-\u0655\u0670"
         r"\u0900-\u0963\u0966-\u097F]+",
         normalize(text), flags=re.UNICODE)
     ipa_words = [to_ipa(w) for w in words]

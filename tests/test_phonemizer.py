@@ -1341,6 +1341,25 @@ def test_korean_hindi_packs():
     assert kon(100_000_000) == "일억"            # 일 kept before 억
 
 
+def test_hebrew_pack():
+    """Hebrew abjad: begadkefat initial stops, matres lectionis, final
+    letter forms, final-cluster epenthesis, feminine numerals."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause
+    from sonata_tpu.text.rule_g2p_he import number_to_words, word_to_ipa
+
+    assert word_to_ipa("שלום") == "ʃelom"
+    assert word_to_ipa("תודה") == "toda"       # final ה → a
+    assert word_to_ipa("בוקר") == "bokeʁ"      # initial ב → b, ו → o
+    assert word_to_ipa("עולם") == "ʔolem"      # final cluster breaks
+    assert word_to_ipa("ילד") == "jeled"       # initial yod stays j
+    assert word_to_ipa("תּוֹדָה") == "toda"       # niqqud: holam male,
+    assert word_to_ipa("שָׁלוֹם") == "ʃalom"      # qamats-he silent
+    assert phonemize_clause("תּוֹדָה", voice="he") == "toda"
+    assert number_to_words(3000) == "שלושת אלפים"  # masc construct
+    assert number_to_words(23) == "עשרים ושלוש"
+    assert phonemize_clause("שלום עולם", voice="he") == "ʃelom ʔolem"
+
+
 def test_every_language_expands_digits():
     """Every registered language renders digit input through its OWN
     number grammar: output is non-empty IPA with no digits left, for a
