@@ -152,6 +152,48 @@ def number_to_words(n: int) -> str:
     return number_to_words(m) + " million" + (" " + number_to_words(r) if r else "")
 
 
+def epenthesize_runs(units: list, flags: list, *, vowel: str = "e",
+                     final_cluster_ok=None) -> str:
+    """Break consonant runs with an epenthetic vowel — shared by the
+    unvocalized-script packs (Persian/Urdu, Hebrew), whose scripts drop
+    short vowels entirely.
+
+    Policy: no initial clusters (the run's first consonant takes the
+    epenthetic vowel: سلام → selɒːm, שלום → ʃelom); word-internal and
+    final runs keep up to two consonants unless ``final_cluster_ok``
+    (a predicate over the final run) rejects them; longer runs break
+    before their last member.
+    """
+    if final_cluster_ok is None:
+        final_cluster_ok = lambda run: True  # noqa: E731
+    out: list[str] = []
+    i = 0
+    n = len(units)
+    while i < n:
+        if flags[i]:
+            out.append(units[i])
+            i += 1
+            continue
+        j = i
+        while j < n and not flags[j]:
+            j += 1
+        run = units[i:j]
+        at_end = j == n
+        if i == 0 and len(run) >= 2:
+            out.append(run[0])
+            out.append(vowel)
+            run = run[1:]
+        if len(run) >= 2 and (len(run) > 2 or
+                              (at_end and not final_cluster_ok(run))):
+            out.extend(run[:-1])
+            out.append(vowel)
+            out.append(run[-1])
+        else:
+            out.extend(run)
+        i = j
+    return "".join(out)
+
+
 def south_asian_number_words(num: int, *, ones: list, tens: dict,
                              hundred: str, thousand: str, lakh: str,
                              minus: str) -> str:
@@ -577,7 +619,8 @@ def phonemize_clause(text: str, voice: str = "en-us") -> str:
     # combining range U+0300-036F so NFD-normalized Vietnamese keeps
     # its tone marks
     words = re.findall(
-        r"[\w'\u0300-\u036F\u05B0-\u05C7\u064B-\u0655\u0670"
+        r"[\w'\u0300-\u036F\u05B0-\u05BD\u05BF\u05C1\u05C2"
+        r"\u05C4\u05C5\u05C7\u064B-\u0655\u0670"
         r"\u0900-\u0963\u0966-\u097F]+",
         normalize(text), flags=re.UNICODE)
     ipa_words = [to_ipa(w) for w in words]

@@ -177,15 +177,42 @@ def _stress(word: str, ipa: str) -> str:
     if len(nuclei) < 2:
         return ipa
     target = 0
-    for pref in _UNSTRESSED_PREFIXES:
-        if word.startswith(pref) and len(word) > len(pref) + 2:
-            target = 1
-            break
+    # stress-attracting Latinate/French suffixes override the initial
+    # default: Universität, Nation, studieren, Bäckerei
+    if word.endswith(("tion", "sion", "tät")):
+        target = len(nuclei) - 1
+    elif word.endswith("ieren") and len(nuclei) >= 2:
+        target = len(nuclei) - 2
+    elif word.endswith("ei") and len(word) > 4:
+        target = len(nuclei) - 1
+    else:
+        for pref in _UNSTRESSED_PREFIXES:
+            if word.startswith(pref) and len(word) > len(pref) + 2:
+                if pref in ("be", "ge") and word[2] in "iuy":
+                    continue  # bei-/beu- are diphthongs, not prefixes
+                target = 1
+                break
     if target >= len(nuclei):
         target = 0
+    if target == 0:
+        # first syllable: everything before the first nucleus IS the
+        # onset (ˈtsvɪʃən, ˈʃpʁaːxə)
+        return "ˈ" + ipa
     pos = nuclei[target]
-    while pos > 0 and ipa[pos - 1] not in _IPA_VOWELS + "ː":
+    # take back a LEGAL onset only: one consonant, extended while the
+    # pair is a German onset cluster (ʃC, obstruent+liquid, st, pf, ts)
+    # — an unbounded walk dragged codas across the boundary
+    # (verstehen → fɛˈʁst…)
+    if pos > 0 and ipa[pos - 1] not in _IPA_VOWELS + "ː":
         pos -= 1
+        while pos > 0 and ipa[pos - 1] not in _IPA_VOWELS + "ː":
+            pair = ipa[pos - 1] + ipa[pos]
+            if pair in ("ʃp", "ʃt", "ʃm", "ʃn", "ʃv", "ʃl", "ʃʁ",
+                        "tʃ", "ts", "pf", "st", "sp") or \
+                    (pair[0] in "pbtdkɡf" and pair[1] in "ʁl"):
+                pos -= 1
+            else:
+                break
     return ipa[:pos] + "ˈ" + ipa[pos:]
 
 

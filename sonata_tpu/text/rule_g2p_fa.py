@@ -82,51 +82,16 @@ def _render(word: str, table: dict) -> str:
                 units[k] = ("uː" if ch == "و" else "iː") + nasal
     if initial_i and units and units[0] == "j":
         units[0] = "iː"
-    # epenthesis over consonant runs, by position:
-    #   word-initial run (Persian forbids initial clusters) and a fully
-    #   vowelless word: break after the FIRST consonant (سلام → selɒːm,
-    #   چشم → tʃeʃm);
-    #   internal/final runs keep up to 2 (coda+onset / final cluster),
-    #   longer runs break before their last member.
+    # epenthesis over consonant runs: shared helper; a final
+    # obstruent+sonorant pair is no Persian coda (mɒːder, peder)
+    from .rule_g2p import epenthesize_runs
+
+    def coda_ok(run):
+        return not (len(run) == 2 and run[1][0] in "rlmn"
+                    and run[0][0] not in "rlmnsʃ")
+
     flags = [vowelish(u) for u in units]
-    out: list[str] = []
-    i = 0
-    n = len(units)
-    while i < n:
-        if flags[i]:
-            out.append(units[i])
-            i += 1
-            continue
-        j = i
-        while j < n and not flags[j]:
-            j += 1
-        run = units[i:j]
-        if i == 0 and len(run) >= 2:
-            out.append(run[0])
-            out.append("e")
-            rest = run[1:]
-            if j == n and len(rest) == 2 and rest[1][0] in "rlmn" \
-                    and rest[0][0] not in "rlmnsʃ":
-                out.append(rest[0])
-                out.append("e")
-                out.append(rest[1])  # پدر → peder
-            else:
-                out.extend(rest)
-        elif len(run) <= 2:
-            if j == n and len(run) == 2 and run[1][0] in "rlmn" \
-                    and run[0][0] not in "rlmnsʃ":
-                # obstruent + sonorant is no Persian coda: mɒːder
-                out.append(run[0])
-                out.append("e")
-                out.append(run[1])
-            else:
-                out.extend(run)
-        else:
-            out.extend(run[:-1])
-            out.append("e")
-            out.append(run[-1])
-        i = j
-    return "".join(out)
+    return epenthesize_runs(units, flags, final_cluster_ok=coda_ok)
 
 
 _URDU_TABLE = {**_LETTERS, **_URDU_EXTRA}

@@ -38,7 +38,7 @@ def word_to_ipa(word: str) -> str:
     units: list[str] = []
     flags: list[bool] = []
     raw: list[str] = []
-    has_niqqud = any(ch in _NIQQUD for ch in word)
+    has_niqqud = any(_NIQQUD.get(ch) for ch in word)
     chars = list(word)
     for k, ch in enumerate(chars):
         nq = _NIQQUD.get(ch)
@@ -83,39 +83,12 @@ def word_to_ipa(word: str) -> str:
             elif ch == "ו" and not prev_v and not next_v and k > 0:
                 units[k] = "o"
                 flags[k] = True
-        # epenthesis like the Persian pack: no initial clusters, break
-        # long runs
-        out: list[str] = []
-        i = 0
-        n = len(units)
-        while i < n:
-            if flags[i]:
-                out.append(units[i])
-                i += 1
-                continue
-            j = i
-            while j < n and not flags[j]:
-                j += 1
-            run = units[i:j]
-            at_end = j == n
-            if i == 0 and len(run) >= 2:
-                out.append(run[0])
-                out.append("e")
-                run = run[1:]
-            if at_end and len(run) >= 2:
-                # Hebrew words essentially never end in clusters:
-                # עולם → ʔolem, ספר → sefeʁ
-                out.extend(run[:-1])
-                out.append("e")
-                out.append(run[-1])
-            elif len(run) <= 2:
-                out.extend(run)
-            else:
-                out.extend(run[:-1])
-                out.append("e")
-                out.append(run[-1])
-            i = j
-        return "".join(out)
+        # epenthesis via the shared helper; Hebrew words essentially
+        # never end in clusters (עולם → ʔolem, ספר → sefeʁ)
+        from .rule_g2p import epenthesize_runs
+
+        return epenthesize_runs(units, flags,
+                                final_cluster_ok=lambda run: False)
     return "".join(units)
 
 

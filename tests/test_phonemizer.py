@@ -398,6 +398,20 @@ def test_golden_ipa_corpus_spanish():
         assert phonemize_clause(text, voice="es") == golden, text
 
 
+def test_german_stress_refinements():
+    """Round-4: legal-onset stress walk (no coda dragging), bei-/beu-
+    excluded from the be- prefix, Latinate suffix attraction."""
+    from sonata_tpu.text.rule_g2p import phonemize_clause as p
+
+    assert p("verstehen", voice="de") == "fɛʁˈsteːən"
+    assert p("Entwicklung", voice="de") == "ɛntˈvɪklʊŋ"
+    assert p("Beispiel", voice="de") == "ˈbaɪspiːl"
+    assert p("zwischen", voice="de") == "ˈtsvɪʃən"
+    assert p("Universität", voice="de") == "ʊnɪfɛʁzɪˈtɛt"
+    assert p("studieren", voice="de") == "ʃtʊˈdiːʁən"
+    assert p("Bäckerei", voice="de") == "bɛkɛˈʁaɪ"
+
+
 def test_german_unstressed_prefixes():
     from sonata_tpu.text.rule_g2p_de import word_to_ipa
 
