@@ -23,6 +23,7 @@ _STRESS: dict[str, int] = {
     "щастие": 1, "ябълка": 1, "момче": 2, "момиче": 2,
     "софия": 1, "луна": 2, "звезда": 2, "сърце": 2, "любов": 2,
     "живот": 2, "народ": 2, "площад": 2, "история": 2, "училище": 2,
+    "страна": 2, "ръка": 2, "глава": 2,
 }
 
 _PLAIN = {"а": "a", "е": "ɛ", "и": "i", "о": "o", "у": "u", "ъ": "ɤ"}

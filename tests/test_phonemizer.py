@@ -1008,6 +1008,19 @@ def test_golden_ipa_corpus_slavic_batch():
         for text, golden in corpus:
             assert phonemize_clause(text, voice=voice) == golden, \
                 (voice, text)
+    # round-4 depth: one more pinned sentence per pack
+    extra = [
+        ("sk", "Slovensko je krásna krajina",
+         "ˈslovensko je ˈkraːsna ˈkrajina"),
+        ("hr", "Hrvatska je lijepa zemlja",
+         "ˈxrvatska je ˈlijepa ˈzemʎa"),
+        ("uk", "Україна є великою країною",
+         "ukraˈjina jɛ ʋɛlɪˈkoju krajiˈnoju"),
+        ("bg", "България е красива страна",
+         "bɤlˈɡarija ɛ kraˈsiva straˈna"),
+    ]
+    for voice, text, golden in extra:
+        assert phonemize_clause(text, voice=voice) == golden, (voice, text)
     # sr and bs share the BCMS pack; Serbian Cyrillic transliterates
     assert phonemize_clause("hvala", voice="sr") == "ˈxvala"
     assert phonemize_clause("hvala", voice="bs") == "ˈxvala"
