@@ -76,8 +76,19 @@ def accelerator_ready_with_retries():
     rounds 1-2): retry init a few times before reporting failure, so a
     transient outage at the moment a bench starts doesn't record a missing
     number.  ``SONATA_BENCH_INIT_RETRIES=0`` disables.  Shared by bench.py
-    and bench_streaming.py."""
+    and bench_streaming.py.
+
+    ``SONATA_BENCH_FORCE_CPU=1`` skips the probe and pins the process to
+    the host CPU backend (``tools/bench_cpu.py`` regression runs — the
+    environment's sitecustomize registers the remote-TPU plugin before
+    env vars are read, so this must go through ``jax.config``)."""
     import os
+
+    if os.environ.get("SONATA_BENCH_FORCE_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu"
 
     retries = int(os.environ.get("SONATA_BENCH_INIT_RETRIES", "3"))
     platform = _accelerator_ready()
@@ -135,7 +146,7 @@ def main() -> None:
     # fall inside the timed loop, here or in the driver's single run
     voice.prewarm_neighbor_buckets()
 
-    iters = 5
+    iters = int(os.environ.get("SONATA_BENCH_ITERS", "5"))
     total_audio = 0.0
     profile_dir = os.environ.get("SONATA_PROFILE")  # xprof trace target
     import contextlib

@@ -182,8 +182,10 @@ def main(argv=None) -> int:
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
     # repeat CLI invocations reuse compiled executables from disk instead
     # of re-paying the cold XLA compile on every run
-    from ..utils.jax_cache import enable_persistent_compile_cache
+    from ..utils.jax_cache import (
+        enable_persistent_compile_cache, pin_platform_from_env)
 
+    pin_platform_from_env()  # SONATA_PLATFORM=cpu|tpu|...
     enable_persistent_compile_cache()
     args = build_parser().parse_args(argv)
     try:

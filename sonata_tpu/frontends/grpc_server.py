@@ -385,8 +385,10 @@ def main(argv=None) -> int:
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
     # compiled executables persist across boots; with --prewarm, a re-boot
     # loads its shapes from disk in seconds instead of re-running XLA
-    from ..utils.jax_cache import enable_persistent_compile_cache
+    from ..utils.jax_cache import (
+        enable_persistent_compile_cache, pin_platform_from_env)
 
+    pin_platform_from_env()  # SONATA_PLATFORM=cpu|tpu|...
     cache_dir = enable_persistent_compile_cache()
     if cache_dir:
         log.info("persistent compile cache: %s", cache_dir)

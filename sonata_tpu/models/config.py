@@ -166,29 +166,87 @@ class ModelConfig:
 
     # -- phoneme-id encoding (piper/src/lib.rs:232-250) ---------------------
     def phonemes_to_ids(self, phonemes: str) -> list[int]:
+        ids, _dropped = self.phonemes_to_ids_diag(phonemes)
+        return ids
+
+    def phonemes_to_ids_diag(
+            self, phonemes: str) -> tuple[list[int], list[str]]:
+        """Encode, also returning the symbols the map could not encode.
+
+        The reference drops unknown symbols silently (``:243``) — for a
+        G2P-produced string that can delete load-bearing phonemes (e.g. a
+        tone letter the voice's map lacks), so the drop list is surfaced
+        here and aggregated by ``SpeechSynthesizer.phonemize_text``
+        diagnostics; encoding behavior itself stays reference-identical.
+        """
         id_map = self.phoneme_id_map
         pad = id_map.get(PAD_CHAR, [0])
         ids: list[int] = list(id_map.get(BOS_CHAR, [1]))
+        dropped: list[str] = []
         for ch in phonemes:
             mapped = id_map.get(ch)
             if mapped is None:
-                continue  # unknown chars silently dropped (:243)
+                dropped.append(ch)  # unknown: silently dropped (:243)
+                continue
             ids.extend(mapped)
             ids.extend(pad)  # interleaved pad after every phoneme
         ids.extend(id_map.get(EOS_CHAR, [2]))
-        return ids
+        return ids, dropped
 
 
 def default_phoneme_id_map() -> dict[str, list[int]]:
-    """A self-contained IPA symbol table for voices created without a Piper
-    JSON (tests, randomly-initialized voices).  Same structural conventions
-    as Piper: ``_`` pad=0, ``^`` bos=1, ``$`` eos=2, then punctuation,
-    space, and the IPA inventory."""
-    symbols = ["_", "^", "$", " ", "!", "'", ",", "-", ".", ":", ";", "?"]
-    ipa = (
-        "abcdefhijklmnopqrstuvwxzæçðøħŋœǀǁǂǃɐɑɒɓɔɕɖɗɘəɚɛɜɞɟɠɡɢɣɤɥɦɧɨɪɫɬɭɮɯɰ"
-        "ɱɲɳɴɵɶɸɹɺɻɽɾʀʁʂʃʄʈʉʊʋʌʍʎʏʐʑʒʔʕʘʙʛʜʝʟʡʢʰʲʷʼˈˌːˑ˞ˤ̩̪̯̺̻̃̊"
-        "βθχᵻⱱ"
+    """The vendored piper-phonemize symbol table for voices created
+    without a Piper JSON (tests, randomly-initialized voices).
+
+    Ids 0-153 reproduce piper-phonemize's ``DEFAULT_PHONEME_ID_MAP``
+    (``src/phoneme_ids.cpp``, a public ~154-entry constant) exactly, so
+    phoneme-id sequences computed against this map are bit-identical to
+    what a Piper voice trained with the default map expects.  Ids 154+
+    are a documented extension block: IPA the hermetic G2P packs emit
+    that the upstream table cannot encode (Chao tone letters carrying
+    the entire zh/vi tone system, the glottalized-tone mark, secondary
+    articulations, and combining diacritics).  A voice loaded from its
+    own config JSON never sees this map.  Structural conventions:
+    ``_`` pad=0, ``^`` bos=1, ``$`` eos=2.
+    """
+    upstream = (
+        "_", "^", "$", " ", "!", "'", "(", ")", ",", "-", ".", ":",
+        ";", "?",
+        "a", "b", "c", "d", "e", "f", "h", "i", "j", "k", "l", "m",
+        "n", "o", "p", "q", "r", "s", "t", "u", "v", "w", "x", "y",
+        "z",
+        "\u00e6", "\u00e7", "\u00f0", "\u00f8", "\u0127", "\u014b",
+        "\u0153",
+        "\u01c0", "\u01c1", "\u01c2", "\u01c3",
+        "\u0250", "\u0251", "\u0252", "\u0253", "\u0254", "\u0255",
+        "\u0256", "\u0257", "\u0258", "\u0259", "\u025a", "\u025b",
+        "\u025c", "\u025e", "\u025f", "\u0260", "\u0261", "\u0262",
+        "\u0263", "\u0264", "\u0265", "\u0266", "\u0267", "\u0268",
+        "\u026a", "\u026b", "\u026c", "\u026d", "\u026e", "\u026f",
+        "\u0270", "\u0271", "\u0272", "\u0273", "\u0274", "\u0275",
+        "\u0276", "\u0278", "\u0279", "\u027a", "\u027b", "\u027d",
+        "\u027e", "\u0280", "\u0281", "\u0282", "\u0283", "\u0284",
+        "\u0288", "\u0289", "\u028a", "\u028b", "\u028c", "\u028d",
+        "\u028e", "\u028f", "\u0290", "\u0291", "\u0292", "\u0294",
+        "\u0295", "\u0298", "\u0299", "\u029b", "\u029c", "\u029d",
+        "\u029f", "\u02a1", "\u02a2", "\u02b2",
+        "\u02c8", "\u02cc", "\u02d0", "\u02d1", "\u02de",
+        "\u03b2", "\u03b8", "\u03c7", "\u1d7b", "\u2c71",
+        "0", "1", "2", "3", "4", "5", "6", "7", "8", "9",
+        "\u0327", "\u0303", "\u032a", "\u032f", "\u0329",
+        "\u02b0", "\u02e4", "\u03b5", "\u2193", "#", '"', "\u2191",
+        "\u033a", "\u033b",
     )
-    symbols.extend(dict.fromkeys(ipa))
+    # extension block (ids 154+): hermetic-pack symbols upstream lacks
+    extension = (
+        "\u02e5", "\u02e6", "\u02e7", "\u02e8", "\u02e9",  # Chao tones
+        "\u02c0",                                   # glottalized tone (vi)
+        "\u02b7", "\u02bc",                        # labialized, ejective
+        "\u02b1",                       # breathy-voice aspiration (ne/hi)
+        "\u0325", "\u030a", "\u0306", "\u031d",  # voiceless/ring/breve/
+        "\u0320", "\u0339", "\u031e", "\u0308",  # raised + retr/round/
+        "\u032c",                                   # lowered/central/voiced
+    )
+    symbols = upstream + extension
+    assert len(symbols) == len(set(symbols))
     return {s: [i] for i, s in enumerate(symbols)}

@@ -21,6 +21,7 @@ Design notes (TPU-first, not a port):
 from __future__ import annotations
 
 import math
+import os
 from functools import partial
 from typing import Optional
 
@@ -90,7 +91,8 @@ def conv_transpose1d(x, p, *, stride: int, padding: int):
     stuffing — an ~8x FLOP waste at Piper's first upsample stage.
     """
     k = p["w"].shape[0]
-    if k - stride == 2 * padding and stride > 1:
+    if (k - stride == 2 * padding and stride > 1
+            and os.environ.get("SONATA_TCONV", "subpixel") != "naive"):
         return conv_transpose1d_subpixel(x, p, stride=stride, padding=padding)
     y = lax.conv_general_dilated(
         x, jnp.flip(p["w"], 0), window_strides=(1,),

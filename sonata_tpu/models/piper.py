@@ -22,6 +22,7 @@ serialized inside the text backend.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -94,6 +95,14 @@ class PiperVoice(BaseModel):
         self._stream_coalescer: "Optional[_StreamDecodeCoalescer]" = None
         self._stage_coalescer: "Optional[_StreamStageCoalescer]" = None
         self._voice_closed = False
+        # encodability diagnostics: symbols the voice's phoneme_id_map
+        # could not encode (dropped, reference-identically, at encode
+        # time — piper/src/lib.rs:243).  A nonzero rate means the G2P
+        # front-end and the voice's symbol table disagree; for tonal
+        # languages that can silently delete the whole tone system.
+        self.drop_stats = {"symbols_total": 0, "symbols_dropped": 0,
+                           "dropped": {}}
+        self._warned_drops: set = set()
         # adaptive frame-budget estimator for the single-dispatch path:
         # running upper bound of frames per input id per unit length_scale.
         # Start optimistic — an underestimate costs one overflow retry on
@@ -252,6 +261,30 @@ class PiperVoice(BaseModel):
             text, voice=self.config.espeak_voice,
             remove_lang_switch_flags=True,
         )
+
+    def _encode_phonemes(self, phonemes: str) -> list[int]:
+        """Encode one sentence, feeding the voice's drop-rate diagnostics.
+
+        Encoding behavior is reference-identical (unknown symbols dropped,
+        piper/src/lib.rs:243); this wrapper only *counts* the drops and
+        warns once per distinct symbol so a G2P/symbol-table mismatch is
+        visible instead of silently degrading audio."""
+        ids, dropped = self.config.phonemes_to_ids_diag(phonemes)
+        stats = self.drop_stats
+        stats["symbols_total"] += len(phonemes)
+        if dropped:
+            stats["symbols_dropped"] += len(dropped)
+            for ch in dropped:
+                stats["dropped"][ch] = stats["dropped"].get(ch, 0) + 1
+                if ch not in self._warned_drops and not ch.isspace():
+                    self._warned_drops.add(ch)
+                    import logging
+
+                    logging.getLogger("sonata").warning(
+                        "phoneme %r (U+%04X) is not in this voice's "
+                        "phoneme_id_map and was dropped at encoding",
+                        ch, ord(ch))
+        return ids
 
     def speak_one_sentence(self, phonemes: str) -> Audio:
         return self.speak_batch([phonemes])[0]
@@ -471,7 +504,7 @@ class PiperVoice(BaseModel):
         if not phoneme_batches:
             return []
         sc = self.get_fallback_synthesis_config()
-        ids_list = [self.config.phonemes_to_ids(p) for p in phoneme_batches]
+        ids_list = [self._encode_phonemes(p) for p in phoneme_batches]
         n = len(ids_list)
         if speakers is not None and len(speakers) != n:
             raise OperationError(
@@ -909,7 +942,8 @@ class PiperVoice(BaseModel):
                 raise OperationError(
                     "voice is closed; streaming is unavailable")
             if self._stream_coalescer is None:
-                self._stream_coalescer = _StreamDecodeCoalescer(self)
+                self._stream_coalescer = _StreamDecodeCoalescer(
+                    self, **_coalescer_ab_overrides())
             return self._stream_coalescer
 
     @property
@@ -919,7 +953,8 @@ class PiperVoice(BaseModel):
                 raise OperationError(
                     "voice is closed; streaming is unavailable")
             if self._stage_coalescer is None:
-                self._stage_coalescer = _StreamStageCoalescer(self)
+                self._stage_coalescer = _StreamStageCoalescer(
+                    self, **_coalescer_ab_overrides())
             return self._stage_coalescer
 
     def close(self) -> None:
@@ -1099,7 +1134,7 @@ class PiperVoice(BaseModel):
     def stream_synthesis(self, phonemes: str, chunk_size: int,
                          chunk_padding: int) -> Iterator[Audio]:
         sc = self.get_fallback_synthesis_config()
-        ids = self.config.phonemes_to_ids(phonemes)
+        ids = self._encode_phonemes(phonemes)
         info = self.audio_output_info()
         hop = self.hp.hop_length
 
@@ -1145,6 +1180,17 @@ class PiperVoice(BaseModel):
                 submitted.append(submit(plans[next_i]))
                 next_i += 1
             yield Audio(samples, info, inference_ms=ms)
+
+
+def _coalescer_ab_overrides() -> dict:
+    """A/B benchmarking knob: ``SONATA_STREAM_COALESCE=0`` degrades both
+    stream coalescers to one-request-per-dispatch (batch 1, zero gather
+    window) — the reference's thread-per-stream serving shape
+    (``grpc/src/main.rs:381-409``) — so ``tools/bench_cpu.py`` can measure
+    what the coalescing architecture actually buys.  Default: unchanged."""
+    if os.environ.get("SONATA_STREAM_COALESCE", "1") == "0":
+        return {"max_batch": 1, "max_wait_ms": 0.0}
+    return {}
 
 
 def _drain_pending_futures(q: "queue.Queue", fut_of, reason: str) -> None:

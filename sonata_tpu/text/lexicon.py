@@ -274,6 +274,11 @@ BASE_WORDS = {
     "language": "lˈæŋɡwɪdʒ", "sentence": "sˈɛntəns",
     "phrase": "fɹeɪz", "sound": "saʊnd", "noise": "nɔɪz",
     "music": "mjˈuːzɪk", "song": "sɔːŋ", "dance": "dæns",
+    # s-final non-plurals the strip-s retry must not misanalyze
+    # (round-4 advisor finding), plus their scan-resistant stems
+    "physics": "fˈɪzɪks", "chaos": "kˈeɪɑːs", "series": "sˈɪɹiz",
+    "menu": "mˈɛnjuː", "lens": "lɛnz", "basis": "bˈeɪsɪs",
+    "analysis": "ənˈæləsɪs", "emphasis": "ˈɛmfəsɪs",
     "art": "ɑːɹt", "color": "kˈʌlɚ", "shape": "ʃeɪp",
     "form": "fɔːɹm", "line": "laɪn", "circle": "sˈɜːkəl",
     "size": "saɪz", "weight": "weɪt",

@@ -237,7 +237,12 @@ def expand_numbers(text: str, number_words) -> str:
 
 
 def normalize_text(text: str) -> str:
-    """Lowercase, expand integers, drop symbols the G2P cannot speak."""
+    """Expand numeric shapes (currency, ordinals, years, decimals via the
+    English :class:`~sonata_tpu.text.numerics.NumberGrammar`, then bare
+    integers), lowercase, drop symbols the G2P cannot speak."""
+    from .numerics import en_grammar, expand_numerics
+
+    text = expand_numerics(text, en_grammar())
     return expand_numbers(text, number_to_words).lower()
 
 

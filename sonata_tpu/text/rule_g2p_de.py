@@ -256,6 +256,8 @@ def number_to_words(num: int) -> str:
 
 
 def normalize_text(text: str) -> str:
+    from .numerics import de_grammar, expand_numerics
     from .rule_g2p import expand_numbers
 
+    text = expand_numerics(text, de_grammar())
     return expand_numbers(text, number_to_words).lower()

@@ -196,6 +196,8 @@ def number_to_words(num: int) -> str:
 
 
 def normalize_text(text: str) -> str:
+    from .numerics import es_grammar, expand_numerics
     from .rule_g2p import expand_numbers
 
+    text = expand_numerics(text, es_grammar())
     return expand_numbers(text, number_to_words).lower()

@@ -384,4 +384,7 @@ def normalize_text(text: str) -> str:
     # typographic apostrophe → ASCII so elision tokens (l’homme) survive
     # the tokenizer's [\w']+ word pattern
     text = text.replace("’", "'")
+    from .numerics import expand_numerics, fr_grammar
+
+    text = expand_numerics(text, fr_grammar())
     return expand_numbers(text, number_to_words).lower()

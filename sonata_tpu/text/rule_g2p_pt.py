@@ -22,7 +22,7 @@ _ACCENTED = {"á": ("a", "a"), "â": ("a", "ɐ"), "à": ("a", "a"),
              "í": ("i", "i"), "ó": ("o", "ɔ"), "ô": ("o", "o"),
              "ú": ("u", "u")}
 _VOWEL_LETTERS = "aeiouáâàãéêíóôõú"
-_NASAL_MAP = {"a": "ɐ̃", "e": "ẽ", "i": "ĩ", "o": "õ", "u": "ũ"}
+_NASAL_MAP = {"a": "ɐ̃", "e": "e\u0303", "i": "i\u0303", "o": "o\u0303", "u": "u\u0303"}
 
 
 def _scan(word: str) -> tuple[list[str], list[bool], list[int], int]:
@@ -75,17 +75,17 @@ def _scan(word: str) -> tuple[list[str], list[bool], list[int], int]:
         if rest.startswith("ão") or (rest.startswith("am") and i + 2 == n):
             emit("ɐ̃w", True, til=rest.startswith("ão")); i += 2; continue
         if rest.startswith("õe"):
-            emit("õj", True, til=True); i += 2; continue
+            emit("o\u0303j", True, til=True); i += 2; continue
         if rest.startswith("ãe"):
             emit("ɐ̃j", True, til=True); i += 2; continue
         if rest.startswith("em") and i + 2 == n:
-            emit("ẽj", True); i += 2; continue
+            emit("e\u0303j", True); i += 2; continue
         if (rest.startswith("ém") or rest.startswith("êm")) and i + 2 == n:
-            emit("ẽj", True, accented=True); i += 2; continue  # também
+            emit("e\u0303j", True, accented=True); i += 2; continue  # também
         if ch == "ã":
             emit("ɐ̃", True, til=True); i += 1; continue
         if ch == "õ":
-            emit("õ", True, til=True); i += 1; continue
+            emit("o\u0303", True, til=True); i += 1; continue
         # vowel + coda m/n → nasal vowel
         if ch in "aeiou" and nxt and nxt in "mn" and nasal_coda(2):
             emit(_NASAL_MAP[ch], True)

@@ -111,12 +111,88 @@ def _scan(word: str) -> tuple[list[str], list[bool]]:
 _SPELLING = {"сегодня": "севодня", "что": "што", "чтобы": "штобы",
              "конечно": "конешно", "скучно": "скушно"}
 
+# -ого words that are adverbs/particles, not genitives: г stays [ɡ]
+_OGO_NOT_GENITIVE = {"много", "немного", "дорого", "недорого",
+                     "строго", "долго", "надолго", "ненадолго",
+                     "убого", "полого"}
+
+# ё-restoration: Russian text overwhelmingly writes е for ё, which is
+# a VOWEL QUALITY error here, not just stress (мед [mʲet] vs мёд
+# [mʲot]).  eSpeak's ru_dict restores ё lexically; this is the hermetic
+# subset over the high-frequency core.  Exact forms, stem prefixes
+# (noun paradigms keep ё in the stem), adjective stems over the
+# agreement endings, and the -шел past family.
+_YO_EXACT = {
+    "еще": "ещё", "мед": "мёд", "лед": "лёд", "елка": "ёлка",
+    "ежик": "ёжик", "нес": "нёс", "вез": "вёз", "пес": "пёс",
+    "звезды": "звёзды", "слезы": "слёзы", "сестры": "сёстры",
+    "жены": "жёны", "озера": "озёра", "весла": "вёсла",
+    "идет": "идёт", "идешь": "идёшь", "идем": "идём",
+    "идете": "идёте", "живет": "живёт", "живешь": "живёшь",
+    "живем": "живём", "дает": "даёт", "даешь": "даёшь",
+    "берет": "берёт", "берешь": "берёшь", "несет": "несёт",
+    "везет": "везёт", "ведет": "ведёт", "поет": "поёт",
+    "пьет": "пьёт", "бьет": "бьёт", "льет": "льёт",
+    "шьет": "шьёт", "встает": "встаёт", "зовет": "зовёт",
+    "ждет": "ждёт", "врет": "врёт", "растет": "растёт",
+    "цветет": "цветёт", "течет": "течёт", "печет": "печёт",
+    "придет": "придёт", "пойдет": "пойдёт", "найдет": "найдёт",
+    "придем": "придём", "пойдем": "пойдём", "начнет": "начнёт",
+    "вернется": "вернётся", "остается": "остаётся",
+    "смеется": "смеётся", "проснется": "проснётся",
+    "трехсот": "трёхсот", "все-таки": "всё-таки",
+}
+_YO_PREFIXES = {
+    "самолет": "самолёт", "вертолет": "вертолёт",
+    "ребенк": "ребёнк", "ребенок": "ребёнок",
+    "котенок": "котёнок",
+    "счет": "счёт", "отчет": "отчёт", "расчет": "расчёт",
+    "учет": "учёт", "зачет": "зачёт", "полет": "полёт",
+    "партнер": "партнёр", "шофер": "шофёр", "актер": "актёр",
+    "режиссер": "режиссёр",
+}
+# a prefix rewrite only fires when the remainder is a noun case ending
+# (полета ✓) — never mid-verb (полетел keeps its е: полете́л)
+_NOUN_CASE_ENDS = ("", "а", "у", "е", "ом", "ы", "и", "ов", "ам",
+                   "ами", "ах", "ой", "ою")
+_YO_ADJ_STEMS = {
+    "черн": "чёрн", "зелен": "зелён", "желт": "жёлт",
+    "тепл": "тёпл", "темн": "тёмн", "легк": "лёгк",
+    "тяжел": "тяжёл", "дешев": "дешёв", "жестк": "жёстк",
+    "тверд": "твёрд", "четк": "чётк", "надежн": "надёжн",
+}
+_ADJ_AGREE = ("ый", "ого", "ому", "ым", "ом", "ая", "ой", "ую",
+              "ое", "ые", "ых", "ыми", "ий", "его", "ему", "им",
+              "ем", "яя", "ее", "ие", "их", "ими")
+
+
+def _restore_yo(word: str) -> str:
+    if "ё" in word:
+        return word
+    hit = _YO_EXACT.get(word)
+    if hit is not None:
+        return hit
+    for pre, yo in _YO_PREFIXES.items():
+        if word.startswith(pre) and word[len(pre):] in _NOUN_CASE_ENDS:
+            return yo + word[len(pre):]
+    for stem, yo in _YO_ADJ_STEMS.items():
+        if word.startswith(stem) and word[len(stem):] in _ADJ_AGREE:
+            return yo + word[len(stem):]
+    # пошел/нашел/пришел/ушел → -шёл; вы́шел keeps е (вы- takes stress)
+    if word.endswith("шел") and not word.startswith("вы"):
+        return word[:-3] + "шёл"
+    return word
+
 
 def word_to_ipa(word: str) -> str:
+    word = _restore_yo(word)  # е-for-ё restoration (quality + stress)
     orig = word
     word = _SPELLING.get(word, word)
-    # genitive -ого/-его endings read г as [v] (нового → novava)
-    if word.endswith(("ого", "его")) and len(word) > 3:
+    # genitive -ого/-его endings read г as [v] (нового → novava) —
+    # except the adverbs/particles whose -ого is not a case ending
+    # (мно́го, до́рого: г stays [ɡ])
+    if word.endswith(("ого", "его")) and len(word) > 3 and \
+            word not in _OGO_NOT_GENITIVE:
         word = word[:-2] + "во"
     units, flags = _scan(word)
     nuclei = [k for k, f in enumerate(flags) if f]
@@ -124,7 +200,13 @@ def word_to_ipa(word: str) -> str:
         return "".join(units)
     if len(nuclei) == 1:
         return "".join(units)
-    stress_pos = _STRESS.get(orig)
+    # round-5 frequency-swept lexicon (exact forms + stem matches over
+    # inflection endings) first; the small legacy table second
+    from .rule_g2p_ru_stress import lookup_stress
+
+    stress_pos = lookup_stress(orig)
+    if stress_pos is None:
+        stress_pos = _STRESS.get(orig)
     if stress_pos is not None:
         target_n = min(stress_pos - 1, len(nuclei) - 1)
     elif "ё" in orig:

@@ -78,10 +78,10 @@ def get_default_engine() -> TashkeelEngine:
     or `.npz` native tagger), or the literal ``bundled`` for the bundled
     tagger (``sonata_tpu/data/tashkeel_default.npz``).  Unset ⇒ the
     heuristic rule engine: the gold-corpus eval (``TASHKEEL_EVAL.json``,
-    ``tools/eval_tashkeel.py``) measures the rules at DER 0.179 /
-    case-ending accuracy 0.905 vs the bundled tagger's 0.257 / 0.67, so
-    the better-scoring system is the default and the eval is the gate for
-    ever flipping it back.
+    ``tools/eval_tashkeel.py``) scores the rules ahead of the bundled
+    tagger on both DER and case-ending accuracy, so the better-scoring
+    system is the default and that eval artifact (not numbers pinned
+    here) is the gate for ever flipping it back.
     """
     global _GLOBAL
     if _GLOBAL is None:

@@ -14,6 +14,26 @@ from __future__ import annotations
 import os
 
 
+def pin_platform_from_env() -> str | None:
+    """Honor ``SONATA_PLATFORM`` (cpu / tpu / …) via ``jax.config``.
+
+    Plain ``JAX_PLATFORMS`` is read at first-jax-import time; in
+    environments where a sitecustomize (or any earlier import) has
+    already pulled jax in, the env var is silently too late and the
+    process can hang probing an unreachable accelerator plugin.  The
+    config API works at any point before first backend use, so the CLI
+    and gRPC entry points call this first.  Returns the pinned platform
+    or None.
+    """
+    platform = os.environ.get("SONATA_PLATFORM")
+    if not platform:
+        return None
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+    return platform
+
+
 def enable_persistent_compile_cache(min_compile_secs: float = 1.0) -> str | None:
     """Point JAX's compilation cache at a per-user directory and return it.
 

@@ -1,0 +1,131 @@
+"""Encodability gate: every G2P pack's output must survive phoneme-id
+encoding against the default symbol table with ZERO dropped symbols.
+
+The reference drops unknown symbols silently at encode time
+(``piper/src/lib.rs:243``).  Round 4 shipped packs whose output the
+default map could not encode (zh/vi Chao tone letters, tr/fi ``y``) —
+the golden-IPA tests pinned *strings*, so nothing gated what actually
+reached the model.  This module closes that hole: the same golden
+corpora the string tests pin are pushed through
+``ModelConfig.phonemes_to_ids_diag`` and the drop list must be empty,
+for every registered language, including each language's number-word
+output.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from sonata_tpu.models.config import ModelConfig, default_phoneme_id_map
+from sonata_tpu.text.rule_g2p import phonemize_clause, supported_languages
+
+import tests.test_phonemizer as tp
+
+# list-style corpora in test_phonemizer: name suffix → language code
+_LIST_CORPORA = {
+    "": "en", "_DE": "de", "_ES": "es", "_IT": "it", "_FR": "fr",
+    "_PT": "pt", "_PL": "pl", "_TR": "tr", "_RO": "ro", "_NL": "nl",
+    "_CS": "cs", "_HU": "hu", "_RU": "ru", "_EL": "el", "_FI": "fi",
+    "_ID": "id", "_SW": "sw", "_SK": "sk", "_HR": "hr", "_UK": "uk",
+    "_BG": "bg",
+}
+# dict-style corpora: {voice: [(text, golden), ...]}
+_DICT_CORPORA = ("GOLDEN_CORPUS_NORDIC", "GOLDEN_CORPUS_SCCK",
+                 "GOLDEN_CORPUS_KLVN")
+
+# languages whose samples live in inline asserts rather than corpora
+_EXTRA_SAMPLES = {
+    "ar": ["مرحبا بالعالم", "شكرا جزيلا"],
+    "fa": ["سلام دنیا، خیلی ممنون", "کتاب فارسی"],
+    "ur": ["ٹھیک ہاں", "لڑکا میں"],
+    "zh": ["nǐ hǎo shì jiè", "xuéxí zhōng wén"],
+    "ko": ["안녕하세요 감사합니다", "좋은 아침"],
+    "hi": ["नमस्ते दुनिया", "ज़रूरी है"],
+    "he": ["שלום עולם", "בוקר טוב"],
+    "ms": ["terima kasih banyak"],
+    "sr": ["Здраво свете, љубав"],
+    "bs": ["hvala lijepo"],
+    "nb": ["takk skal du ha"],
+}
+
+
+def _samples_by_language() -> dict[str, list[str]]:
+    samples: dict[str, list[str]] = {}
+    for suffix, lang in _LIST_CORPORA.items():
+        corpus = getattr(tp, f"GOLDEN_CORPUS{suffix}")
+        samples.setdefault(lang, []).extend(text for text, _ in corpus)
+    for name in _DICT_CORPORA:
+        for lang, corpus in getattr(tp, name).items():
+            samples.setdefault(lang, []).extend(text for text, _ in corpus)
+    for lang, texts in _EXTRA_SAMPLES.items():
+        samples.setdefault(lang, []).extend(texts)
+    return samples
+
+
+_SAMPLES = _samples_by_language()
+
+
+def test_gate_covers_every_registered_language():
+    """If a new pack registers a language, it must join this gate."""
+    missing = set(supported_languages()) - set(_SAMPLES)
+    assert not missing, (
+        f"languages registered but not encodability-gated: {sorted(missing)}"
+        " — add corpus samples for them")
+
+
+def _default_config() -> ModelConfig:
+    return ModelConfig.from_dict({
+        "audio": {"sample_rate": 22050, "quality": "medium"},
+        "espeak": {"voice": "en-us"},
+        "inference": {},
+        "num_symbols": len(default_phoneme_id_map()),
+        "num_speakers": 1,
+        "phoneme_id_map": default_phoneme_id_map(),
+    })
+
+
+@pytest.mark.parametrize("lang", sorted(_SAMPLES))
+def test_golden_corpus_encodes_without_drops(lang):
+    cfg = _default_config()
+    # natural text plus number shapes: number words must encode too
+    texts = _SAMPLES[lang] + ["7", "1984"]
+    for text in texts:
+        ipa = phonemize_clause(text, voice=lang)
+        ids, dropped = cfg.phonemes_to_ids_diag(ipa)
+        assert not dropped, (
+            f"{lang}: {[f'{c} U+{ord(c):04X}' for c in dropped]} "
+            f"dropped encoding {ipa!r} (from {text!r})")
+        assert len(ids) > 2  # bos/eos plus real content
+
+
+def test_default_map_matches_piper_phonemize_prefix():
+    """Ids 0-153 are the vendored piper-phonemize DEFAULT_PHONEME_ID_MAP;
+    spot-check the anchor points that pin the layout."""
+    m = default_phoneme_id_map()
+    assert m["_"] == [0] and m["^"] == [1] and m["$"] == [2]
+    assert m[" "] == [3] and m["("] == [6] and m[")"] == [7]
+    assert m["a"] == [14] and m["y"] == [37] and m["z"] == [38]
+    assert m["æ"] == [39] and m["ɐ"] == [50] and m["ʲ"] == [119]
+    assert m["ˈ"] == [120] and m["ˌ"] == [121] and m["ː"] == [122]
+    assert m["β"] == [125] and m["ⱱ"] == [129]
+    assert m["0"] == [130] and m["9"] == [139]
+    assert m["̧"] == [140] and m["̃"] == [141]
+    assert m["ʰ"] == [145] and m["#"] == [149] and m['"'] == [150]
+    assert m["̻"] == [153]
+    # extension block starts exactly past the upstream table
+    assert m["˥"] == [154]
+
+
+def test_drop_stats_surface_on_voice():
+    """PiperVoice counts encode-time drops instead of hiding them."""
+    from tests.voices import tiny_voice
+
+    v = tiny_voice(seed=3)
+    ph = v.phonemize_text("hello there")
+    v.speak_batch(ph)
+    assert v.drop_stats["symbols_total"] > 0
+    assert v.drop_stats["symbols_dropped"] == 0
+    # now force a symbol outside the map: it must be counted, and the
+    # encoding itself must stay reference-identical (silently dropped)
+    ids, dropped = v.config.phonemes_to_ids_diag("h☃i")  # snowman
+    assert dropped == ["☃"]

@@ -354,6 +354,29 @@ def test_latinate_suffix_stress():
     assert g("operations").endswith("ˈeɪʃənz")
 
 
+def test_s_final_non_plurals_not_misanalyzed():
+    """The strip-final-s suffix retry must not misread s-final NON-plural
+    words as stem+suffix+plural (round-4 advisor finding): the outputs
+    keep their final consonant exactly as the lexicon/scan renders it,
+    with no plural allomorph glued on."""
+    from sonata_tpu.text.rule_g2p import english_word_to_ipa as g
+
+    assert g("physics") == "fˈɪzɪks"      # NOT physic+s reanalysis
+    assert g("chaos") == "kˈeɪɑːs"
+    assert g("series") == "sˈɪɹiz"        # invariant plural form
+    assert g("lens") == "lɛnz"            # monomorphemic s-final
+    assert g("analysis") == "ənˈæləsɪs"   # -is endings keep s
+    assert g("basis") == "bˈeɪsɪs"
+    assert g("emphasis") == "ˈɛmfəsɪs"
+    assert g("canvas") == "ˈkænvæs"
+    assert g("tennis") == "ˈtɛnɪs"
+    assert g("famous").endswith("əs")     # -ous adjectives: no z
+    assert g("nervous").endswith("əs")
+    # genuine plurals still ride the suffix match with allomorphy
+    assert g("menus") == "mˈɛnjuːz"
+    assert g("operations").endswith("z")
+
+
 GOLDEN_CORPUS_DE = [
     ("Hallo Welt, wie geht es dir heute?",
      "haˈloː vɛlt viː ɡeːt ɛs dɪʁ ˈhɔʏtə"),
@@ -527,15 +550,15 @@ def test_it_fr_number_expansion():
 
 GOLDEN_CORPUS_PT = [
     ("Olá mundo, como você está?",
-     "oˈla ˈmũdu ˈkomu voˈse esˈta"),
+     "oˈla ˈmũdu ˈkomu voˈse esˈta"),
     ("O coração não sabe mentir",
-     "u koɾaˈsɐ̃w ˈnɐ̃w ˈsabi mẽˈtʃiɾ"),
+     "u koɾaˈsɐ̃w ˈnɐ̃w ˈsabi mẽˈtʃiɾ"),
     ("Bom dia, muito obrigado",
-     "bõ ˈdʒiɐ ˈmujtu obɾiˈɡadu"),
+     "bõ ˈdʒiɐ ˈmujtu obɾiˈɡadu"),
     ("vinte e três pessoas na cidade",
-     "ˈvĩtʃi i ˈtɾes peˈsoɐs nɐ siˈdadʒi"),
+     "ˈvĩtʃi i ˈtɾes peˈsoɐs nɐ siˈdadʒi"),
     ("A gente fala português do Brasil",
-     "ɐ ˈʒẽtʃi ˈfalɐ poɾtuˈɡes du bɾaˈzil"),
+     "ɐ ˈʒẽtʃi ˈfalɐ poɾtuˈɡes du bɾaˈzil"),
 ]
 
 GOLDEN_CORPUS_PL = [
@@ -576,7 +599,7 @@ def test_portuguese_phenomena():
     from sonata_tpu.text.rule_g2p_pt import word_to_ipa
 
     assert word_to_ipa("coração") == "koɾaˈsɐ̃w"   # til attracts stress
-    assert word_to_ipa("também") == "tɐ̃ˈbẽj"      # final -ém → ẽj
+    assert word_to_ipa("também") == "tɐ̃ˈbẽj"      # final -ém → ẽj
     assert word_to_ipa("banho") == "ˈbaɲu"        # nh digraph, no nasal
     assert word_to_ipa("carro") != word_to_ipa("caro")  # ʁ vs ɾ
     assert word_to_ipa("livros") == "ˈlivɾus"     # plural-final raising
@@ -811,9 +834,19 @@ GOLDEN_CORPUS_RU = [
     ("Спасибо большое, всё хорошо",
      "spaˈsʲiba balʲˈʃojɪ fsʲo xaraˈʃo"),
     ("двадцать три книги на столе",
-     "ˈdvadtsatʲ trʲi ˈknʲiɡʲi na ˈstolʲɪ"),
+     "ˈdvadtsatʲ trʲi ˈknʲiɡʲi na staˈlʲe"),
     ("Сегодня хорошая погода",
-     "sʲɪˈvodnʲɪ xaraˈʃajɪ paˈɡoda"),
+     "sʲɪˈvodnʲɪ xaˈroʃajɪ paˈɡoda"),
+    # round-5 stress lexicon + е-for-ё restoration: mobile столе́,
+    # ребёнок/пошёл/самолёт written with е, adverb высоко́
+    ("Молоко и масло на столе",
+     "malaˈko i ˈmasla na staˈlʲe"),
+    ("Ребенок пошел в школу",
+     "rʲɪˈbʲonak paˈʃol f ˈʃkolu"),
+    ("Самолет летит высоко",
+     "samaˈlʲot lʲɪˈtʲit vɨsaˈko"),
+    ("Учитель читает интересную книгу",
+     "uˈtʃʲitʲɪlʲ tʃʲiˈtajɪt intʲɪˈrʲesnuju ˈknʲiɡu"),
 ]
 
 
@@ -836,7 +869,10 @@ def test_russian_phenomena():
     assert word_to_ipa("язык") == "jɪˈzɨk"       # iotated я + ikanie, ы
     assert word_to_ipa("вода") == "vaˈda"        # lexical stress, akanie
     assert word_to_ipa("большой") == "balʲˈʃoj"  # -ой ending stress
-    assert word_to_ipa("нового") == "naˈvova"    # genitive г → [v]
+    # genitive г → [v]; но́вый is stem-stressed (round-5 lexicon), so
+    # both post-stress о's reduce: [ˈnovava] (was naˈvova under the old
+    # penultimate guess — the lexicon fixed the vowel qualities)
+    assert word_to_ipa("нового") == "ˈnovava"
     assert word_to_ipa("что") == "ʃto"           # spelling exception
     assert word_to_ipa("самолёт") == "samaˈlʲot"  # ё is always stressed
     assert word_to_ipa("телефон") == "tʲɪlʲɪˈfon"  # loanword -он final

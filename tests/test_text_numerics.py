@@ -1,0 +1,195 @@
+"""Golden tests for numeric text normalization (VERDICT r04 item 4):
+decimals, ordinals, years, and currency x en/de/es/fr, end-to-end
+through each pack's normalizer (the eSpeak ``TranslateNumber`` behaviors
+the reference inherits via ``text_to_phonemes``).
+"""
+
+from __future__ import annotations
+
+from sonata_tpu.text.numerics import (
+    de_grammar,
+    en_grammar,
+    es_grammar,
+    expand_numerics,
+    fr_grammar,
+)
+from sonata_tpu.text.rule_g2p import normalize_text as norm_en
+from sonata_tpu.text.rule_g2p_de import normalize_text as norm_de
+from sonata_tpu.text.rule_g2p_es import normalize_text as norm_es
+from sonata_tpu.text.rule_g2p_fr import normalize_text as norm_fr
+
+
+def _words(s: str) -> str:
+    return " ".join(s.split())
+
+
+# -- decimals ---------------------------------------------------------------
+
+def test_decimals_en():
+    assert _words(norm_en("pi is 3.14")) == "pi is three point one four"
+    assert _words(norm_en("0.5 percent")) == "zero point five percent"
+
+
+def test_decimals_de():
+    assert _words(norm_de("Pi ist 3,14")) == "pi ist drei komma eins vier"
+
+
+def test_decimals_es():
+    assert _words(norm_es("pi es 3,14")) == "pi es tres coma uno cuatro"
+
+
+def test_decimals_fr():
+    assert _words(norm_fr("pi vaut 3,14")) == \
+        "pi vaut trois virgule un quatre"
+
+
+# -- ordinals ---------------------------------------------------------------
+
+def test_ordinals_en():
+    assert _words(norm_en("the 1st prize")) == "the first prize"
+    assert _words(norm_en("the 2nd try")) == "the second try"
+    assert _words(norm_en("the 3rd time")) == "the third time"
+    assert _words(norm_en("the 5th of June")) == "the fifth of june"
+    assert _words(norm_en("the 12th round")) == "the twelfth round"
+    assert _words(norm_en("the 21st century")) == \
+        "the twenty first century"
+    assert _words(norm_en("the 30th day")) == "the thirtieth day"
+    assert _words(norm_en("the 100th visitor")) == \
+        "the one hundredth visitor"
+
+
+def test_ordinals_de():
+    assert _words(norm_de("am 3. Mai")) == "am dritte mai"
+    assert _words(norm_de("der 1. Versuch")) == "der erste versuch"
+    assert _words(norm_de("der 7. Tag")) == "der siebte tag"
+    assert _words(norm_de("der 21. Juni")) == "der einundzwanzigste juni"
+    # sentence-final period is a full stop, NOT an ordinal (the integer
+    # pass pads its expansion with spaces; the period survives as its
+    # own token)
+    out = _words(norm_de("Ich sehe 3."))
+    assert "dritte" not in out and "drei" in out and out.endswith(".")
+
+
+def test_ordinals_es():
+    assert _words(norm_es("el 1º de mayo")) == "el primero de mayo"
+    assert _words(norm_es("la 3ª vez")) == "la tercera vez"
+    assert _words(norm_es("el 8º piso")) == "el octavo piso"
+
+
+def test_ordinals_fr():
+    assert _words(norm_fr("le 1er mai")) == "le premier mai"
+    assert _words(norm_fr("la 1re fois")) == "la première fois"
+    assert _words(norm_fr("la 2e fois")) == "la deuxième fois"
+    assert _words(norm_fr("le 9e art")) == "le neuvième art"
+    assert _words(norm_fr("le 21e siècle")) == "le vingt et unième siècle"
+
+
+# -- years ------------------------------------------------------------------
+
+def test_years_en():
+    assert _words(norm_en("in 1984")) == "in nineteen eighty four"
+    assert _words(norm_en("in 1900")) == "in nineteen hundred"
+    assert _words(norm_en("in 1805")) == "in eighteen oh five"
+    assert _words(norm_en("in 2000")) == "in two thousand"
+    assert _words(norm_en("in 2007")) == "in two thousand seven"
+    assert _words(norm_en("in 2026")) == "in twenty twenty six"
+
+
+def test_years_de():
+    assert _words(norm_de("im Jahr 1984")) == \
+        "im jahr neunzehnhundertvierundachtzig"
+    assert _words(norm_de("im Jahr 2007")) == "im jahr zweitausendsieben"
+
+
+def test_years_es():
+    # Spanish years read as plain cardinals
+    assert _words(norm_es("en 1984")) == \
+        "en mil novecientos ochenta y cuatro"
+
+
+def test_years_fr():
+    assert _words(norm_fr("en 1984")) == \
+        "en mille neuf cent quatre-vingt-quatre"
+
+
+# -- currency ---------------------------------------------------------------
+
+def test_currency_en():
+    assert _words(norm_en("$12.50 please")) == \
+        "twelve dollars fifty cents please"
+    assert _words(norm_en("it costs €5")) == "it costs five euros"
+    assert _words(norm_en("£1.01 exactly")) == \
+        "one pound one penny exactly"
+    assert _words(norm_en("$1 only")) == "one dollar only"
+
+
+def test_currency_de():
+    assert _words(norm_de("12,50 € bitte")) == \
+        "zwölf euro fünfzig sent bitte"
+
+
+def test_currency_es():
+    assert _words(norm_es("12,50 € por favor")) == \
+        "doce euros cincuenta céntimos por favor"
+    assert _words(norm_es("$100 al mes")) == "cien dólares al mes"
+
+
+def test_currency_fr():
+    assert _words(norm_fr("12,50 € merci")) == \
+        "douze euros cinquante centimes merci"
+    assert _words(norm_fr("1 € suffit")) == "un euro suffit"
+
+
+# -- interactions -----------------------------------------------------------
+
+def test_thousands_groups_collapse():
+    assert _words(norm_en("1,000,000 items")) == "one million items"
+    assert _words(norm_de("1.000.000 Dinge")) == "eine million dinge"
+
+
+def test_decimal_not_mistaken_for_year():
+    # 1984.5 must read as a decimal, not year + orphan digits
+    assert _words(norm_en("value 1984.5")) == \
+        "value one thousand nine hundred eighty four point five"
+
+
+def test_plain_integers_still_expand():
+    assert _words(norm_en("42 things")) == "forty two things"
+    assert _words(norm_fr("80 jours")) == "quatre-vingts jours"
+
+
+def test_grouped_currency_amounts():
+    # review finding r05: group separators inside currency amounts
+    assert _words(norm_en("$1,234.56 total")) == \
+        ("one thousand two hundred thirty four dollars fifty six cents "
+         "total")
+    assert _words(norm_de("1.234,56 € gesamt")) == \
+        ("eintausendzweihundertvierunddreißig euro sechsundfünfzig "
+         "sent gesamt")
+
+
+def test_teen_ordinals_above_one_hundred():
+    # review finding r05: x11-x19 must not take the decade split
+    assert _words(norm_en("the 112th item")) == \
+        "the one hundred twelfth item"
+    assert _words(norm_en("the 111th try")) == \
+        "the one hundred eleventh try"
+
+
+def test_grouped_cardinal_is_not_a_year():
+    # review finding r05: 1,984 is a cardinal; bare 1984 is a year
+    assert _words(norm_en("1,984 people")) == \
+        "one thousand nine hundred eighty four people"
+    assert _words(norm_en("in 1984")) == "in nineteen eighty four"
+
+
+def test_grammar_pass_order_is_stable():
+    # currency beats decimal; ordinal beats bare integer
+    g = en_grammar()
+    assert "dollars" in expand_numerics("$2.50", g)
+    assert "first" in expand_numerics("1st", g)
+    for grammar in (en_grammar(), de_grammar(), es_grammar(),
+                    fr_grammar()):
+        # idempotent on already-expanded text (no digits left to eat)
+        once = expand_numerics("3rd 3,14 1984 $5", grammar)
+        assert expand_numerics(once, grammar) == once

@@ -1,0 +1,115 @@
+"""CPU-backend perf regression harness (VERDICT r04 item 1).
+
+The TPU tunnel has been down for whole rounds at a stretch, leaving every
+optimization in the stack (sub-pixel transposed convs, stream coalescing,
+pipelined dispatch) unmeasured.  This harness runs ``bench.py`` and
+``bench_streaming.py`` on the host CPU backend — clearly labeled as such —
+with A/B toggles over the optimization stack, so each round commits
+*measured ratios* regardless of tunnel health:
+
+- batch RTF: sub-pixel transposed convs (default) vs the naive
+  ``lhs_dilation`` lowering (``SONATA_TCONV=naive``)
+- streaming TTFB/throughput: shared stream coalescers (default) vs
+  one-request-per-dispatch (``SONATA_STREAM_COALESCE=0``), the
+  reference's thread-per-stream serving shape
+
+Each configuration runs in its own subprocess (the toggles are read at
+trace time; a warm jit cache would mask an in-process flip).
+
+Usage::
+
+    python tools/bench_cpu.py [--out BENCH_CPU_rNN.json]
+                              [--streaming-out BENCH_STREAMING_CPU_rNN.json]
+
+Writes two JSON artifacts: a batch file with both tconv variants and a
+streaming file with both coalescing variants, each entry tagged
+``platform: "cpu"`` with the exact env toggles used.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def run_bench(script: str, env_extra: dict, timeout_s: float = 3600):
+    env = dict(os.environ)
+    env.update(env_extra)
+    env["SONATA_BENCH_FORCE_CPU"] = "1"
+    env.setdefault("SONATA_BENCH_ITERS", "2")  # CPU: keep wall time sane
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, str(REPO / script)], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=timeout_s)
+    wall = time.time() - t0
+    lines = []
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                lines.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return {"rc": proc.returncode, "wall_s": round(wall, 1),
+            "results": lines,
+            "stderr_tail": proc.stderr.strip().splitlines()[-3:]}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="BENCH_CPU_r05.json")
+    ap.add_argument("--streaming-out", default="BENCH_STREAMING_CPU_r05.json")
+    ap.add_argument("--skip-streaming", action="store_true")
+    args = ap.parse_args()
+
+    batch = {"platform": "cpu", "note": (
+        "host-CPU regression numbers (TPU tunnel down; absolute values are "
+        "NOT comparable to the BASELINE.md TPU target — the ratios are the "
+        "deliverable)"), "configs": {}}
+    for name, env in (("subpixel_tconv", {}),
+                      ("naive_tconv", {"SONATA_TCONV": "naive"})):
+        print(f"[bench_cpu] batch config {name} ...", flush=True)
+        batch["configs"][name] = {"env": env, **run_bench("bench.py", env)}
+    try:
+        a = batch["configs"]["subpixel_tconv"]["results"][0]["value"]
+        b = batch["configs"]["naive_tconv"]["results"][0]["value"]
+        if a and b:
+            batch["subpixel_speedup"] = round(b / a, 3)
+    except (KeyError, IndexError, TypeError):
+        pass
+    Path(args.out).write_text(json.dumps(batch, indent=1) + "\n")
+    print(f"[bench_cpu] wrote {args.out}", flush=True)
+
+    if args.skip_streaming:
+        return
+    streaming = {"platform": "cpu", "note": batch["note"], "configs": {}}
+    for name, env in (("coalescing_on", {}),
+                      ("coalescing_off", {"SONATA_STREAM_COALESCE": "0"})):
+        print(f"[bench_cpu] streaming config {name} ...", flush=True)
+        streaming["configs"][name] = {
+            "env": env, **run_bench("bench_streaming.py", env)}
+
+    def metric(cfg, name):
+        for r in streaming["configs"][cfg]["results"]:
+            if r.get("metric") == name:
+                return r.get("value")
+        return None
+
+    for m in ("streaming_ttfb_p50_at_4_streams",
+              "streaming_ttfb_p50_at_8_streams"):
+        on, off = metric("coalescing_on", m), metric("coalescing_off", m)
+        if on and off:
+            streaming[f"{m}_coalescing_gain"] = round(off / on, 3)
+    Path(args.streaming_out).write_text(json.dumps(streaming, indent=1) + "\n")
+    print(f"[bench_cpu] wrote {args.streaming_out}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

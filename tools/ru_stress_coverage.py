@@ -1,0 +1,133 @@
+"""Measure Russian stress-lexicon coverage (VERDICT r04 item 5).
+
+Runs the committed high-frequency Russian token list below through
+``rule_g2p_ru``'s stress resolution and reports what fraction of
+polysyllabic tokens (weighted by rank — Zipf 1/rank) resolve from the
+LEXICON (exact form or stem match) versus falling back to heuristics.
+Monosyllables and ё-carrying words are excluded from the denominator:
+their stress needs no lexicon.
+
+Writes ``RU_STRESS_COVERAGE.json`` at the repo root.
+
+The frequency list is a hand-curated ~500-form sample of the Russian
+high-frequency core (function words that carry stress, everyday nouns
+and verbs in their most frequent inflected forms, common adjectives and
+adverbs) — the shapes a TTS request actually contains.  It is data, not
+test fixtures: the coverage number moves only when the lexicon grows.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+# rank-ordered: most frequent first (the weight is 1/rank)
+FREQ_TOKENS = """
+это что как его она они мы вы был была было были есть быть
+если уже только еще очень можно нужно надо когда где здесь там
+теперь сейчас потом тогда всегда никогда часто редко иногда
+сегодня завтра вчера утром вечером ночью
+человек люди время года день дела жизнь жизни слова место мир
+дом дома работа работы работу рука руки руку глаза голова голос
+вода воды земля стране страны город города деньги отец мать
+друг друга дети ребенок женщина мужчина народ семья власть
+вопрос вопросы дело конец начало сторона стороны часть случай
+машина машины улица дорога дороге окно стол книга книги письмо
+школа школе учитель урок класс университет студент институт
+сказал сказала говорит говорил говорила сказать говорить
+думал думала думает думать знал знала знает знать
+видел видела видит видеть смотрел смотрит смотреть
+пошел пошла идет шел шла пойти идти прийти пришел пришла
+сделал сделать делает делать работал работает работать
+хотел хотела хочет хотеть может могут мог могла мочь
+стал стала стать было будет будут любит любил любить
+живет жил жила жить дает дал дала дать взял взяла взять
+нашел нашла найти спросил спросила ответил ответила
+понял поняла понять помнит помнил помнить
+осталась остался остаться начал начала начать
+русский русского новый новая новое новые старый старая
+большой большая большое большие маленький маленькая
+хороший хорошая хорошее плохой молодой молодая последний
+первый первая второй третий главный главная важный важная
+белый черный красный зеленый синий светлый темный
+высокий низкий длинный короткий быстрый медленный
+сильный слабый тяжелый легкий простой сложный
+интересный интересная известный разный каждый каждая
+хорошо плохо быстро медленно громко тихо легко трудно
+просто сложно много мало немного совсем вместе отдельно
+далеко близко рядом около снова опять также тоже
+конечно наверное возможно действительно вообще почти
+сначала наконец вдруг даже именно например
+молоко хлеб масло мясо вода чай кофе сахар соль
+завтрак обед ужин еда кухня комната квартира дверь
+погода солнце дождь снег ветер небо зима лето весна осень
+январь февраль март апрель май июнь июль август
+сентябрь октябрь ноябрь декабрь понедельник вторник
+среда четверг пятница суббота воскресенье неделя месяц
+собака кошка лошадь птица рыба дерево лес поле река море
+гора цветок трава лист солнца луна звезда
+музыка песня танец театр кино фильм картина история
+книга газета журнал радио телефон компьютер интернет
+игра футбол спорт команда победа здоровье болезнь больница
+врач доктор лекарство аптека магазин рынок цена деньги
+рубль доллар автобус поезд самолет машина метро станция
+вокзал аэропорт билет город деревня столица москва россия
+правда ложь счастье радость горе страх любовь надежда
+вера мечта мысль идея память внимание интерес цель
+причина результат условие возможность проблема решение
+помощь совет просьба ошибка смысл значение
+государство закон право суд армия война мир граница
+общество политика экономика наука культура искусство
+литература язык языка слово буква звук предложение
+утро вечер ночь час часа минута секунда момент период
+""".split()
+
+
+def main() -> None:
+    import sys
+
+    sys.path.insert(0, str(REPO))
+    from sonata_tpu.text.rule_g2p_ru import _STRESS, _restore_yo
+    from sonata_tpu.text.rule_g2p_ru_stress import (
+        STRESS_TABLE,
+        lookup_stress,
+    )
+
+    vowels = set("аеёиоуыэюя")
+    total_w = lex_w = heur_w = 0.0
+    total_n = lex_n = 0
+    uncovered: list[str] = []
+    for rank, tok in enumerate(FREQ_TOKENS, 1):
+        n_vow = sum(1 for c in tok if c in vowels)
+        if n_vow < 2 or "ё" in tok:
+            continue  # monosyllable / ё: stress is free
+        w = 1.0 / rank
+        total_w += w
+        total_n += 1
+        restored = _restore_yo(tok)  # е-for-ё: restoration pins stress
+        if ("ё" in restored or lookup_stress(tok) is not None
+                or tok in _STRESS):
+            lex_w += w
+            lex_n += 1
+        else:
+            heur_w += w
+            uncovered.append(tok)
+
+    out = {
+        "lexicon_entries": len(STRESS_TABLE),
+        "freq_tokens_total": len(FREQ_TOKENS),
+        "polysyllabic_tokens": total_n,
+        "covered_tokens": lex_n,
+        "coverage_unweighted": round(lex_n / max(total_n, 1), 4),
+        "coverage_zipf_weighted": round(lex_w / max(total_w, 1e-9), 4),
+        "top_uncovered": uncovered[:40],
+    }
+    (REPO / "RU_STRESS_COVERAGE.json").write_text(
+        json.dumps(out, ensure_ascii=False, indent=1) + "\n")
+    print(json.dumps(out, ensure_ascii=False))
+
+
+if __name__ == "__main__":
+    main()
