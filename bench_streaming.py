@@ -29,7 +29,9 @@ def main() -> None:
                  "audio_seconds_per_second"),
                 ("streaming_ttfb_p50_at_4_streams", "ms"),
                 ("streaming_ttfb_p50_at_8_streams", "ms"),
-                ("stream_decode_coalescing_ratio", "requests_per_dispatch")):
+                ("stream_decode_coalescing_ratio", "requests_per_dispatch"),
+                ("stream_stage_coalescing_ratio", "requests_per_dispatch"),
+                ("dispatch_policy_coalesce", "bool")):
             print(json.dumps({
                 "metric": metric, "value": None, "unit": unit,
                 "vs_baseline": None,
@@ -117,14 +119,31 @@ def main() -> None:
             "unit": "ms",
             "vs_baseline": None,
         }))
-    co = voice._stream_coalescer
-    if co is not None:
+    # per-dispatch observability: what the backend-adaptive policy chose
+    # and how many requests actually shared each device dispatch
+    stats = voice.dispatch_stats()
+    for stage in ("stream_decode", "stream_stage"):
+        s = stats.get(stage)
+        if s is not None:
+            print(json.dumps({
+                "metric": f"{stage}_coalescing_ratio",
+                "value": s["coalescing_ratio"],
+                "unit": "requests_per_dispatch",
+                "vs_baseline": None,
+            }))
+    pol = stats.get("policy")
+    if pol is not None:
         print(json.dumps({
-            "metric": "stream_decode_coalescing_ratio",
-            "value": round(co.stats["requests"]
-                           / max(co.stats["dispatches"], 1), 2),
-            "unit": "requests_per_dispatch",
+            "metric": "dispatch_policy_coalesce",
+            "value": 1.0 if pol["coalesce"] else 0.0,
+            "unit": "bool",
             "vs_baseline": None,
+            "policy": {k: pol[k] for k in (
+                "backend", "source", "stream_decode_max_batch",
+                "stream_decode_max_wait_ms", "stream_stage_max_batch",
+                "stream_stage_max_wait_ms", "scheduler_max_batch",
+                "scheduler_max_wait_ms")},
+            "probe": pol.get("probe"),
         }))
 
 

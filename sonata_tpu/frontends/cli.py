@@ -209,6 +209,9 @@ def main(argv=None) -> int:
             }, ensure_ascii=False))
             return 0
         voice = from_config_path(args.config, seed=args.seed)
+        policy = getattr(voice, "dispatch_policy", None)
+        if policy is not None:  # visible serving shape (backend-adaptive)
+            log.info(policy.describe())
         synth = SpeechSynthesizer(voice)
         _apply_scales(synth, args)
         text = args.text

@@ -135,6 +135,20 @@ class SonataGrpcService:
             log.info("voice %s: %d utterances, aggregate RTF %.4f "
                      "(%.1f audio-s/s)", v.voice_id, stats.utterances,
                      stats.rtf, stats.audio_seconds_per_second)
+            # per-dispatch counters ride the same cadence: requests vs
+            # device dispatches per stage shows whether coalescing is
+            # actually happening under the current policy
+            dispatch_stats = getattr(v.voice, "dispatch_stats", None)
+            if dispatch_stats is not None:
+                ds = dispatch_stats()
+                if v.scheduler is not None:
+                    s = dict(v.scheduler.stats)
+                    s["coalescing_ratio"] = round(
+                        s["requests"] / max(s["dispatches"], 1), 3)
+                    ds["scheduler"] = s
+                log.info("voice %s dispatch: %s", v.voice_id,
+                         {k: val for k, val in ds.items()
+                          if k != "policy"})
 
     # -- unary RPCs -----------------------------------------------------------
     def GetSonataVersion(self, request: pb.Empty, context) -> pb.Version:
@@ -171,6 +185,14 @@ class SonataGrpcService:
                 self._voices[vid] = v
                 self._loading.pop(vid, None)
         log.info("loaded voice %s from %s", vid, request.config_path)
+        # resolve + surface the backend-adaptive dispatch policy at load
+        # time, so the serving shape (coalescing on/off, batch/wait knobs,
+        # probe constants) is in the log before traffic arrives
+        try:
+            log.info("voice %s %s", vid, voice.dispatch_policy.describe())
+        except Exception:  # policy must never block serving
+            log.exception("dispatch-policy resolution failed "
+                          "(serving continues on defaults)")
         return self._voice_info(v)
 
     def GetVoiceInfo(self, request: pb.VoiceIdentifier, context) -> pb.VoiceInfo:

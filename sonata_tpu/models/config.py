@@ -185,10 +185,18 @@ class ModelConfig:
         dropped: list[str] = []
         for ch in phonemes:
             mapped = id_map.get(ch)
-            if mapped is None:
-                dropped.append(ch)  # unknown: silently dropped (:243)
+            if not mapped:
+                # unknown symbol — or a present-but-EMPTY map entry in a
+                # user-supplied config, which must degrade like unknown
+                # rather than crash the encode path: dropped (:243)
+                dropped.append(ch)
                 continue
-            ids.extend(mapped)
+            # multi-id map entries contribute only their FIRST id — the
+            # reference pushes ``id.first()`` per phoneme
+            # (piper/src/lib.rs phonemes_to_input_ids), so extending with
+            # the whole list would desynchronize sequences (and their
+            # interleaved pads) from what the voice was trained on
+            ids.append(mapped[0])
             ids.extend(pad)  # interleaved pad after every phoneme
         ids.extend(id_map.get(EOS_CHAR, [2]))
         return ids, dropped

@@ -50,6 +50,11 @@ from ..utils.buckets import (
     bucket_for,
     pad_to,
 )
+from ..utils.dispatch_policy import (
+    DispatchPolicy,
+    resolve_policy,
+    should_donate,
+)
 from . import vits
 from .chunker import CROSSFADE_SAMPLES, plan_chunks
 from .config import ModelConfig, SynthesisConfig, default_phoneme_id_map
@@ -61,7 +66,8 @@ class PiperVoice(BaseModel):
 
     def __init__(self, config: ModelConfig, params, *, seed: int = 0,
                  tashkeel: Optional[TashkeelEngine] = None, mesh=None,
-                 compute_dtype: Optional[str] = None):
+                 compute_dtype: Optional[str] = None,
+                 dispatch_policy: "Optional[DispatchPolicy]" = None):
         self.config = config
         self.hp = config.hyper
         self.params = params
@@ -94,6 +100,12 @@ class PiperVoice(BaseModel):
         self._dec_cache: dict = {}
         self._stream_coalescer: "Optional[_StreamDecodeCoalescer]" = None
         self._stage_coalescer: "Optional[_StreamStageCoalescer]" = None
+        # backend-adaptive dispatch policy (utils/dispatch_policy): pass
+        # one explicitly to pin the serving shape; None resolves lazily
+        # on first use (env overrides → backend fast path → cached probe)
+        # so plain construction never pays a probe dispatch.
+        self._dispatch_policy = dispatch_policy
+        self._policy_lock = threading.Lock()
         self._voice_closed = False
         # encodability diagnostics: symbols the voice's phoneme_id_map
         # could not encode (dropped, reference-identically, at encode
@@ -377,7 +389,7 @@ class PiperVoice(BaseModel):
         # width can enter the cache at b=max only — and the first lone
         # straggler at that width would then pay a b=1 cold compile
         # mid-request (the exact stall prewarm exists to prevent)
-        widths = {(width, has_sid) for (_, width, _b, has_sid) in seen}
+        widths = {(k[1], k[3]) for k in seen}
         for (width, has_sid) in widths:
             for b in {1, co._max_batch}:
 
@@ -911,7 +923,14 @@ class PiperVoice(BaseModel):
         prewarmable; the first round of concurrent traffic must never pay
         a mid-request XLA compile (measured: a cold b=4 shape on a remote
         chip stalled every stream's first chunk by tens of seconds)."""
-        key = ("wbatch", width, b, has_sid)
+        # the stacked [B, width, C] windows buffer is dead after the call,
+        # but XLA input/output aliasing needs an identically-sized output
+        # to reuse it and the [B, width*hop] waveform never matches — the
+        # annotation only produced per-compile "donated buffers were not
+        # usable" warnings (r05 streaming bench), so donation is now off
+        # unless SONATA_DONATE=1 forces it back on for A/B runs.
+        donate = should_donate()
+        key = ("wbatch", width, b, has_sid, donate)
         with self._jit_lock:
             fn = self._dec_cache.get(key)
             if fn is None:
@@ -924,37 +943,72 @@ class PiperVoice(BaseModel):
                     return vits.decode(params, hp, windows, g=g,
                                        compute_dtype=cdt)
 
-                # donate the stacked windows: each dispatch stacks a fresh
-                # [B, width, C] buffer that nothing reads afterwards, so
-                # XLA may reuse its HBM for decoder intermediates (the
-                # upsampling stack's working set is the streaming path's
-                # peak memory).  No retry path exists here, unlike the
-                # fused batch fn whose overflow re-dispatch must reuse its
-                # args.  TPU-only effect; CPU ignores donation.
-                fn = jax.jit(run, donate_argnums=(1,))
+                fn = jax.jit(run, donate_argnums=(1,) if donate else ())
                 self._dec_cache[key] = fn
         return fn
 
     @property
+    def dispatch_policy(self) -> DispatchPolicy:
+        """The resolved backend-adaptive dispatch policy (lazy, cached).
+
+        Resolution order: an explicitly-passed policy > env overrides
+        (``SONATA_STREAM_COALESCE``, ``SONATA_DISPATCH_POLICY``) > the
+        backend fast path / cached dispatch-scaling probe — see
+        :func:`sonata_tpu.utils.dispatch_policy.resolve_policy`.
+        Resolved outside the jit lock: the probe may itself dispatch.
+        """
+        with self._policy_lock:
+            if self._dispatch_policy is None:
+                self._dispatch_policy = resolve_policy(
+                    shape_key=(self.hp.inter_channels, self.hp.hop_length))
+                import logging
+
+                logging.getLogger("sonata").info(
+                    self._dispatch_policy.describe())
+            return self._dispatch_policy
+
+    def dispatch_stats(self) -> dict:
+        """Per-dispatch observability: the policy decision plus each
+        stream coalescer's request/dispatch counters and coalescing
+        ratio (requests per device dispatch; 1.0 = no coalescing).
+        Stages that never ran report ``None``."""
+        def view(co):
+            if co is None:
+                return None
+            s = dict(co.stats)
+            s["coalescing_ratio"] = round(
+                s["requests"] / max(s["dispatches"], 1), 3)
+            return s
+
+        with self._jit_lock:
+            decode, stage = self._stream_coalescer, self._stage_coalescer
+        pol = self._dispatch_policy
+        return {"policy": pol.as_dict() if pol is not None else None,
+                "stream_decode": view(decode),
+                "stream_stage": view(stage)}
+
+    @property
     def _stream_decoder(self) -> "_StreamDecodeCoalescer":
+        kwargs = self.dispatch_policy.stream_decode_kwargs()
         with self._jit_lock:
             if self._voice_closed:
                 raise OperationError(
                     "voice is closed; streaming is unavailable")
             if self._stream_coalescer is None:
                 self._stream_coalescer = _StreamDecodeCoalescer(
-                    self, **_coalescer_ab_overrides())
+                    self, **kwargs)
             return self._stream_coalescer
 
     @property
     def _stream_stages(self) -> "_StreamStageCoalescer":
+        kwargs = self.dispatch_policy.stream_stage_kwargs()
         with self._jit_lock:
             if self._voice_closed:
                 raise OperationError(
                     "voice is closed; streaming is unavailable")
             if self._stage_coalescer is None:
                 self._stage_coalescer = _StreamStageCoalescer(
-                    self, **_coalescer_ab_overrides())
+                    self, **kwargs)
             return self._stage_coalescer
 
     def close(self) -> None:
@@ -1180,17 +1234,6 @@ class PiperVoice(BaseModel):
                 submitted.append(submit(plans[next_i]))
                 next_i += 1
             yield Audio(samples, info, inference_ms=ms)
-
-
-def _coalescer_ab_overrides() -> dict:
-    """A/B benchmarking knob: ``SONATA_STREAM_COALESCE=0`` degrades both
-    stream coalescers to one-request-per-dispatch (batch 1, zero gather
-    window) — the reference's thread-per-stream serving shape
-    (``grpc/src/main.rs:381-409``) — so ``tools/bench_cpu.py`` can measure
-    what the coalescing architecture actually buys.  Default: unchanged."""
-    if os.environ.get("SONATA_STREAM_COALESCE", "1") == "0":
-        return {"max_batch": 1, "max_wait_ms": 0.0}
-    return {}
 
 
 def _drain_pending_futures(q: "queue.Queue", fut_of, reason: str) -> None:

@@ -30,11 +30,28 @@ from ..core import Model, OperationError
 
 
 class BatchScheduler:
-    def __init__(self, model: Model, *, max_batch: int = 16,
-                 max_wait_ms: float = 5.0):
+    def __init__(self, model: Model, *, max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None):
         self._model = model
+        # knobs default from the model's backend-adaptive dispatch policy
+        # (utils/dispatch_policy): on a CPU backend that degrades to
+        # per-request pass-through (batch 1, zero wait) — batching buys
+        # nothing when the backend runs rows serially, while the gather
+        # window and bucket padding cost real latency.  Explicit kwargs
+        # (and models without a policy) keep the accelerator defaults.
+        if max_batch is None or max_wait_ms is None:
+            policy = getattr(model, "dispatch_policy", None)
+            defaults = (policy.scheduler_kwargs() if policy is not None
+                        else {"max_batch": 16, "max_wait_ms": 5.0})
+            max_batch = defaults["max_batch"] if max_batch is None \
+                else max_batch
+            max_wait_ms = defaults["max_wait_ms"] if max_wait_ms is None \
+                else max_wait_ms
         self._max_batch = max_batch
         self._max_wait = max_wait_ms / 1000.0
+        #: per-dispatch observability, same shape as the stream
+        #: coalescers': coalescing ratio = requests / dispatches
+        self.stats = {"requests": 0, "dispatches": 0}
         self._queue: "queue.Queue" = queue.Queue()
         self._closed = threading.Event()
         self._worker = threading.Thread(target=self._run,
@@ -114,6 +131,8 @@ class BatchScheduler:
 
     def _dispatch(self, batch) -> None:
         sentences, speakers, scales, futures = (list(x) for x in zip(*batch))
+        self.stats["requests"] += len(batch)
+        self.stats["dispatches"] += 1
         try:
             # speakers/scales are part of the Model protocol
             audios = self._model.speak_batch(sentences, speakers=speakers,

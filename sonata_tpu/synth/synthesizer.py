@@ -89,6 +89,14 @@ class SpeechSynthesizer:
         if close is not None:
             close()
 
+    def dispatch_stats(self):
+        """Backend-adaptive dispatch observability (policy decision +
+        per-stage request/dispatch counters), or None for models without
+        a dispatch policy.  Delegated so frontends and benches talk to
+        the synthesizer, not the concrete model."""
+        stats = getattr(self.model, "dispatch_stats", None)
+        return stats() if stats is not None else None
+
     # -- processing helper ---------------------------------------------------
     def _post_process(self, audio: Audio,
                       output_config: Optional[AudioOutputConfig]) -> Audio:

@@ -52,6 +52,11 @@ class NumberGrammar:
     #: "3." vs a sentence-final cardinal).  Returns False ⇒ leave the
     #: match unexpanded.  None ⇒ every pattern match is an ordinal.
     ordinal_guard: Optional[Callable[["re.Match[str]"], bool]] = None
+    #: number-scaling words ("$3.5 billion"): a fractional currency
+    #: amount followed by one of these is a scaled quantity, not
+    #: dollars-and-cents — the currency pass declines and the decimal
+    #: pass reads the number.  Lowercased.
+    magnitudes: tuple = ()
 
     def read_digits(self, digits: str) -> str:
         """Fractional digits read one by one ("14" → "one four")."""
@@ -63,21 +68,38 @@ def _sub_currency(text: str, g: NumberGrammar) -> str:
         return text
     syms = "".join(re.escape(s) for s in g.currency)
     dec = "," if g.decimal_comma else r"\."
-    # $12.50 / 12,50 € / €5 / 5€ — symbol before or after, optional
-    # fractional part in the language's decimal separator
+    # $12.50 / $12.5 / 12,50 € / €5 / 5€ — symbol before or after, with
+    # an optional 1-2 digit fractional part in the language's decimal
+    # separator (a lone tenths digit reads as tens of cents).  The gap
+    # between symbol and amount explicitly admits the \x1f degrouping
+    # sentinel: the group-separator pass runs first and rewrites
+    # "$1,000" to "$\x1f1000", so the tag sits exactly here — spelling
+    # it out beats relying on Python's \s happening to treat U+001F as
+    # whitespace.  3+ fractional digits fall through to the decimal
+    # pass ("$1.999" is not an amount in cents).
     pat = re.compile(
-        rf"(?:(?P<pre>[{syms}])\s?(?P<a>\d+)(?:{dec}(?P<af>\d{{2}}))?"
-        rf"|(?P<b>\d+)(?:{dec}(?P<bf>\d{{2}}))?\s?(?P<post>[{syms}]))")
+        rf"(?:(?P<pre>[{syms}])[\s\x1f]?(?P<a>\d+)"
+        rf"(?:{dec}(?P<af>\d{{1,2}})(?!\d))?(?!{dec}\d)"
+        rf"|(?P<b>\d+)(?:{dec}(?P<bf>\d{{1,2}})(?!\d))?(?!{dec}\d)"
+        rf"[\s\x1f]?(?P<post>[{syms}]))")
 
     def _one(m: re.Match) -> str:
         sym = m.group("pre") or m.group("post")
         whole = int(m.group("a") or m.group("b"))
         frac = m.group("af") or m.group("bf")
+        if frac and g.magnitudes:
+            # "$3.5 billion" is a scaled number, not 3 dollars 50 cents:
+            # decline the cents reading and let the decimal pass speak it
+            nxt = re.match(r"\s*([^\W\d_]+)", m.string[m.end():])
+            if nxt and nxt.group(1).lower() in g.magnitudes:
+                return m.group(0)
         one_major, many_major, one_minor, many_minor = g.currency[sym]
         out = g.cardinal(whole) + " " + (
             one_major if whole == 1 else many_major)
         if frac and int(frac) != 0:
-            cents = int(frac)
+            # "12.5" means fifty cents, not five: a single fractional
+            # digit counts tenths of the major unit
+            cents = int(frac) * (10 if len(frac) == 1 else 1)
             out += " " + g.cardinal(cents) + " " + (
                 one_minor if cents == 1 else many_minor)
         return " " + out + " "
@@ -231,6 +253,8 @@ def en_grammar() -> NumberGrammar:
         currency={"$": ("dollar", "dollars", "cent", "cents"),
                   "€": ("euro", "euros", "cent", "cents"),
                   "£": ("pound", "pounds", "penny", "pence")},
+        magnitudes=("hundred", "thousand", "million", "billion",
+                    "trillion"),
     )
 
 
@@ -302,6 +326,8 @@ def de_grammar() -> NumberGrammar:
         # feeds the G2P, never the user
         currency={"€": ("euro", "euro", "sent", "sent"),
                   "$": ("dollar", "dollar", "sent", "sent")},
+        magnitudes=("hundert", "tausend", "million", "millionen",
+                    "milliarde", "milliarden", "billion", "billionen"),
     )
 
 
@@ -339,6 +365,8 @@ def es_grammar() -> NumberGrammar:
         currency={"€": ("euro", "euros", "céntimo", "céntimos"),
                   "$": ("dólar", "dólares", "centavo", "centavos")},
         ordinal_fem=lambda n: re.sub("o$", "a", _es_ordinal(n)),
+        magnitudes=("cien", "mil", "millón", "millones", "billón",
+                    "billones"),
     )
 
 
@@ -379,4 +407,6 @@ def fr_grammar() -> NumberGrammar:
         decimal_comma=True,
         currency={"€": ("euro", "euros", "centime", "centimes"),
                   "$": ("dollar", "dollars", "centime", "centimes")},
+        magnitudes=("cent", "cents", "mille", "million", "millions",
+                    "milliard", "milliards"),
     )

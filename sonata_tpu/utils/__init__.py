@@ -1,4 +1,18 @@
-from .buckets import BATCH_BUCKETS, FRAME_BUCKETS, TEXT_BUCKETS, bucket_for, pad_to
+from .buckets import (
+    BATCH_BUCKETS,
+    FRAME_BUCKETS,
+    TEXT_BUCKETS,
+    bucket_for,
+    canonical_dispatch_batch,
+    pad_to,
+)
+from .dispatch_policy import (
+    DispatchPolicy,
+    ProbeResult,
+    probe_dispatch_scaling,
+    resolve_policy,
+)
 
 __all__ = ["BATCH_BUCKETS", "FRAME_BUCKETS", "TEXT_BUCKETS", "bucket_for",
-           "pad_to"]
+           "canonical_dispatch_batch", "pad_to", "DispatchPolicy",
+           "ProbeResult", "probe_dispatch_scaling", "resolve_policy"]

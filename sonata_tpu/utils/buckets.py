@@ -25,3 +25,15 @@ def bucket_for(n: int, buckets=TEXT_BUCKETS) -> int:
 def pad_to(seq, length: int, value=0):
     """Pad a python list to ``length``."""
     return list(seq) + [value] * (length - len(seq))
+
+
+def canonical_dispatch_batch(max_batch: int) -> int:
+    """Canonical batch size for a coalesced dispatch group.
+
+    The stream coalescers pad every multi-request group to ONE batch
+    size so the compiled-executable set per stage is exactly {1, max} —
+    that size must be a :data:`BATCH_BUCKETS` bucket, or prewarm (which
+    walks buckets) and dispatch would disagree on the shape set.  Used
+    by :mod:`.dispatch_policy` when deriving coalescer knobs.
+    """
+    return bucket_for(max(int(max_batch), 1), BATCH_BUCKETS)

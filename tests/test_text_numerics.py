@@ -123,6 +123,35 @@ def test_currency_en():
     assert _words(norm_en("$1 only")) == "one dollar only"
 
 
+def test_currency_single_fractional_digit():
+    # ISSUE-1 satellite: "$12.5" means fifty cents (tenths of the major
+    # unit), not five cents — and not decimal fall-through "$12 point 5"
+    assert _words(norm_en("$12.5 total")) == \
+        "twelve dollars fifty cents total"
+    assert _words(norm_de("12,5 € gesamt")) == \
+        "zwölf euro fünfzig sent gesamt"
+
+
+def test_currency_magnitude_words_decline_cents_reading():
+    # review finding r06: "$3.5 billion" is a scaled number, not three
+    # dollars fifty cents — the currency pass declines and the decimal
+    # pass reads the figure
+    assert _words(norm_en("a $3.5 billion deal")) == \
+        "a $ three point five billion deal"
+    assert _words(norm_en("$1.25 million raised")) == \
+        "$ one point two five million raised"
+    assert _words(norm_de("3,5 € millionen kosten")) == \
+        "3,5 € millionen kosten".replace("3,5 €", "drei komma fünf €")
+
+
+def test_currency_three_fractional_digits_fall_through():
+    # 3+ fractional digits are not a cents amount: the currency pass
+    # declines the match entirely and the decimal pass reads the number
+    # (the orphan symbol is dropped later, at phoneme encoding)
+    assert _words(norm_en("$1.999 per unit")) == \
+        "$ one point nine nine nine per unit"
+
+
 def test_currency_de():
     assert _words(norm_de("12,50 € bitte")) == \
         "zwölf euro fünfzig sent bitte"

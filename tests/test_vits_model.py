@@ -61,11 +61,31 @@ def test_phonemes_to_ids_interleaved_pad():
 
 
 def test_phonemes_to_ids_multi_id_chars():
+    # reference parity (piper/src/lib.rs phonemes_to_input_ids): a
+    # multi-id map entry contributes only its FIRST id, then the
+    # interleaved pad — never the whole list
     mc = ModelConfig.from_dict({
         "phoneme_id_map": {"_": [0], "^": [1], "$": [2], "ʧ": [5, 6]},
         "num_symbols": 7,
     })
-    assert mc.phonemes_to_ids("ʧ") == [1, 5, 6, 0, 2]
+    assert mc.phonemes_to_ids("ʧ") == [1, 5, 0, 2]
+    # the diag variant agrees and reports no drops for a mapped symbol
+    ids, dropped = mc.phonemes_to_ids_diag("ʧʧ")
+    assert ids == [1, 5, 0, 5, 0, 2]
+    assert dropped == []
+
+
+def test_phonemes_to_ids_empty_map_entry_drops_not_crashes():
+    # a present-but-empty entry in a user-supplied config must degrade
+    # like an unknown symbol, not IndexError the encode path
+    mc = ModelConfig.from_dict({
+        "phoneme_id_map": {"_": [0], "^": [1], "$": [2], "a": [3],
+                           "x": []},
+        "num_symbols": 5,
+    })
+    ids, dropped = mc.phonemes_to_ids_diag("axa")
+    assert ids == [1, 3, 0, 3, 0, 2]
+    assert dropped == ["x"]
 
 
 def test_synthesis_config_roundtrip(voice):

@@ -8,10 +8,16 @@ with A/B toggles over the optimization stack, so each round commits
 *measured ratios* regardless of tunnel health:
 
 - batch RTF: sub-pixel transposed convs (default) vs the naive
-  ``lhs_dilation`` lowering (``SONATA_TCONV=naive``)
-- streaming TTFB/throughput: shared stream coalescers (default) vs
-  one-request-per-dispatch (``SONATA_STREAM_COALESCE=0``), the
-  reference's thread-per-stream serving shape
+  ``lhs_dilation`` lowering (``SONATA_TCONV=naive``), the bfloat16
+  decoder compute policy (``SONATA_COMPUTE_DTYPE=bfloat16``), and the
+  streaming window-decode buffer-donation annotation forced on
+  (``SONATA_DONATE=1``; default off — see
+  ``utils/dispatch_policy.should_donate``)
+- streaming TTFB/throughput: the backend-adaptive dispatch policy's
+  default (``auto`` → per-request dispatch on CPU) vs coalescing forced
+  on (``SONATA_DISPATCH_POLICY=on``, the pre-policy default shape) vs
+  the legacy per-request override (``SONATA_STREAM_COALESCE=0``) — the
+  last two bracket what the policy chooses between
 
 Each configuration runs in its own subprocess (the toggles are read at
 trace time; a warm jit cache would mask an in-process flip).
@@ -21,9 +27,8 @@ Usage::
     python tools/bench_cpu.py [--out BENCH_CPU_rNN.json]
                               [--streaming-out BENCH_STREAMING_CPU_rNN.json]
 
-Writes two JSON artifacts: a batch file with both tconv variants and a
-streaming file with both coalescing variants, each entry tagged
-``platform: "cpu"`` with the exact env toggles used.
+Writes two JSON artifacts, each entry tagged ``platform: "cpu"`` with the
+exact env toggles used, plus cross-config ratios.
 """
 
 from __future__ import annotations
@@ -37,6 +42,19 @@ import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+
+BATCH_CONFIGS = (
+    ("baseline", {}),  # sub-pixel tconv, f32, donation off (the defaults)
+    ("naive_tconv", {"SONATA_TCONV": "naive"}),
+    ("bf16", {"SONATA_COMPUTE_DTYPE": "bfloat16"}),
+    ("donation", {"SONATA_DONATE": "1"}),
+)
+
+STREAMING_CONFIGS = (
+    ("default_policy", {}),  # SONATA_DISPATCH_POLICY=auto
+    ("coalescing_forced_on", {"SONATA_DISPATCH_POLICY": "on"}),
+    ("coalescing_off", {"SONATA_STREAM_COALESCE": "0"}),
+)
 
 
 def run_bench(script: str, env_extra: dict, timeout_s: float = 3600):
@@ -64,34 +82,45 @@ def run_bench(script: str, env_extra: dict, timeout_s: float = 3600):
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="BENCH_CPU_r05.json")
-    ap.add_argument("--streaming-out", default="BENCH_STREAMING_CPU_r05.json")
+    ap.add_argument("--out", default="BENCH_CPU_r06.json")
+    ap.add_argument("--streaming-out", default="BENCH_STREAMING_CPU_r06.json")
     ap.add_argument("--skip-streaming", action="store_true")
+    ap.add_argument("--skip-batch", action="store_true")
     args = ap.parse_args()
 
-    batch = {"platform": "cpu", "note": (
-        "host-CPU regression numbers (TPU tunnel down; absolute values are "
-        "NOT comparable to the BASELINE.md TPU target — the ratios are the "
-        "deliverable)"), "configs": {}}
-    for name, env in (("subpixel_tconv", {}),
-                      ("naive_tconv", {"SONATA_TCONV": "naive"})):
-        print(f"[bench_cpu] batch config {name} ...", flush=True)
-        batch["configs"][name] = {"env": env, **run_bench("bench.py", env)}
-    try:
-        a = batch["configs"]["subpixel_tconv"]["results"][0]["value"]
-        b = batch["configs"]["naive_tconv"]["results"][0]["value"]
-        if a and b:
-            batch["subpixel_speedup"] = round(b / a, 3)
-    except (KeyError, IndexError, TypeError):
-        pass
-    Path(args.out).write_text(json.dumps(batch, indent=1) + "\n")
-    print(f"[bench_cpu] wrote {args.out}", flush=True)
+    note = ("host-CPU regression numbers (TPU tunnel down; absolute values "
+            "are NOT comparable to the BASELINE.md TPU target — the ratios "
+            "are the deliverable)")
+
+    if not args.skip_batch:
+        batch = {"platform": "cpu", "note": note,
+                 "cpu_count": os.cpu_count(), "configs": {}}
+        for name, env in BATCH_CONFIGS:
+            print(f"[bench_cpu] batch config {name} ...", flush=True)
+            batch["configs"][name] = {"env": env,
+                                      **run_bench("bench.py", env)}
+
+        def rtf(cfg):
+            try:
+                return batch["configs"][cfg]["results"][0]["value"]
+            except (KeyError, IndexError, TypeError):
+                return None
+
+        base = rtf("baseline")
+        # ratio > 1.0 ⇒ the baseline beats (is faster than) that config;
+        # for naive_tconv that reads as "sub-pixel speedup"
+        for cfg in ("naive_tconv", "bf16", "donation"):
+            other = rtf(cfg)
+            if base and other:
+                batch[f"{cfg}_vs_baseline_rtf_ratio"] = round(other / base, 3)
+        Path(args.out).write_text(json.dumps(batch, indent=1) + "\n")
+        print(f"[bench_cpu] wrote {args.out}", flush=True)
 
     if args.skip_streaming:
         return
-    streaming = {"platform": "cpu", "note": batch["note"], "configs": {}}
-    for name, env in (("coalescing_on", {}),
-                      ("coalescing_off", {"SONATA_STREAM_COALESCE": "0"})):
+    streaming = {"platform": "cpu", "note": note,
+                 "cpu_count": os.cpu_count(), "configs": {}}
+    for name, env in STREAMING_CONFIGS:
         print(f"[bench_cpu] streaming config {name} ...", flush=True)
         streaming["configs"][name] = {
             "env": env, **run_bench("bench_streaming.py", env)}
@@ -102,12 +131,25 @@ def main() -> None:
                 return r.get("value")
         return None
 
-    for m in ("streaming_ttfb_p50_at_4_streams",
+    # the acceptance ratios: default policy vs both forced shapes, at
+    # every concurrency level plus aggregate throughput.  TTFB ratios
+    # > 1.0 ⇒ the default beats (has lower TTFB than) the named config.
+    for m in ("streaming_ttfb_p50",
+              "streaming_ttfb_p50_at_4_streams",
               "streaming_ttfb_p50_at_8_streams"):
-        on, off = metric("coalescing_on", m), metric("coalescing_off", m)
-        if on and off:
-            streaming[f"{m}_coalescing_gain"] = round(off / on, 3)
-    Path(args.streaming_out).write_text(json.dumps(streaming, indent=1) + "\n")
+        d = metric("default_policy", m)
+        for cfg in ("coalescing_forced_on", "coalescing_off"):
+            o = metric(cfg, m)
+            if d and o:
+                streaming[f"{m}_default_vs_{cfg}"] = round(o / d, 3)
+    d = metric("default_policy", "concurrent_streaming_audio_s_per_s")
+    for cfg in ("coalescing_forced_on", "coalescing_off"):
+        o = metric(cfg, "concurrent_streaming_audio_s_per_s")
+        if d and o:
+            # throughput: > 1.0 ⇒ the default delivers more audio-s/s
+            streaming[f"throughput_default_vs_{cfg}"] = round(d / o, 3)
+    Path(args.streaming_out).write_text(
+        json.dumps(streaming, indent=1) + "\n")
     print(f"[bench_cpu] wrote {args.streaming_out}", flush=True)
 
 

@@ -12,10 +12,19 @@ while true; do
     SONATA_BENCH_INIT_RETRIES=1 timeout 1800 python bench.py > /tmp/bench_tpu.out 2>>"$LOG"
     rc1=$?
     tail -1 /tmp/bench_tpu.out > BENCH_TPU_r05.json
-    SONATA_BENCH_INIT_RETRIES=1 timeout 1800 python bench_streaming.py > BENCH_STREAMING_TPU_r05.json 2>>"$LOG"
+    # capture to a temp file and extract only the JSON metric lines, like
+    # the batch path: writing raw stdout straight into the artifact let a
+    # crashed run commit tracebacks/partial output as "results"
+    SONATA_BENCH_INIT_RETRIES=1 timeout 1800 python bench_streaming.py > /tmp/bench_streaming_tpu.out 2>>"$LOG"
     rc2=$?
+    grep -a '^{' /tmp/bench_streaming_tpu.out > BENCH_STREAMING_TPU_r05.json
     echo "$(date -u +%FT%TZ) bench rc=$rc1 streaming rc=$rc2" >> "$LOG"
-    if [ $rc1 -eq 0 ] && grep -q '"value": [0-9]' BENCH_TPU_r05.json; then
+    # success gate covers BOTH benches and BOTH artifacts' validity — a
+    # failed streaming bench must not let the watcher exit having
+    # committed a corrupt/empty streaming artifact
+    if [ $rc1 -eq 0 ] && [ $rc2 -eq 0 ] \
+        && grep -q '"value": [0-9]' BENCH_TPU_r05.json \
+        && grep -q '"value": [0-9]' BENCH_STREAMING_TPU_r05.json; then
       echo "$(date -u +%FT%TZ) capture OK — watcher done" >> "$LOG"
       exit 0
     fi
