@@ -62,6 +62,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--info", action="store_true",
                    help="print voice metadata as JSON and exit")
+    p.add_argument("--timeout-s", type=float, default=None,
+                   help="per-request synthesis deadline in seconds "
+                        "(default $SONATA_REQUEST_TIMEOUT_S, unset = "
+                        "no deadline; streams stop with an error when "
+                        "it expires)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve Prometheus /metrics and /healthz;/readyz "
+                        "on this port while the process runs (0 = "
+                        "ephemeral; default $SONATA_METRICS_PORT or "
+                        "disabled) — useful with the stdin JSON loop")
     return p
 
 
@@ -99,12 +109,52 @@ def _stream_for(synth: SpeechSynthesizer, args, text: str):
     return synth.synthesize_parallel(text, cfg)
 
 
+def _deadline_for(args):
+    """Per-request deadline from --timeout-s (None = unbounded: the CLI
+    historically has no timeout, so unlike the server there is no
+    implicit 120 s default — only an explicit flag or env opts in)."""
+    timeout = args.timeout_s
+    if timeout is None:
+        raw = os.environ.get("SONATA_REQUEST_TIMEOUT_S")
+        if raw:
+            try:
+                timeout = float(raw)
+            except ValueError:
+                timeout = None
+    if timeout is None or timeout <= 0:
+        return None
+    from ..serving import Deadline
+
+    return Deadline.after(timeout)
+
+
 def process_synthesis_request(synth: SpeechSynthesizer, args, text: str,
                               out_path: str | None) -> None:
-    """Synthesize one request to a file or stdout (``main.rs:126-182``)."""
+    """Synthesize one request to a file or stdout (``main.rs:126-182``).
+
+    With ``--timeout-s`` (or ``SONATA_REQUEST_TIMEOUT_S``) the stream is
+    checked between items and fails with DeadlineExceeded when the
+    request runs over — same contract as the gRPC server."""
     t0 = time.perf_counter()
+    deadline = _deadline_for(args)
+
+    def guarded(stream):
+        try:
+            for audio in stream:
+                if deadline is not None:
+                    deadline.raise_if_expired("synthesis")
+                yield audio
+        except BaseException:
+            # a realtime stream's producer keeps synthesizing into its
+            # queue unless told to stop — on expiry (or any abandon),
+            # cancel it so a timed-out request stops costing device time
+            cancel = getattr(stream, "cancel", None)
+            if cancel is not None:
+                cancel()
+            raise
+
     if out_path == "-":
-        stream = _stream_for(synth, args, text)
+        stream = guarded(_stream_for(synth, args, text))
         raw = sys.stdout.buffer
         for audio in stream:
             raw.write(audio.as_wave_bytes())  # raw samples (main.rs:167-182)
@@ -113,7 +163,7 @@ def process_synthesis_request(synth: SpeechSynthesizer, args, text: str,
         from ..audio import AudioSamples, write_wave_samples_to_file
 
         merged = AudioSamples()
-        for audio in _stream_for(synth, args, text):
+        for audio in guarded(_stream_for(synth, args, text)):
             merged.merge(audio.samples)
         write_wave_samples_to_file(
             out_path, merged.to_i16(),
@@ -122,7 +172,8 @@ def process_synthesis_request(synth: SpeechSynthesizer, args, text: str,
                  (time.perf_counter() - t0) * 1e3)
     else:
         # no sink: drain and report timing (useful for benchmarking)
-        n = sum(len(a.samples) for a in _stream_for(synth, args, text))
+        n = sum(len(a.samples)
+                for a in guarded(_stream_for(synth, args, text)))
         sr = synth.audio_output_info().sample_rate
         elapsed = time.perf_counter() - t0
         print(f"synthesized {n / sr:.2f}s of audio in {elapsed * 1e3:.1f} ms "
@@ -213,14 +264,36 @@ def main(argv=None) -> int:
         if policy is not None:  # visible serving shape (backend-adaptive)
             log.info(policy.describe())
         synth = SpeechSynthesizer(voice)
+        runtime = None
+        if args.metrics_port is not None or os.environ.get(
+                "SONATA_METRICS_PORT"):
+            # same metrics/health plane as the gRPC server — lets a
+            # long-running stdin JSON loop be scraped and probed
+            from ..serving import ServingRuntime
+
+            runtime = ServingRuntime()
+            http_port = runtime.start_http(args.metrics_port)
+            if http_port is not None:
+                log.info("metrics/health plane on http://127.0.0.1:%d",
+                         http_port)
+                # only live sources: dispatch_stats reads the voice's
+                # real counters (the CLI has no per-request RTF
+                # aggregation path, so no rtf_counter here)
+                runtime.register_voice(
+                    "cli", dispatch_stats=synth.dispatch_stats)
+                runtime.health.set_ready("voice loaded")
         _apply_scales(synth, args)
         text = args.text
         if args.input_file:
             text = Path(args.input_file).read_text(encoding="utf-8")
-        if text is not None:
-            process_synthesis_request(synth, args, text, args.output)
-        else:
-            stdin_json_loop(synth, args)
+        try:
+            if text is not None:
+                process_synthesis_request(synth, args, text, args.output)
+            else:
+                stdin_json_loop(synth, args)
+        finally:
+            if runtime is not None:
+                runtime.close()
     except SonataError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1

@@ -133,3 +133,15 @@ class WaveSamples(Message):
     FIELDS = {
         "wav_samples": Field(1, "bytes"),
     }
+
+
+class HealthStatus(Message):
+    """sonata-tpu extension: liveness/readiness over the serving protocol
+    (mirrors the HTTP /healthz + /readyz plane, ``serving/health.py``)."""
+
+    FIELDS = {
+        "live": Field(1, "bool"),
+        "ready": Field(2, "bool"),
+        "reason": Field(3, "string"),
+        "version": Field(4, "string"),
+    }

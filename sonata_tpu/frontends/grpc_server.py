@@ -21,6 +21,16 @@ TPU-native analogue of the reference's ``sonata-grpc`` frontend
 - binds ``127.0.0.1:$SONATA_GRPC_SERVER_PORT``, default 49314
   (``main.rs:17,437-440``); logging env ``SONATA_GRPC`` (``:413-416``).
 
+Unlike the reference — which queues unboundedly and waits forever — the
+server runs behind a :class:`~sonata_tpu.serving.ServingRuntime`
+(admission control, per-request deadlines, a Prometheus ``/metrics`` +
+``/healthz``/``/readyz`` HTTP plane, and a ``CheckHealth`` unary):
+excess load sheds with ``RESOURCE_EXHAUSTED``, requests that outlive
+their (client or ``SONATA_REQUEST_TIMEOUT_S`` default) deadline fail
+with ``DEADLINE_EXCEEDED`` before reaching a device dispatch, and
+readiness flips only after preloaded voices complete a warmup
+synthesis (see docs/DEPLOY.md "Serving runtime").
+
 grpcio is used through a ``GenericRpcHandler`` with our own message codec —
 no protoc plugin exists in this environment.
 """
@@ -31,6 +41,9 @@ import hashlib
 import logging
 import os
 import threading
+import time
+from concurrent.futures import CancelledError
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from pathlib import Path
 from typing import Iterator, Optional
 
@@ -39,6 +52,7 @@ import grpc
 from .. import __version__
 from ..core import FailedToLoadResource, OperationError, SonataError
 from ..models import PiperVoice, from_config_path
+from ..serving import Deadline, DeadlineExceeded, Overloaded, ServingRuntime
 from ..synth import AudioOutputConfig, SpeechSynthesizer
 from ..utils.profiling import RtfCounter
 from . import grpc_messages as pb
@@ -73,7 +87,11 @@ class _Voice:
 
 
 def _status_for(e: SonataError) -> grpc.StatusCode:
-    # main.rs:47-59 mapping
+    # main.rs:47-59 mapping, extended with the serving-runtime errors
+    if isinstance(e, Overloaded):
+        return grpc.StatusCode.RESOURCE_EXHAUSTED
+    if isinstance(e, DeadlineExceeded):
+        return grpc.StatusCode.DEADLINE_EXCEEDED
     if isinstance(e, FailedToLoadResource):
         return grpc.StatusCode.NOT_FOUND
     if isinstance(e, OperationError):
@@ -86,13 +104,16 @@ class SonataGrpcService:
     (``main.rs:76``)."""
 
     def __init__(self, mesh=None, seed: int = 0,
-                 continuous_batching: bool = False):
+                 continuous_batching: bool = False,
+                 runtime: Optional[ServingRuntime] = None):
         self._voices: dict[str, _Voice] = {}
         self._lock = threading.RLock()
         self._loading: dict[str, threading.Lock] = {}
         self._mesh = mesh
         self._seed = seed
         self._continuous_batching = continuous_batching
+        self.runtime = runtime if runtime is not None else ServingRuntime()
+        self._draining = threading.Event()
 
     # -- helpers -------------------------------------------------------------
     def _get(self, voice_id: str, context) -> _Voice:
@@ -142,10 +163,7 @@ class SonataGrpcService:
             if dispatch_stats is not None:
                 ds = dispatch_stats()
                 if v.scheduler is not None:
-                    s = dict(v.scheduler.stats)
-                    s["coalescing_ratio"] = round(
-                        s["requests"] / max(s["dispatches"], 1), 3)
-                    ds["scheduler"] = s
+                    ds["scheduler"] = v.scheduler.stats_view()
                 log.info("voice %s dispatch: %s", v.voice_id,
                          {k: val for k, val in ds.items()
                           if k != "policy"})
@@ -163,28 +181,52 @@ class SonataGrpcService:
         # one load instead of each importing the model (the reference holds
         # its registry lock across the load, main.rs:83-98; a per-voice
         # lock keeps other voices servable meanwhile)
-        with self._lock:
-            existing = self._voices.get(vid)
-            if existing is None:
-                load_lock = self._loading.setdefault(vid, threading.Lock())
-        if existing is not None:  # idempotent per path (main.rs:96-98)
-            return self._voice_info(existing)
-        with load_lock:
+        while True:
             with self._lock:
                 existing = self._voices.get(vid)
-            if existing is not None:
+                if existing is None:
+                    load_lock = self._loading.setdefault(
+                        vid, threading.Lock())
+            if existing is not None:  # idempotent per path (main.rs:96-98)
                 return self._voice_info(existing)
-            try:
-                voice = from_config_path(request.config_path, seed=self._seed,
-                                         mesh=self._mesh)
-            except SonataError as e:
-                context.abort(_status_for(e), str(e))
-            v = _Voice(voice, request.config_path, vid,
-                       continuous_batching=self._continuous_batching)
-            with self._lock:
-                self._voices[vid] = v
-                self._loading.pop(vid, None)
+            with load_lock:
+                with self._lock:
+                    # a failed load pops its _loading entry (below), so a
+                    # lock acquired before that pop may be stale — a later
+                    # caller could already be loading under a fresh lock.
+                    # Only the holder of the CURRENTLY mapped lock may
+                    # load; stale holders retry from the top (and then
+                    # either find the voice or serialize on the new lock).
+                    if self._loading.get(vid) is not load_lock:
+                        continue
+                    existing = self._voices.get(vid)
+                if existing is not None:
+                    return self._voice_info(existing)
+                # the finally pops the load-lock entry on EVERY exit: a
+                # failed load used to leak it (context.abort raises,
+                # skipping the pop), growing _loading by one dead Lock
+                # per bad path
+                try:
+                    try:
+                        voice = from_config_path(request.config_path,
+                                                 seed=self._seed,
+                                                 mesh=self._mesh)
+                    except SonataError as e:
+                        context.abort(_status_for(e), str(e))
+                    v = _Voice(voice, request.config_path, vid,
+                               continuous_batching=self._continuous_batching)
+                    with self._lock:
+                        self._voices[vid] = v
+                    break
+                finally:
+                    with self._lock:
+                        self._loading.pop(vid, None)
         log.info("loaded voice %s from %s", vid, request.config_path)
+        # export the voice's existing observability (RTF aggregate,
+        # dispatch counters, scheduler queue) on the metrics plane
+        self.runtime.register_voice(vid, rtf_counter=v.rtf,
+                                    dispatch_stats=v.synth.dispatch_stats,
+                                    scheduler=v.scheduler)
         # resolve + surface the backend-adaptive dispatch policy at load
         # time, so the serving shape (coalescing on/off, batch/wait knobs,
         # probe constants) is in the log before traffic arrives
@@ -239,10 +281,62 @@ class SonataGrpcService:
                                  pitch=args.pitch,
                                  appended_silence_ms=args.appended_silence_ms)
 
+    # -- serving-runtime helpers ----------------------------------------------
+    def _abort_sonata(self, context, rpc: str, e: SonataError) -> None:
+        """Record the failure on the metrics plane, then abort (raises)."""
+        code = _status_for(e)
+        self.runtime.failures.labels(rpc=rpc, code=code.name).inc()
+        context.abort(code, str(e))
+
+    @staticmethod
+    def _await_future(fut, deadline: Optional[Deadline]):
+        """Wait for a scheduler future, bounded by the request deadline.
+
+        The scheduler's gather loop fails expired items itself; this
+        guard covers the remaining window — an item already packed into a
+        long-running dispatch when its deadline passes, where only the
+        waiter can observe the expiry promptly."""
+        timeout = None
+        if deadline is not None:
+            rem = deadline.remaining()
+            if rem is not None:
+                # small grace so the scheduler's own expiry (the accurate
+                # error) wins the race when both fire together
+                timeout = max(rem, 0.0) + 0.05
+        try:
+            return fut.result(timeout=timeout)
+        except FutureTimeoutError:
+            fut.cancel()  # may already be running; best effort
+            raise DeadlineExceeded(
+                "deadline exceeded waiting for device dispatch") from None
+        except CancelledError:
+            # the scheduler cancelled it because the client went away
+            raise DeadlineExceeded("request cancelled") from None
+
+    def _admitted(self, request, context, rpc: str, body):
+        """Run a streaming RPC body inside one admission slot; sheds with
+        RESOURCE_EXHAUSTED when the controller is at capacity."""
+        rt = self.runtime
+        try:
+            with rt.admission.admit():
+                rt.requests.labels(rpc=rpc).inc()
+                yield from body(request, context)
+        except Overloaded as e:
+            self._abort_sonata(context, rpc, e)
+
     def SynthesizeUtterance(self, request: pb.Utterance,
                             context) -> Iterator[pb.SynthesisResult]:
+        return self._admitted(request, context, "SynthesizeUtterance",
+                              self._synthesize_utterance)
+
+    def _synthesize_utterance(self, request: pb.Utterance,
+                              context) -> Iterator[pb.SynthesisResult]:
+        rt = self.runtime
         v = self._get(request.voice_id, context)
         cfg = self._speech_args_config(request.speech_args)
+        deadline = rt.deadline_for(context)
+        t0 = time.monotonic()
+        first_at: Optional[float] = None
         try:
             if v.scheduler is not None and cfg is None:
                 # continuous batching: submit every sentence up front so a
@@ -250,18 +344,24 @@ class SonataGrpcService:
                 # requests, then stream results in order.  The speaker is
                 # snapshotted per request — concurrent clients that set
                 # different speakers via SetSynthesisOptions each keep
-                # their own voice inside a shared dispatch.
+                # their own voice inside a shared dispatch.  Every item
+                # carries the request deadline, so queue-stuck sentences
+                # are dropped before they reach a device dispatch.
                 sc = v.voice.get_fallback_synthesis_config()
                 sid = sc.speaker[1] if sc.speaker else None
                 futures = [v.scheduler.submit(sentence, speaker=sid,
-                                              scales=sc)
+                                              scales=sc, deadline=deadline)
                            for sentence in v.synth.phonemize_text(request.text)]
                 for fut in futures:
-                    audio = fut.result()
+                    audio = self._await_future(fut, deadline)
                     v.rtf.record(audio)
+                    if first_at is None:
+                        first_at = time.monotonic()
+                        rt.ttfb.observe(first_at - t0)
                     yield pb.SynthesisResult(
                         wav_samples=audio.as_wave_bytes(),
                         rtf=audio.real_time_factor())
+                rt.synth_latency.observe(time.monotonic() - t0)
                 self._maybe_log_rtf(v)
                 return
             if request.synthesis_mode in (pb.SynthesisMode.PARALLEL,
@@ -270,13 +370,23 @@ class SonataGrpcService:
             else:
                 stream = v.synth.synthesize_lazy(request.text, cfg)
             for audio in stream:
+                if deadline.cancelled:
+                    return  # client went away; stop synthesizing
+                deadline.raise_if_expired()
                 v.rtf.record(audio)
+                if first_at is None:
+                    first_at = time.monotonic()
+                    rt.ttfb.observe(stream.ttfb_s or (first_at - t0))
                 yield pb.SynthesisResult(
                     wav_samples=audio.as_wave_bytes(),
                     rtf=audio.real_time_factor())  # main.rs:345-348
+            rt.synth_latency.observe(time.monotonic() - t0)
             self._maybe_log_rtf(v)
+        except DeadlineExceeded as e:
+            rt.expired.inc()
+            self._abort_sonata(context, "SynthesizeUtterance", e)
         except SonataError as e:
-            context.abort(_status_for(e), str(e))
+            self._abort_sonata(context, "SynthesizeUtterance", e)
 
     def UnloadVoice(self, request: pb.VoiceIdentifier,
                     context) -> pb.Empty:
@@ -289,17 +399,34 @@ class SonataGrpcService:
         if v is None:
             context.abort(grpc.StatusCode.NOT_FOUND,
                           f"no voice with id {request.voice_id}")
-        v.voice.close()
+        self._close_voice(v)
         log.info("unloaded voice %s", request.voice_id)
         return pb.Empty()
 
+    def _close_voice(self, v: _Voice) -> None:
+        """Tear one voice down in dependency order: scheduler first (its
+        queued futures fail with the OperationError the docstring
+        promises, before the model underneath disappears), then the
+        voice's own worker threads, then the metrics series."""
+        if v.scheduler is not None:
+            v.scheduler.shutdown()
+        v.voice.close()
+        self.runtime.unregister_voice(v.voice_id)
+
     def shutdown(self) -> None:
         """Close every loaded voice (server termination path)."""
+        # same lock as the warmup's check-and-set_ready: the pair must be
+        # atomic or a warmup finishing mid-shutdown could re-flip a
+        # closed replica to ready
+        with self._lock:
+            self._draining.set()
+            self.runtime.health.set_not_ready("shutting down")
         with self._lock:
             voices = list(self._voices.values())
             self._voices.clear()
         for v in voices:
-            v.voice.close()
+            self._close_voice(v)
+        self.runtime.close()
 
     def ListVoices(self, request: pb.Empty, context) -> pb.VoiceList:
         """sonata-tpu extension: catalog of loaded voices (the reference
@@ -325,20 +452,86 @@ class SonataGrpcService:
 
     def SynthesizeUtteranceRealtime(self, request: pb.Utterance,
                                     context) -> Iterator[pb.WaveSamples]:
+        return self._admitted(request, context,
+                              "SynthesizeUtteranceRealtime",
+                              self._synthesize_realtime)
+
+    def _synthesize_realtime(self, request: pb.Utterance,
+                             context) -> Iterator[pb.WaveSamples]:
+        rt = self.runtime
         v = self._get(request.voice_id, context)
         cfg = self._speech_args_config(request.speech_args)
+        deadline = rt.deadline_for(context)
         # per-request chunk negotiation (sonata-tpu extension); absent/0
         # fields keep the reference's hardcoded schedule (main.rs:383)
         chunk_size = request.realtime_chunk_size or 55
         chunk_padding = request.realtime_chunk_padding or 3
+        t0 = time.monotonic()
+        stream = None
         try:
             stream = v.synth.synthesize_streamed(
                 request.text, cfg, chunk_size=chunk_size,
                 chunk_padding=chunk_padding)
+            first = True
             for chunk in stream:
+                if deadline.cancelled:
+                    return  # client went away; the producer is cancelled
+                    # by the finally below
+                deadline.raise_if_expired()
+                if first:
+                    first = False
+                    rt.ttfb.observe(stream.ttfb_s
+                                    or (time.monotonic() - t0))
                 yield pb.WaveSamples(wav_samples=chunk.as_wave_bytes())
+            rt.synth_latency.observe(time.monotonic() - t0)
+        except DeadlineExceeded as e:
+            rt.expired.inc()
+            self._abort_sonata(context, "SynthesizeUtteranceRealtime", e)
         except SonataError as e:
-            context.abort(_status_for(e), str(e))
+            self._abort_sonata(context, "SynthesizeUtteranceRealtime", e)
+        finally:
+            # stop the producer thread on every exit (deadline, client
+            # disconnect, error) so it does not keep pushing chunks into
+            # a queue nobody drains
+            if stream is not None:
+                stream.cancel()
+
+    # -- health plane ----------------------------------------------------------
+    def CheckHealth(self, request: pb.Empty, context) -> pb.HealthStatus:
+        """gRPC mirror of the HTTP /healthz + /readyz probes, for
+        load balancers that health-check over the serving protocol."""
+        h = self.runtime.health.snapshot()
+        return pb.HealthStatus(live=h["live"], ready=h["ready"],
+                               reason=h["reason"], version=__version__)
+
+    def warmup_and_mark_ready(self) -> None:
+        """Synthesize one utterance through every loaded voice, then flip
+        readiness.  The warmup pays the XLA compile of the common
+        executables up front, so the readiness gate guarantees the first
+        real request is served at steady-state latency (rolling-restart
+        contract, docs/DEPLOY.md)."""
+        with self._lock:
+            voices = list(self._voices.values())
+        try:
+            for v in voices:
+                for _audio in v.synth.synthesize_parallel("Ready."):
+                    pass
+            # a shutdown that began while the warmup synthesized (slow
+            # cold compile) must win: never flip a draining replica back
+            # into the serving set.  Check and set under the same lock
+            # shutdown() uses, so the pair is atomic against it.
+            with self._lock:
+                if self._draining.is_set():
+                    log.info("warmup finished during shutdown; staying "
+                             "not-ready")
+                    return
+                self.runtime.health.set_ready(
+                    f"{len(voices)} voice(s) loaded and warmed")
+            log.info("readiness: %s", self.runtime.health.reason)
+        except Exception:
+            # stay not-ready: the orchestrator keeps traffic away and
+            # retries the rollout rather than sending users into compiles
+            log.exception("warmup failed; readiness stays false")
 
 
 # method name → (request type, response type, is_server_streaming)
@@ -353,6 +546,7 @@ _METHODS = {
     "SynthesizeUtteranceRealtime": (pb.Utterance, pb.WaveSamples, True),
     "ListVoices": (pb.Empty, pb.VoiceList, False),
     "UnloadVoice": (pb.VoiceIdentifier, pb.Empty, False),
+    "CheckHealth": (pb.Empty, pb.HealthStatus, False),
 }
 
 
@@ -384,13 +578,24 @@ class _Handler(grpc.GenericRpcHandler):
 
 def create_server(port: Optional[int] = None, *, mesh=None, seed: int = 0,
                   max_workers: int = 16, continuous_batching: bool = False,
-                  host: str = "127.0.0.1") -> tuple[grpc.Server, int]:
+                  host: str = "127.0.0.1",
+                  runtime: Optional[ServingRuntime] = None,
+                  max_in_flight: Optional[int] = None,
+                  max_queue_depth: Optional[int] = None,
+                  request_timeout_s: Optional[float] = None,
+                  metrics_port: Optional[int] = None
+                  ) -> tuple[grpc.Server, int]:
     from concurrent.futures import ThreadPoolExecutor
 
     port = port if port is not None else int(
         os.environ.get("SONATA_GRPC_SERVER_PORT", DEFAULT_PORT))
+    if runtime is None:
+        runtime = ServingRuntime(max_in_flight=max_in_flight,
+                                 max_queue_depth=max_queue_depth,
+                                 request_timeout_s=request_timeout_s)
     service = SonataGrpcService(mesh=mesh, seed=seed,
-                                continuous_batching=continuous_batching)
+                                continuous_batching=continuous_batching,
+                                runtime=runtime)
     server = grpc.server(ThreadPoolExecutor(max_workers=max_workers,
                                             thread_name_prefix="sonata_grpc"))
     server.add_generic_rpc_handlers((_Handler(service),))
@@ -398,6 +603,13 @@ def create_server(port: Optional[int] = None, *, mesh=None, seed: int = 0,
     if bound == 0:
         raise OperationError(f"cannot bind {host}:{port}")
     server.sonata_service = service  # for startup hooks (e.g. prewarm)
+    server.sonata_runtime = runtime
+    # metrics/health HTTP plane: explicit port > SONATA_METRICS_PORT >
+    # disabled (0 binds an ephemeral port, runtime.http_port has it)
+    http_port = runtime.start_http(metrics_port)
+    if http_port is not None:
+        log.info("metrics/health plane on http://127.0.0.1:%d "
+                 "(/metrics /healthz /readyz)", http_port)
     return server, bound
 
 
@@ -442,6 +654,22 @@ def main(argv=None) -> int:
                          "buckets, streaming decoders) in the background "
                          "at startup, so first requests never wait on "
                          "XLA compilation")
+    ap.add_argument("--request-timeout-s", type=float, default=None,
+                    help="server-side default deadline for requests whose "
+                         "client set none (default: "
+                         "$SONATA_REQUEST_TIMEOUT_S or 120; <=0 disables)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve /metrics /healthz /readyz on this HTTP "
+                         "port (0 = ephemeral; default: "
+                         "$SONATA_METRICS_PORT or disabled)")
+    ap.add_argument("--max-in-flight", type=int, default=None,
+                    help="admission: max concurrently executing requests "
+                         "(default $SONATA_MAX_IN_FLIGHT or 32)")
+    ap.add_argument("--max-queue-depth", type=int, default=None,
+                    help="admission: max requests waiting beyond "
+                         "--max-in-flight before shedding with "
+                         "RESOURCE_EXHAUSTED (default "
+                         "$SONATA_MAX_QUEUE_DEPTH or 128)")
     args = ap.parse_args(argv)
 
     mesh = None
@@ -455,7 +683,11 @@ def main(argv=None) -> int:
         ap.error("--seq-parallel/--model-parallel require --mesh-devices")
 
     server, port = create_server(args.port, host=args.host, mesh=mesh,
-                                 continuous_batching=args.continuous_batching)
+                                 continuous_batching=args.continuous_batching,
+                                 request_timeout_s=args.request_timeout_s,
+                                 metrics_port=args.metrics_port,
+                                 max_in_flight=args.max_in_flight,
+                                 max_queue_depth=args.max_queue_depth)
     server.start()
     log.info("sonata-tpu gRPC server v%s listening on %s:%d",
              __version__, args.host, port)
@@ -470,11 +702,25 @@ def main(argv=None) -> int:
             for cfg in args.voice:
                 info = stub(pb.VoicePath(config_path=cfg))
                 log.info("preloaded voice %s", info.voice_id)
+
+            def startup():
+                # prewarm (broad shape coverage) before the warmup that
+                # gates readiness — each preloaded voice answers one real
+                # utterance before the replica joins the serving set
+                if args.prewarm:
+                    server.sonata_service.prewarm_all()
+                server.sonata_service.warmup_and_mark_ready()
+
+            threading.Thread(target=startup, name="sonata_warmup",
+                             daemon=True).start()
+        else:
             if args.prewarm:
-                threading.Thread(target=server.sonata_service.prewarm_all,
-                                 name="sonata_prewarm", daemon=True).start()
-        elif args.prewarm:
-            log.warning("--prewarm does nothing without --voice")
+                log.warning("--prewarm does nothing without --voice")
+            # nothing to warm: an empty server is "ready" in the sense
+            # that it will serve LoadVoice immediately
+            runtime = getattr(server, "sonata_runtime", None)
+            if runtime is not None:  # absent on test stubs
+                runtime.health.set_ready("no preloaded voices")
         server.wait_for_termination()
     except KeyboardInterrupt:
         pass

@@ -1,0 +1,219 @@
+"""Serving runtime: admission control, deadlines, metrics, and health.
+
+The layer every production inference stack grows once it must survive
+overload and be observed in production (ROADMAP north star: heavy traffic
+from millions of users).  Four orthogonal pieces:
+
+- :mod:`.admission` — bounded admission (max in-flight + max queue
+  depth); excess load fails fast with :class:`Overloaded` instead of
+  queueing unboundedly.
+- :mod:`.deadlines` — per-request :class:`Deadline` propagated into the
+  batch scheduler, so expired or client-abandoned work is dropped
+  *before* it reaches a device dispatch.
+- :mod:`.metrics` — counter/gauge/histogram registry with Prometheus
+  text exposition over a stdlib HTTP server.
+- :mod:`.health` — liveness plus warmup-gated readiness for rolling
+  restarts.
+
+:class:`ServingRuntime` bundles one of each with the standard instrument
+set and the glue that exports existing observability (``RtfCounter``,
+``dispatch_stats()``, scheduler stats) per voice.  Frontends construct
+one runtime per process and thread it through their request paths; the
+whole layer is frontend-agnostic — nothing in here imports gRPC.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from .admission import AdmissionController, Overloaded
+from .deadlines import Deadline, DeadlineExceeded, default_timeout_s
+from .health import HealthState
+from .metrics import (
+    MetricsRegistry,
+    parse_prometheus_text,
+    resolve_metrics_port,
+    start_http_server,
+)
+
+__all__ = [
+    "AdmissionController",
+    "Overloaded",
+    "Deadline",
+    "DeadlineExceeded",
+    "default_timeout_s",
+    "HealthState",
+    "MetricsRegistry",
+    "parse_prometheus_text",
+    "resolve_metrics_port",
+    "start_http_server",
+    "ServingRuntime",
+]
+
+
+class ServingRuntime:
+    """One process's serving plane: admission + deadlines + metrics +
+    health, pre-wired with the standard instrument set."""
+
+    def __init__(self, *, max_in_flight: Optional[int] = None,
+                 max_queue_depth: Optional[int] = None,
+                 request_timeout_s: Optional[float] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.health = HealthState(registry=self.registry)
+        self.admission = AdmissionController(max_in_flight, max_queue_depth)
+        #: server-side default when the client sets no deadline; None
+        #: disables the default (explicit arg > env > 120 s).  An
+        #: explicit <= 0 means "disabled" — same contract as the env
+        #: knob — NOT "already expired".
+        if request_timeout_s is None:
+            self.request_timeout_s = default_timeout_s()
+        else:
+            self.request_timeout_s = (request_timeout_s
+                                      if request_timeout_s > 0 else None)
+        self.http: Optional["object"] = None
+        #: per-voice labeled series created by register_voice, so
+        #: unregister_voice removes exactly what was registered (no
+        #: twin hardcoded name lists to keep in sync)
+        self._voice_series: dict = {}
+
+        r = self.registry
+        self.requests = r.counter(
+            "sonata_requests_total", "Requests admitted, by rpc.")
+        self.failures = r.counter(
+            "sonata_request_failures_total",
+            "Requests failed, by rpc and grpc code.")
+        self.shed = r.counter(
+            "sonata_shed_total",
+            "Requests rejected at admission (RESOURCE_EXHAUSTED).")
+        self.expired = r.counter(
+            "sonata_deadline_expired_total",
+            "Requests or scheduler items dropped on an expired deadline.")
+        self.ttfb = r.histogram(
+            "sonata_ttfb_seconds",
+            "Time to first audio of a synthesis stream.")
+        self.synth_latency = r.histogram(
+            "sonata_synth_seconds",
+            "End-to-end synthesis request latency.")
+        r.gauge(
+            "sonata_in_flight",
+            "Admitted requests currently held (executing or queued)."
+        ).set_function(lambda: float(self.admission.in_flight))
+        r.gauge(
+            "sonata_admission_capacity",
+            "Admission ceiling (max_in_flight + max_queue_depth)."
+        ).set_function(lambda: float(self.admission.capacity))
+        # admission sheds counted inside the controller surface here too,
+        # so dashboards need only one source
+        self.shed.labels(source="admission").set_function(
+            lambda: float(self.admission.shed_total))
+        self._started_at = time.monotonic()
+        r.gauge("sonata_uptime_seconds", "Seconds since runtime start."
+                ).set_function(
+            lambda: time.monotonic() - self._started_at)
+
+    # -- deadlines -----------------------------------------------------------
+    def deadline_for(self, context=None) -> Deadline:
+        """Per-request deadline: client gRPC deadline > server default."""
+        if context is None:
+            return Deadline.after(self.request_timeout_s)
+        return Deadline.from_grpc_context(
+            context, default_s=self.request_timeout_s)
+
+    # -- HTTP plane ----------------------------------------------------------
+    def start_http(self, port: Optional[int] = None,
+                   host: Optional[str] = None) -> Optional[int]:
+        """Start the /metrics + /healthz + /readyz server if configured.
+
+        Returns the bound port, or None when disabled (no explicit port
+        and no ``SONATA_METRICS_PORT``)."""
+        resolved = resolve_metrics_port(port)
+        if resolved is None:
+            return None
+        self.http = start_http_server(self.registry, health=self.health,
+                                      port=resolved, host=host)
+        return self.http.port
+
+    @property
+    def http_port(self) -> Optional[int]:
+        return self.http.port if self.http is not None else None
+
+    # -- per-voice observability wiring --------------------------------------
+    def register_voice(self, voice_id: str, *, rtf_counter=None,
+                       dispatch_stats=None, scheduler=None) -> None:
+        """Export an existing voice's counters as labeled gauge series.
+
+        Everything is callback-based: the scrape reads live state, the
+        hot path pays nothing.  ``dispatch_stats`` is the zero-arg
+        callable from ``PiperVoice.dispatch_stats`` /
+        ``SpeechSynthesizer.dispatch_stats``.
+        """
+        r = self.registry
+        lbl = {"voice": voice_id}
+        owned = self._voice_series.setdefault(voice_id, [])
+
+        def voice_gauge(name, help, fn):
+            metric = r.gauge(name, help)
+            metric.labels(**lbl).set_function(fn)
+            owned.append(metric)
+
+        if rtf_counter is not None:
+            def stat(attr):
+                return lambda: float(getattr(rtf_counter.snapshot(), attr))
+
+            voice_gauge("sonata_voice_utterances",
+                        "Utterances synthesized, per voice.",
+                        stat("utterances"))
+            voice_gauge("sonata_voice_rtf",
+                        "Aggregate real-time factor, per voice "
+                        "(inference ms / audio ms).",
+                        lambda: float(rtf_counter.snapshot().rtf))
+            voice_gauge("sonata_voice_audio_ms",
+                        "Total audio milliseconds synthesized, per voice.",
+                        stat("audio_ms"))
+        if dispatch_stats is not None:
+            def stage_stat(stage, key):
+                def read():
+                    stats = dispatch_stats()
+                    s = (stats or {}).get(stage)
+                    return float(s[key]) if s else None
+                return read
+
+            for stage in ("stream_decode", "stream_stage"):
+                for key in ("requests", "dispatches"):
+                    voice_gauge(f"sonata_{stage}_{key}",
+                                f"Stream coalescer {key}, per voice.",
+                                stage_stat(stage, key))
+        if scheduler is not None:
+            voice_gauge("sonata_scheduler_queue_depth",
+                        "Items waiting in the batch scheduler, per voice.",
+                        lambda: float(scheduler.queue_depth()))
+
+            def sched_stat(key):
+                return lambda: float(scheduler.stats.get(key, 0))
+
+            for key, help in (
+                    ("requests", "Scheduler items submitted"),
+                    ("dispatches", "Scheduler device dispatches"),
+                    ("expired", "Scheduler items dropped on expired "
+                                "deadlines"),
+                    ("cancelled", "Scheduler items dropped on client "
+                                  "cancellation"),
+                    ("shed", "Scheduler items rejected on a full queue")):
+                voice_gauge(f"sonata_scheduler_{key}",
+                            f"{help}, per voice.", sched_stat(key))
+
+    def unregister_voice(self, voice_id: str) -> None:
+        """Drop a voice's labeled series after UnloadVoice — exactly the
+        ones register_voice created (recorded per voice, so the two
+        methods cannot drift apart), releasing the closures that would
+        otherwise pin the unloaded voice's objects."""
+        lbl = {"voice": voice_id}
+        for metric in self._voice_series.pop(voice_id, []):
+            metric.remove(**lbl)
+
+    def close(self) -> None:
+        if self.http is not None:
+            self.http.stop()
+            self.http = None

@@ -1,0 +1,90 @@
+"""Liveness and readiness for rolling restarts.
+
+Two different questions, two different endpoints:
+
+- **Liveness** (``/healthz``): is the process able to make progress at
+  all?  True from startup; an orchestrator restarts the pod when it goes
+  false (we only flip it on unrecoverable internal failure).
+- **Readiness** (``/readyz``, and the ``CheckHealth`` gRPC unary): should
+  a load balancer send traffic *now*?  False until the preloaded voices
+  have finished loading AND each has synthesized one warmup utterance —
+  the warmup forces the XLA compile of the common executables, so the
+  first real request never eats a multi-second (cold cache: multi-minute)
+  compile.  During a rolling restart the new replica therefore joins the
+  serving set only once it can answer at steady-state latency.
+
+Both are also exported as gauges (``sonata_up``, ``sonata_ready``) so the
+scrape plane sees the same truth the probes do.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from .metrics import MetricsRegistry
+
+
+class HealthState:
+    """Thread-safe liveness/readiness flags with a human-readable reason."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self._lock = threading.Lock()
+        self._live = True
+        self._ready = threading.Event()
+        self._reason = "starting: voices not loaded"
+        self._ready_at: Optional[float] = None
+        if registry is not None:
+            registry.gauge(
+                "sonata_up", "Process liveness (1 = live)."
+            ).set_function(lambda: 1.0 if self.live else 0.0)
+            registry.gauge(
+                "sonata_ready",
+                "Readiness gate (1 = voices loaded and warmed)."
+            ).set_function(lambda: 1.0 if self.ready else 0.0)
+
+    # -- liveness ------------------------------------------------------------
+    @property
+    def live(self) -> bool:
+        with self._lock:
+            return self._live
+
+    def set_unhealthy(self, reason: str) -> None:
+        """Unrecoverable internal failure: ask the orchestrator for a
+        restart (also drops readiness)."""
+        with self._lock:
+            self._live = False
+            self._reason = reason
+        self._ready.clear()
+
+    # -- readiness -----------------------------------------------------------
+    @property
+    def ready(self) -> bool:
+        return self._ready.is_set()
+
+    @property
+    def reason(self) -> str:
+        with self._lock:
+            return self._reason
+
+    def set_ready(self, reason: str = "ready") -> None:
+        with self._lock:
+            self._reason = reason
+            if self._ready_at is None:
+                self._ready_at = time.monotonic()
+        self._ready.set()
+
+    def set_not_ready(self, reason: str) -> None:
+        """Drop out of the serving set (e.g. draining before shutdown)."""
+        with self._lock:
+            self._reason = reason
+        self._ready.clear()
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        return self._ready.wait(timeout)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"live": self._live, "ready": self._ready.is_set(),
+                    "reason": self._reason}

@@ -1,0 +1,356 @@
+"""Metrics registry and Prometheus text exposition over stdlib HTTP.
+
+The serving stack already *measures* a lot — ``RtfCounter`` aggregates,
+``dispatch_stats()`` counters, scheduler coalescing stats — but until now
+the only way out was a log line every ~50 utterances.  This module gives
+those numbers (plus the admission/deadline counters this subsystem adds)
+a pull endpoint any Prometheus-compatible scraper understands:
+
+- :class:`MetricsRegistry` owns named metrics.  Three kinds: ``counter``
+  (monotonic), ``gauge`` (settable, or lazily computed via a callback at
+  scrape time — how existing stats objects are wired in without adding a
+  push call to every hot path), and ``histogram`` (bounded buckets, via
+  :class:`~sonata_tpu.utils.profiling.Histogram`).
+- Metrics are labelable (``metric.labels(voice="1234").inc()``); series
+  for unloaded voices are removed with ``metric.remove(...)``.
+- ``render()`` emits `text/plain; version=0.0.4` exposition format;
+  :func:`parse_prometheus_text` is the matching validator used by the
+  tests and the CI serving smoke.
+- :func:`start_http_server` serves ``/metrics`` plus the health plane's
+  ``/healthz`` and ``/readyz`` (see :mod:`.health`) from one tiny
+  threaded stdlib ``http.server`` — no web framework dependency.
+
+Port comes from ``SONATA_METRICS_PORT`` (0 = ephemeral; unset = no
+server).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+
+from ..utils.profiling import Histogram
+
+log = logging.getLogger("sonata.serving")
+
+METRICS_PORT_ENV = "SONATA_METRICS_PORT"
+METRICS_HOST_ENV = "SONATA_METRICS_HOST"
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _format_value(v: float) -> str:
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _escape_label(v: str) -> str:
+    return (str(v).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def _label_str(labels: _LabelKey, extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label(v)}"' for k, v in labels]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Child:
+    """One labeled series of a metric."""
+
+    __slots__ = ("_value", "_fn", "_hist", "_lock")
+
+    def __init__(self, hist_buckets=None, is_hist: bool = False):
+        self._value = 0.0
+        self._fn: Optional[Callable[[], Optional[float]]] = None
+        self._hist = Histogram(hist_buckets) if is_hist else None
+        self._lock = threading.Lock()
+
+    # counter / gauge API
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+            self._fn = None
+
+    def set_function(self, fn: Callable[[], Optional[float]]) -> None:
+        """Compute the value at scrape time (returning None skips the
+        series for that scrape)."""
+        with self._lock:
+            self._fn = fn
+
+    def get(self) -> Optional[float]:
+        with self._lock:
+            fn = self._fn
+            if fn is None:
+                return self._value
+        try:
+            return fn()
+        except Exception:
+            # a dead callback (e.g. voice unloaded mid-scrape) must never
+            # break the whole exposition
+            return None
+
+    # histogram API
+    def observe(self, value: float) -> None:
+        self._hist.observe(value)
+
+
+class Metric:
+    """A named metric family; series are created on first ``labels()``."""
+
+    def __init__(self, name: str, help: str, type: str, buckets=None):
+        self.name = name
+        self.help = help
+        self.type = type
+        self._buckets = buckets
+        self._children: Dict[_LabelKey, _Child] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, **labels) -> _Child:
+        key: _LabelKey = tuple(sorted(labels.items()))
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = _Child(self._buckets,
+                               is_hist=self.type == "histogram")
+                self._children[key] = child
+            return child
+
+    def remove(self, **labels) -> None:
+        key: _LabelKey = tuple(sorted(labels.items()))
+        with self._lock:
+            self._children.pop(key, None)
+
+    # unlabeled convenience: metric.inc() == metric.labels().inc()
+    def inc(self, amount: float = 1.0) -> None:
+        self.labels().inc(amount)
+
+    def set(self, value: float) -> None:
+        self.labels().set(value)
+
+    def set_function(self, fn: Callable[[], Optional[float]]) -> None:
+        self.labels().set_function(fn)
+
+    def observe(self, value: float) -> None:
+        self.labels().observe(value)
+
+    def get(self, **labels) -> Optional[float]:
+        return self.labels(**labels).get()
+
+    # -- exposition ----------------------------------------------------------
+    def render(self) -> str:
+        with self._lock:
+            children = list(self._children.items())
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.type}"]
+        n_series = 0
+        for key, child in children:
+            if self.type == "histogram":
+                snap = child._hist.snapshot()
+                for bound, cum in zip(snap.buckets, snap.counts):
+                    le = 'le="' + _format_value(bound) + '"'
+                    lines.append(
+                        f"{self.name}_bucket{_label_str(key, le)} {cum}")
+                inf = 'le="+Inf"'
+                lines.append(f"{self.name}_bucket{_label_str(key, inf)} "
+                             f"{snap.total}")
+                lines.append(f"{self.name}_sum{_label_str(key)} "
+                             f"{_format_value(snap.sum)}")
+                lines.append(f"{self.name}_count{_label_str(key)} "
+                             f"{snap.total}")
+                n_series += 1
+                continue
+            value = child.get()
+            if value is None:
+                continue
+            lines.append(f"{self.name}{_label_str(key)} "
+                         f"{_format_value(value)}")
+            n_series += 1
+        if n_series == 0:
+            return ""
+        return "\n".join(lines) + "\n"
+
+
+class MetricsRegistry:
+    """Named metric families, rendered together in one exposition."""
+
+    def __init__(self):
+        self._metrics: Dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, name: str, help: str, type: str,
+                  buckets=None) -> Metric:
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if existing.type != type:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.type}")
+                return existing
+            m = Metric(name, help, type, buckets=buckets)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str) -> Metric:
+        return self._register(name, help, "counter")
+
+    def gauge(self, name: str, help: str) -> Metric:
+        return self._register(name, help, "gauge")
+
+    def histogram(self, name: str, help: str, buckets=None) -> Metric:
+        return self._register(name, help, "histogram", buckets=buckets)
+
+    def get(self, name: str) -> Optional[Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        return "".join(m.render() for m in metrics)
+
+
+def parse_prometheus_text(text: str) -> Dict[str, list]:
+    """Strict-enough exposition parser: ``{series_name: [(labels, value)]}``.
+
+    Raises ``ValueError`` on malformed lines.  Used by the tests and the
+    CI serving smoke to assert ``render()`` output actually parses —
+    the exporter ships with its own format check.
+    """
+    import re
+
+    series: Dict[str, list] = {}
+    sample_re = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+        r'(\{([^}]*)\})?'
+        r'\s+(-?[0-9.eE+-]+|NaN|[+-]Inf)$')
+    label_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            continue
+        if line.startswith("#"):
+            raise ValueError(f"line {lineno}: bad comment {line!r}")
+        m = sample_re.match(line)
+        if m is None:
+            raise ValueError(f"line {lineno}: unparseable sample {line!r}")
+        name, _, labelblock, raw = m.groups()
+        labels = {}
+        if labelblock:
+            consumed = label_re.sub("", labelblock).strip(", \t")
+            if consumed:
+                raise ValueError(
+                    f"line {lineno}: bad label syntax {labelblock!r}")
+            labels = dict(label_re.findall(labelblock))
+        if raw == "+Inf":
+            value = math.inf
+        elif raw == "-Inf":
+            value = -math.inf
+        elif raw == "NaN":
+            value = math.nan
+        else:
+            value = float(raw)
+        series.setdefault(name, []).append((labels, value))
+    return series
+
+
+# ---------------------------------------------------------------------------
+# HTTP plane: /metrics + /healthz + /readyz on one stdlib server
+# ---------------------------------------------------------------------------
+
+class _Handler(BaseHTTPRequestHandler):
+    # set per-server via type() in start_http_server
+    registry: MetricsRegistry = None
+    health = None
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = self.registry.render().encode("utf-8")
+            self._reply(200, body, CONTENT_TYPE)
+        elif path == "/healthz":
+            live = self.health is None or self.health.live
+            self._reply(200 if live else 503,
+                        b"ok\n" if live else b"unhealthy\n")
+        elif path == "/readyz":
+            if self.health is None or self.health.ready:
+                self._reply(200, b"ready\n")
+            else:
+                reason = (self.health.reason or "not ready").encode()
+                self._reply(503, b"not ready: " + reason + b"\n")
+        else:
+            self._reply(404, b"not found\n")
+
+    def _reply(self, code: int, body: bytes,
+               content_type: str = "text/plain; charset=utf-8") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # scrapes every few seconds —
+        log.debug("metrics http: " + fmt, *args)  # keep them off INFO
+
+
+class MetricsHTTPServer:
+    """Owns the background thread serving the metrics/health plane."""
+
+    def __init__(self, server: ThreadingHTTPServer):
+        self._server = server
+        self.port = server.server_address[1]
+        self._thread = threading.Thread(target=server.serve_forever,
+                                        name="sonata_metrics_http",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+
+
+def resolve_metrics_port(port: Optional[int] = None) -> Optional[int]:
+    """Explicit port wins; else ``SONATA_METRICS_PORT``; else disabled.
+
+    Returns None when no metrics server should start (0 is a valid
+    request: bind an ephemeral port)."""
+    if port is not None:
+        return port
+    raw = os.environ.get(METRICS_PORT_ENV)
+    if raw is None or raw.strip() == "":
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        log.warning("ignoring non-integer %s=%r", METRICS_PORT_ENV, raw)
+        return None
+
+
+def start_http_server(registry: MetricsRegistry, health=None,
+                      port: Optional[int] = None,
+                      host: Optional[str] = None) -> MetricsHTTPServer:
+    """Serve ``/metrics``, ``/healthz``, ``/readyz`` in a daemon thread."""
+    host = host or os.environ.get(METRICS_HOST_ENV, "127.0.0.1")
+    handler = type("BoundHandler", (_Handler,),
+                   {"registry": registry, "health": health})
+    httpd = ThreadingHTTPServer((host, port or 0), handler)
+    httpd.daemon_threads = True
+    return MetricsHTTPServer(httpd)

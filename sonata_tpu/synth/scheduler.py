@@ -8,10 +8,24 @@ for 16 sentences costs nearly the same wall time as for one (latency-bound;
 see SURVEY §7 step 5 "continuous batching across concurrent requests").
 
 :class:`BatchScheduler` keeps a queue of (sentence, speaker, scales,
-future) tuples; a worker collects up to ``max_batch`` sentences — waiting
-at most ``max_wait_ms`` after the first — and issues one ``speak_batch``
-with the per-row speakers and scales.  Under load, throughput approaches full-batch efficiency;
-idle, a lone request pays only the wait window.
+deadline, future) tuples; a worker collects up to ``max_batch`` sentences
+— waiting at most ``max_wait_ms`` after the first — and issues one
+``speak_batch`` with the per-row speakers and scales.  Under load,
+throughput approaches full-batch efficiency; idle, a lone request pays
+only the wait window.
+
+Serving-runtime integration (:mod:`sonata_tpu.serving`):
+
+- the queue is **bounded** (``max_queue``, default
+  ``SONATA_SCHED_MAX_QUEUE`` or 1024); a full queue sheds with
+  :class:`~sonata_tpu.serving.Overloaded` instead of growing without
+  limit — defense in depth behind the frontend admission controller;
+- items may carry a :class:`~sonata_tpu.serving.Deadline`; the gather
+  loop drops expired or client-cancelled items *before* packing a device
+  dispatch (their futures fail with
+  :class:`~sonata_tpu.serving.DeadlineExceeded`, or are cancelled), so a
+  backed-up queue never spends accelerator time on answers nobody will
+  read.
 
 Requests may carry their own speaker id and synthesis scales; the batch
 forwards both per row, so coalescing never flattens per-request settings.
@@ -19,6 +33,7 @@ forwards both per row, so coalescing never flattens per-request settings.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -27,11 +42,28 @@ from typing import Optional
 
 from ..audio import Audio
 from ..core import Model, OperationError
+from ..serving.admission import Overloaded
+from ..serving.deadlines import Deadline, DeadlineExceeded
+
+MAX_QUEUE_ENV = "SONATA_SCHED_MAX_QUEUE"
+DEFAULT_MAX_QUEUE = 1024
+
+
+class _Item:
+    __slots__ = ("phonemes", "speaker", "scales", "deadline", "future")
+
+    def __init__(self, phonemes, speaker, scales, deadline, future):
+        self.phonemes = phonemes
+        self.speaker = speaker
+        self.scales = scales
+        self.deadline = deadline
+        self.future = future
 
 
 class BatchScheduler:
     def __init__(self, model: Model, *, max_batch: Optional[int] = None,
-                 max_wait_ms: Optional[float] = None):
+                 max_wait_ms: Optional[float] = None,
+                 max_queue: Optional[int] = None):
         self._model = model
         # knobs default from the model's backend-adaptive dispatch policy
         # (utils/dispatch_policy): on a CPU backend that degrades to
@@ -47,23 +79,64 @@ class BatchScheduler:
                 else max_batch
             max_wait_ms = defaults["max_wait_ms"] if max_wait_ms is None \
                 else max_wait_ms
+        if max_queue is None:
+            try:
+                max_queue = int(os.environ.get(MAX_QUEUE_ENV,
+                                               DEFAULT_MAX_QUEUE))
+            except ValueError:
+                max_queue = DEFAULT_MAX_QUEUE
         self._max_batch = max_batch
         self._max_wait = max_wait_ms / 1000.0
+        self._max_queue = max_queue
         #: per-dispatch observability, same shape as the stream
-        #: coalescers': coalescing ratio = requests / dispatches
-        self.stats = {"requests": 0, "dispatches": 0}
-        self._queue: "queue.Queue" = queue.Queue()
+        #: coalescers': coalescing ratio = requests / dispatches; plus the
+        #: serving-runtime drop counters (shed = queue full at submit,
+        #: expired/cancelled = dropped by the gather loop pre-dispatch).
+        #: submit() counters race with the worker's, so increments go
+        #: through _bump (dict += is not atomic under concurrency)
+        self.stats = {"requests": 0, "dispatches": 0, "shed": 0,
+                      "expired": 0, "cancelled": 0}
+        self._stats_lock = threading.Lock()
+        # maxsize counts the sentinel too, but one slot of slack on a
+        # 1024-deep bound is noise; <= 0 means unbounded (tests only)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(max_queue, 0))
         self._closed = threading.Event()
         self._worker = threading.Thread(target=self._run,
                                         name="sonata_batcher", daemon=True)
         self._worker.start()
 
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self.stats[key] += n
+
     # -- public API ----------------------------------------------------------
+    def queue_depth(self) -> int:
+        """Items currently waiting (approximate; for metrics)."""
+        return self._queue.qsize()
+
+    def stats_view(self) -> dict:
+        """Stats snapshot plus the derived coalescing ratio (requests per
+        device dispatch; 1.0 = no coalescing) — the one place the ratio
+        formula lives for every consumer (server log line, benches)."""
+        with self._stats_lock:
+            s = dict(self.stats)
+        s["coalescing_ratio"] = round(
+            s["requests"] / max(s["dispatches"], 1), 3)
+        return s
+
     def submit(self, phonemes: str,
                speaker: Optional[int] = None,
-               scales=None) -> "Future[Audio]":
+               scales=None,
+               deadline: Optional[Deadline] = None) -> "Future[Audio]":
         if self._closed.is_set():
             raise OperationError("scheduler is shut down")
+        if deadline is not None and not deadline.alive():
+            # no point occupying a queue slot for work that is already
+            # dead — fail at the door with the accurate error
+            if deadline.cancelled:
+                raise OperationError("request cancelled before submit")
+            self._bump("expired")
+            raise DeadlineExceeded("request deadline exceeded before submit")
         if speaker is not None:
             # validate here, per request: a bad speaker id inside a
             # coalesced dispatch would otherwise fail every request in
@@ -86,17 +159,36 @@ class BatchScheduler:
                     raise OperationError(
                         f"scales.{attr} missing or non-numeric")
         fut: "Future[Audio]" = Future()
-        self._queue.put((phonemes, speaker, scales, fut))
+        item = _Item(phonemes, speaker, scales, deadline, fut)
+        try:
+            self._queue.put_nowait(item)
+        except queue.Full:
+            self._bump("shed")
+            raise Overloaded(
+                f"scheduler queue full ({self._max_queue} items); "
+                "shedding") from None
+        # shutdown race: a submit that passed the _closed check above can
+        # interleave with shutdown()'s drain and land its item *after*
+        # the drain emptied the queue — that future would never resolve.
+        # Re-check after the put and fail the future ourselves; if the
+        # drain (or the worker) already handled it, the set_exception is
+        # a tolerated no-op.
+        if self._closed.is_set():
+            _try_set_exception(fut, OperationError("scheduler shut down"))
         return fut
 
     def speak(self, phonemes: str, timeout: Optional[float] = None,
-              speaker: Optional[int] = None, scales=None) -> Audio:
-        return self.submit(phonemes, speaker=speaker,
-                           scales=scales).result(timeout)
+              speaker: Optional[int] = None, scales=None,
+              deadline: Optional[Deadline] = None) -> Audio:
+        return self.submit(phonemes, speaker=speaker, scales=scales,
+                           deadline=deadline).result(timeout)
 
     def shutdown(self) -> None:
         self._closed.set()
-        self._queue.put(None)  # wake the worker
+        try:
+            self._queue.put_nowait(None)  # wake the worker
+        except queue.Full:
+            pass  # worker will observe _closed on its next loop anyway
         self._worker.join(timeout=5.0)
         # fail anything still enqueued so no caller blocks forever
         while True:
@@ -105,13 +197,17 @@ class BatchScheduler:
             except queue.Empty:
                 break
             if item is not None:
-                _try_set_exception(item[-1],
+                _try_set_exception(item.future,
                                    OperationError("scheduler shut down"))
 
     # -- worker --------------------------------------------------------------
     def _run(self) -> None:
         while not self._closed.is_set():
-            item = self._queue.get()
+            try:
+                item = self._queue.get(timeout=0.5)
+            except queue.Empty:
+                continue  # re-check _closed: a full queue can eat the
+                # shutdown sentinel, so the worker must not block forever
             if item is None:
                 continue
             batch = [item]
@@ -127,12 +223,38 @@ class BatchScheduler:
                 if nxt is None:
                     break
                 batch.append(nxt)
-            self._dispatch(batch)
+            batch = self._drop_dead(batch)
+            if batch:
+                self._dispatch(batch)
+
+    def _drop_dead(self, batch: list) -> list:
+        """Filter expired/cancelled items out of a gathered batch *before*
+        it is packed into a device dispatch — the whole point of deadline
+        propagation: a backed-up queue sheds dead work instead of
+        synthesizing audio nobody is waiting for."""
+        live = []
+        for item in batch:
+            dl = item.deadline
+            if dl is None or dl.alive():
+                live.append(item)
+            elif dl.cancelled:
+                self._bump("cancelled")
+                item.future.cancel()  # nobody is reading the result
+            else:
+                self._bump("expired")
+                _try_set_exception(
+                    item.future,
+                    DeadlineExceeded("deadline expired in scheduler queue "
+                                     "before device dispatch"))
+        return live
 
     def _dispatch(self, batch) -> None:
-        sentences, speakers, scales, futures = (list(x) for x in zip(*batch))
-        self.stats["requests"] += len(batch)
-        self.stats["dispatches"] += 1
+        sentences = [i.phonemes for i in batch]
+        speakers = [i.speaker for i in batch]
+        scales = [i.scales for i in batch]
+        futures = [i.future for i in batch]
+        self._bump("requests", len(batch))
+        self._bump("dispatches")
         try:
             # speakers/scales are part of the Model protocol
             audios = self._model.speak_batch(sentences, speakers=speakers,

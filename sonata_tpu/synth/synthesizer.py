@@ -26,6 +26,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 from typing import Iterator, Optional, Union
@@ -170,11 +171,37 @@ class SpeechSynthesizer:
         write_wave_samples_to_file(path, samples.to_i16(), sample_rate)
 
 
-class SpeechStreamLazy:
+class _StageTimestamps:
+    """Serving-plane stage timestamps shared by every stream mode.
+
+    ``created_ts`` is stamped at stream construction (request accepted),
+    ``first_item_ts`` when the first audio leaves the stream — their
+    difference is the time-to-first-byte the metrics plane exports as the
+    ``sonata_ttfb_seconds`` histogram.  Monotonic clock; ``ttfb_s`` is
+    None until the first item is produced.
+    """
+
+    def __init__(self):
+        self.created_ts = time.monotonic()
+        self.first_item_ts: Optional[float] = None
+
+    def _mark_item(self) -> None:
+        if self.first_item_ts is None:
+            self.first_item_ts = time.monotonic()
+
+    @property
+    def ttfb_s(self) -> Optional[float]:
+        if self.first_item_ts is None:
+            return None
+        return self.first_item_ts - self.created_ts
+
+
+class SpeechStreamLazy(_StageTimestamps):
     """One sentence per ``next()`` (``synth/lib.rs:282-307``)."""
 
     def __init__(self, synth: SpeechSynthesizer, phonemes: Phonemes,
                  output_config: Optional[AudioOutputConfig]):
+        super().__init__()
         self._synth = synth
         self._sentences = list(phonemes)
         self._output_config = output_config
@@ -189,16 +216,19 @@ class SpeechStreamLazy:
         sentence = self._sentences[self._idx]
         self._idx += 1
         audio = self._synth.model.speak_one_sentence(sentence)
-        return self._synth._post_process(audio, self._output_config)
+        audio = self._synth._post_process(audio, self._output_config)
+        self._mark_item()
+        return audio
 
 
-class SpeechStreamBatched:
+class SpeechStreamBatched(_StageTimestamps):
     """All sentences in one padded device batch, precomputed at construction
     (behavioral parity with the reference's parallel stream, ``:310-325``,
     but a single device program instead of a rayon fan-out)."""
 
     def __init__(self, synth: SpeechSynthesizer, phonemes: Phonemes,
                  output_config: Optional[AudioOutputConfig]):
+        super().__init__()
         sentences = list(phonemes)
         audios = synth.model.speak_batch(sentences) if sentences else []
         self._results = [synth._post_process(a, output_config)
@@ -213,13 +243,14 @@ class SpeechStreamBatched:
             raise StopIteration
         audio = self._results[self._idx]
         self._idx += 1
+        self._mark_item()
         return audio
 
 
 _SENTINEL = object()
 
 
-class RealtimeSpeechStream:
+class RealtimeSpeechStream(_StageTimestamps):
     """Pipelined chunked streaming (``synth/lib.rs:335-430``).
 
     A producer task on the shared pool walks sentences, calls the model's
@@ -232,6 +263,7 @@ class RealtimeSpeechStream:
     def __init__(self, synth: SpeechSynthesizer, phonemes: Phonemes,
                  output_config: Optional[AudioOutputConfig],
                  chunk_size: int, chunk_padding: int):
+        super().__init__()
         self._queue: "queue.Queue" = queue.Queue()
         self._synth = synth
         self._cancelled = threading.Event()
@@ -269,4 +301,5 @@ class RealtimeSpeechStream:
             if isinstance(item, OperationError):
                 raise item
             raise OperationError(str(item)) from item
+        self._mark_item()
         return item

@@ -87,9 +87,12 @@ def _sub_currency(text: str, g: NumberGrammar) -> str:
         sym = m.group("pre") or m.group("post")
         whole = int(m.group("a") or m.group("b"))
         frac = m.group("af") or m.group("bf")
-        if frac and g.magnitudes:
-            # "$3.5 billion" is a scaled number, not 3 dollars 50 cents:
-            # decline the cents reading and let the decimal pass speak it
+        if g.magnitudes:
+            # "$3.5 billion" / "$3 billion" are scaled numbers, not an
+            # amount in dollars-and-cents followed by a stray word:
+            # decline the currency reading and let the decimal/cardinal
+            # pass speak the number (the symbol stays, as pinned by
+            # test_currency_magnitude_words_decline_cents_reading)
             nxt = re.match(r"\s*([^\W\d_]+)", m.string[m.end():])
             if nxt and nxt.group(1).lower() in g.magnitudes:
                 return m.group(0)

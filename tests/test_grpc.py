@@ -322,6 +322,175 @@ def test_server_main_mesh_flags(monkeypatch):
                                             "model": 2}
 
 
+def test_load_voice_failure_does_not_leak_loading_lock(tmp_path):
+    """Regression: a failed LoadVoice used to leak its per-voice entry in
+    ``_loading`` (context.abort raises past the pop).  Load a bad config
+    path twice; the registry of load locks must be empty after each."""
+    from sonata_tpu.frontends import grpc_server as srv
+
+    service = srv.SonataGrpcService()
+
+    class Ctx:
+        def abort(self, code, msg):
+            raise RuntimeError(f"abort: {code}")
+
+    bad = str(tmp_path / "does_not_exist.json")
+    for _ in range(2):
+        with pytest.raises(RuntimeError, match="abort"):
+            service.LoadVoice(pb.VoicePath(config_path=bad), Ctx())
+        assert service._loading == {}  # no leaked lock entry
+    assert service._voices == {}
+
+
+def test_failed_load_waiter_retries_and_loads_once(tmp_path_factory,
+                                                   monkeypatch):
+    """A waiter that was queued on a load-lock whose load FAILED holds a
+    stale lock (the failure popped the ``_loading`` entry).  It must
+    retry under a fresh lock and load exactly once — never skip the
+    staleness check and double-load against a concurrent caller."""
+    import threading
+    import time as _time
+
+    from sonata_tpu.frontends import grpc_server as srv
+
+    cfg = str(write_tiny_voice(tmp_path_factory.mktemp("staleretry")))
+    service = srv.SonataGrpcService()
+    real = srv.from_config_path
+    calls = []
+    b_queued = threading.Event()
+
+    def flaky(path, **kw):
+        calls.append(path)
+        if len(calls) == 1:
+            # hold the load open until the second caller is (almost
+            # certainly) queued on our lock, then fail — the waiter's
+            # lock is popped by the failure path, making it stale
+            assert b_queued.wait(10.0)
+            _time.sleep(0.3)
+            from sonata_tpu.core import FailedToLoadResource
+
+            raise FailedToLoadResource("transient load failure")
+        return real(path, **kw)
+
+    monkeypatch.setattr(srv, "from_config_path", flaky)
+
+    class Ctx:
+        def abort(self, code, msg):
+            raise RuntimeError(f"abort {code.name}")
+
+    outcomes = []
+
+    def load():
+        try:
+            outcomes.append(service.LoadVoice(
+                pb.VoicePath(config_path=cfg), Ctx()).voice_id)
+        except RuntimeError as e:
+            outcomes.append(str(e))
+
+    a = threading.Thread(target=load)
+    a.start()
+    deadline = _time.monotonic() + 10.0
+    while not calls:  # A is inside from_config_path, holding the lock
+        assert _time.monotonic() < deadline
+        _time.sleep(0.005)
+    b = threading.Thread(target=load)
+    b.start()
+    b_queued.set()
+    a.join(timeout=30.0)
+    b.join(timeout=30.0)
+    assert not a.is_alive() and not b.is_alive()
+    # A aborted NOT_FOUND; B retried under a fresh lock and loaded
+    assert sorted(o.startswith("abort") for o in outcomes) == [False, True]
+    assert len(calls) == 2  # one failure + exactly one successful load
+    assert len(service._voices) == 1
+    assert service._loading == {}
+
+
+def test_unload_voice_with_inflight_scheduler_requests(tmp_path_factory):
+    """Satellite pin for the UnloadVoice docstring contract: in-flight
+    continuous-batching requests fail with an OperationError-mapped
+    status (ABORTED) rather than hanging when their voice is unloaded."""
+    import threading
+    import time as _time
+
+    from sonata_tpu.core import OperationError
+    from sonata_tpu.frontends import grpc_server as srv
+
+    cfg = str(write_tiny_voice(tmp_path_factory.mktemp("unload_inflight")))
+    service = srv.SonataGrpcService(continuous_batching=True)
+
+    class Ctx:
+        def abort(self, code, msg):
+            raise RuntimeError(f"{code.name}: {msg}")
+
+    info = service.LoadVoice(pb.VoicePath(config_path=cfg), Ctx())
+    v = service._voices[info.voice_id]
+    # block the scheduler worker inside a dispatch so queued requests
+    # are genuinely in flight when the unload happens
+    release = threading.Event()
+    entered = threading.Event()
+    real = v.voice.speak_batch
+
+    def slow(sentences, speakers=None, scales=None):
+        entered.set()
+        release.wait(5.0)
+        return real(sentences, speakers=speakers, scales=scales)
+
+    v.voice.speak_batch = slow
+    outcomes = []
+
+    def request(i):
+        try:
+            n = len(list(service.SynthesizeUtterance(
+                pb.Utterance(voice_id=info.voice_id,
+                             text=f"In flight {i}."), Ctx())))
+            outcomes.append(("ok", n))
+        except RuntimeError as e:
+            outcomes.append(("abort", str(e)))
+
+    threads = [threading.Thread(target=request, args=(i,))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    assert entered.wait(10.0)  # first dispatch holds the worker
+    _time.sleep(0.2)           # let the rest queue behind it
+    unload_err = []
+
+    def unload():
+        try:
+            service.UnloadVoice(
+                pb.VoiceIdentifier(voice_id=info.voice_id), Ctx())
+        except Exception as e:  # must not raise
+            unload_err.append(e)
+
+    u = threading.Thread(target=unload)
+    u.start()
+    _time.sleep(0.2)
+    release.set()  # free the blocked dispatch so shutdown can drain
+    u.join(timeout=15.0)
+    for t in threads:
+        t.join(timeout=15.0)
+    assert not u.is_alive() and not any(t.is_alive() for t in threads)
+    assert not unload_err
+    # every request resolved: completed, or failed mapped (ABORTED from
+    # the scheduler's shutdown OperationError) — no hangs
+    assert len(outcomes) == 3
+    for kind, detail in outcomes:
+        if kind == "abort":
+            assert "ABORTED" in detail or "DEADLINE_EXCEEDED" in detail
+    # voice gone, scheduler rejects new work
+    with pytest.raises(OperationError):
+        v.scheduler.submit("late")
+
+
+def test_check_health_over_wire(server_and_voice):
+    """CheckHealth rides the same wire as every other unary."""
+    channel, _ = server_and_voice
+    h = _unary(channel, "CheckHealth", pb.Empty(), pb.HealthStatus)
+    assert h.live is True
+    assert h.version
+
+
 def test_unload_voice(server_and_voice, tmp_path):
     """UnloadVoice (sonata-tpu extension) drops the voice, stops its
     worker threads, and subsequent requests for it NOT_FOUND; unloading an

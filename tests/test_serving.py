@@ -1,0 +1,638 @@
+"""Serving runtime tests: admission control, deadlines, metrics, health.
+
+Pins the ISSUE 2 acceptance contract:
+
+- excess concurrent load beyond ``max_in_flight + max_queue_depth`` fails
+  fast with RESOURCE_EXHAUSTED (typed :class:`Overloaded`), never queues
+  unboundedly;
+- a deadline shorter than the queue wait yields DEADLINE_EXCEEDED
+  *without the item reaching a device dispatch*;
+- ``/metrics`` serves parseable Prometheus text including queue depth,
+  shed count, and the TTFB histogram; readiness flips only after warmup.
+"""
+
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from sonata_tpu.core import OperationError
+from sonata_tpu.serving import (
+    AdmissionController,
+    Deadline,
+    DeadlineExceeded,
+    HealthState,
+    MetricsRegistry,
+    Overloaded,
+    ServingRuntime,
+    parse_prometheus_text,
+    start_http_server,
+)
+
+from voices import tiny_voice
+
+
+# ---------------------------------------------------------------------------
+# admission
+# ---------------------------------------------------------------------------
+
+def test_admission_sheds_beyond_capacity():
+    ac = AdmissionController(max_in_flight=2, max_queue_depth=1)
+    assert ac.capacity == 3
+    assert all(ac.try_acquire() for _ in range(3))
+    assert ac.in_flight == 3
+    assert not ac.try_acquire()
+    assert ac.shed_total == 1
+    ac.release()
+    assert ac.try_acquire()  # capacity freed → admitted again
+    with pytest.raises(Overloaded):
+        with ac.admit():
+            pass
+    assert ac.shed_total == 2
+
+
+def test_admission_context_manager_releases_on_error():
+    ac = AdmissionController(max_in_flight=1, max_queue_depth=0)
+    with pytest.raises(RuntimeError):
+        with ac.admit():
+            assert ac.in_flight == 1
+            raise RuntimeError("boom")
+    assert ac.in_flight == 0
+
+
+def test_admission_env_defaults(monkeypatch):
+    monkeypatch.setenv("SONATA_MAX_IN_FLIGHT", "5")
+    monkeypatch.setenv("SONATA_MAX_QUEUE_DEPTH", "7")
+    ac = AdmissionController()
+    assert (ac.max_in_flight, ac.max_queue_depth) == (5, 7)
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+def test_deadline_expiry_and_cancel():
+    dl = Deadline.after(0.02)
+    assert dl.alive() and not dl.expired()
+    time.sleep(0.03)
+    assert dl.expired() and not dl.alive()
+    with pytest.raises(DeadlineExceeded):
+        dl.raise_if_expired()
+    dl2 = Deadline.none()
+    assert dl2.remaining() is None and dl2.alive()
+    dl2.cancel()
+    assert dl2.cancelled and not dl2.alive()
+    dl2.raise_if_expired()  # cancelled ≠ expired; no raise
+
+
+def test_deadline_from_grpc_context_client_deadline_wins():
+    class Ctx:
+        def __init__(self, remaining):
+            self._remaining = remaining
+            self.callbacks = []
+
+        def time_remaining(self):
+            return self._remaining
+
+        def add_callback(self, cb):
+            self.callbacks.append(cb)
+
+    ctx = Ctx(0.5)
+    dl = Deadline.from_grpc_context(ctx, default_s=100.0)
+    assert 0.0 < dl.remaining() <= 0.5
+    # disconnect callback registered and wired to cancel
+    assert ctx.callbacks
+    ctx.callbacks[0]()
+    assert dl.cancelled
+
+
+def test_deadline_from_grpc_context_int64max_means_default():
+    """grpcio without a client deadline reports int64-max-epoch seconds
+    on some versions; that must fall back to the server default, not
+    overflow downstream waits."""
+    class Ctx:
+        def time_remaining(self):
+            return 3e11
+
+    dl = Deadline.from_grpc_context(Ctx(), default_s=1.0)
+    assert dl.remaining() < 2.0
+
+
+def test_deadline_bare_context_uses_default():
+    class Ctx:  # test doubles in this suite have neither attribute
+        pass
+
+    dl = Deadline.from_grpc_context(Ctx(), default_s=5.0)
+    rem = dl.remaining()
+    assert rem is not None and 4.0 < rem <= 5.0
+
+
+def test_default_timeout_env(monkeypatch):
+    from sonata_tpu.serving.deadlines import default_timeout_s
+
+    monkeypatch.setenv("SONATA_REQUEST_TIMEOUT_S", "33.5")
+    assert default_timeout_s() == 33.5
+    monkeypatch.setenv("SONATA_REQUEST_TIMEOUT_S", "0")
+    assert default_timeout_s() is None  # <= 0 disables
+    monkeypatch.delenv("SONATA_REQUEST_TIMEOUT_S")
+    assert default_timeout_s() == 120.0
+
+
+# ---------------------------------------------------------------------------
+# metrics registry + exposition format
+# ---------------------------------------------------------------------------
+
+def test_registry_counter_gauge_render_parse():
+    r = MetricsRegistry()
+    c = r.counter("sonata_test_total", "A counter.")
+    c.inc()
+    c.labels(kind="x").inc(2)
+    g = r.gauge("sonata_test_gauge", "A gauge.")
+    g.set(4.25)
+    text = r.render()
+    assert "# TYPE sonata_test_total counter" in text
+    parsed = parse_prometheus_text(text)
+    series = dict((tuple(sorted(l.items())), v)
+                  for l, v in parsed["sonata_test_total"])
+    assert series[()] == 1.0
+    assert series[(("kind", "x"),)] == 2.0
+    assert parsed["sonata_test_gauge"][0][1] == 4.25
+
+
+def test_registry_gauge_callback_and_skip_on_none():
+    r = MetricsRegistry()
+    g = r.gauge("sonata_cb", "Callback gauge.")
+    g.labels(a="1").set_function(lambda: 7.0)
+    g.labels(a="dead").set_function(lambda: None)  # skipped at scrape
+    g.labels(a="boom").set_function(lambda: 1 / 0)  # must not break render
+    parsed = parse_prometheus_text(r.render())
+    assert parsed["sonata_cb"] == [({"a": "1"}, 7.0)]
+
+
+def test_registry_histogram_render_parse():
+    r = MetricsRegistry()
+    h = r.histogram("sonata_lat_seconds", "Latency.",
+                    buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 5.0):
+        h.observe(v)
+    parsed = parse_prometheus_text(r.render())
+    buckets = {l["le"]: v for l, v in parsed["sonata_lat_seconds_bucket"]}
+    assert buckets["0.01"] == 1.0
+    assert buckets["0.1"] == 3.0
+    assert buckets["1"] == 3.0
+    assert buckets["+Inf"] == 4.0
+    assert parsed["sonata_lat_seconds_count"][0][1] == 4.0
+    assert parsed["sonata_lat_seconds_sum"][0][1] == pytest.approx(5.105)
+
+
+def test_registry_remove_series():
+    r = MetricsRegistry()
+    g = r.gauge("sonata_rm", "Removable.")
+    g.labels(voice="1").set(1)
+    g.labels(voice="2").set(2)
+    g.remove(voice="1")
+    parsed = parse_prometheus_text(r.render())
+    assert parsed["sonata_rm"] == [({"voice": "2"}, 2.0)]
+
+
+def test_parse_rejects_malformed():
+    with pytest.raises(ValueError):
+        parse_prometheus_text("not a metric line at all !!!")
+    with pytest.raises(ValueError):
+        parse_prometheus_text('m{bad-label="x"} 1')
+
+
+def test_label_escaping_roundtrip():
+    r = MetricsRegistry()
+    g = r.gauge("sonata_esc", "Escapes.")
+    g.labels(path='a"b\\c\nd').set(1)
+    parsed = parse_prometheus_text(r.render())
+    ((labels, value),) = parsed["sonata_esc"]
+    assert value == 1.0  # and the line parsed at all
+
+
+# ---------------------------------------------------------------------------
+# health + HTTP plane
+# ---------------------------------------------------------------------------
+
+def test_health_state_transitions():
+    h = HealthState()
+    assert h.live and not h.ready
+    h.set_ready("warmed")
+    assert h.ready and h.reason == "warmed"
+    h.set_not_ready("draining")
+    assert not h.ready and h.live
+    h.set_unhealthy("device lost")
+    assert not h.live and not h.ready
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return resp.getcode(), resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_http_plane_metrics_healthz_readyz():
+    r = MetricsRegistry()
+    h = HealthState(registry=r)
+    r.counter("sonata_things_total", "Things.").inc(3)
+    srv = start_http_server(r, health=h, port=0)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        code, body = _get(base + "/healthz")
+        assert code == 200
+        code, body = _get(base + "/readyz")
+        assert code == 503 and "not ready" in body
+        h.set_ready("warmed")
+        code, body = _get(base + "/readyz")
+        assert code == 200
+        code, body = _get(base + "/metrics")
+        assert code == 200
+        parsed = parse_prometheus_text(body)
+        assert parsed["sonata_things_total"][0][1] == 3.0
+        assert parsed["sonata_ready"][0][1] == 1.0
+        code, _ = _get(base + "/nope")
+        assert code == 404
+    finally:
+        srv.stop()
+
+
+def test_serving_runtime_standard_instruments():
+    rt = ServingRuntime(max_in_flight=4, max_queue_depth=2,
+                        request_timeout_s=9.0)
+    rt.ttfb.observe(0.02)
+    rt.requests.labels(rpc="SynthesizeUtterance").inc()
+    dl = rt.deadline_for(None)
+    assert 8.0 < dl.remaining() <= 9.0
+    parsed = parse_prometheus_text(rt.registry.render())
+    assert parsed["sonata_in_flight"][0][1] == 0.0
+    assert parsed["sonata_admission_capacity"][0][1] == 6.0
+    assert parsed["sonata_ttfb_seconds_count"][0][1] == 1.0
+    assert {"source": "admission"} in [l for l, _ in
+                                       parsed["sonata_shed_total"]]
+
+
+def test_serving_runtime_timeout_nonpositive_disables():
+    """--request-timeout-s 0 (or negative) means "no server default",
+    matching the env knob's contract — NOT an already-expired deadline
+    that would fail every request instantly."""
+    for value in (0, -5.0):
+        rt = ServingRuntime(request_timeout_s=value)
+        assert rt.request_timeout_s is None
+        dl = rt.deadline_for(None)
+        assert dl.remaining() is None and dl.alive()
+
+
+def test_serving_runtime_register_unregister_voice():
+    rt = ServingRuntime()
+
+    class FakeSched:
+        stats = {"requests": 3, "dispatches": 2, "shed": 1, "expired": 0,
+                 "cancelled": 0}
+
+        @staticmethod
+        def queue_depth():
+            return 5
+
+    rt.register_voice("v1", scheduler=FakeSched())
+    parsed = parse_prometheus_text(rt.registry.render())
+    assert parsed["sonata_scheduler_queue_depth"] == [({"voice": "v1"}, 5.0)]
+    assert parsed["sonata_scheduler_shed"] == [({"voice": "v1"}, 1.0)]
+    rt.unregister_voice("v1")
+    parsed = parse_prometheus_text(rt.registry.render())
+    assert "sonata_scheduler_queue_depth" not in parsed
+
+
+# ---------------------------------------------------------------------------
+# scheduler: bounded queue + deadline propagation
+# ---------------------------------------------------------------------------
+
+class _BlockingModel:
+    """speak_batch blocks until released; records every dispatched
+    sentence so tests can assert what reached the device."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.dispatched = []
+
+    def get_speakers(self):
+        return None
+
+    def speak_batch(self, sentences, speakers=None, scales=None):
+        self.dispatched.extend(sentences)
+        self.release.wait(10.0)
+        return [object() for _ in sentences]
+
+
+def test_scheduler_queue_full_sheds():
+    from sonata_tpu.synth import BatchScheduler
+
+    model = _BlockingModel()
+    sched = BatchScheduler(model, max_batch=1, max_wait_ms=1.0, max_queue=2)
+    try:
+        first = sched.submit("blocker")  # occupies the worker
+        # wait for the worker to pull "blocker" into its dispatch so the
+        # queue is empty before we fill exactly the two bounded slots
+        deadline = time.monotonic() + 5.0
+        while model.dispatched != ["blocker"]:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        queued = [sched.submit(f"q{i}") for i in range(2)]
+        with pytest.raises(Overloaded):
+            sched.submit("overflow")  # queue holds 2; this one must shed
+        assert sched.stats["shed"] == 1
+    finally:
+        model.release.set()
+        sched.shutdown()
+    assert first.result(1.0) is not None
+    del queued
+
+
+def test_scheduler_expired_item_never_reaches_dispatch():
+    """Acceptance pin: a deadline shorter than the queue wait fails with
+    DeadlineExceeded and the item is dropped BEFORE being packed into a
+    device dispatch."""
+    from sonata_tpu.synth import BatchScheduler
+
+    model = _BlockingModel()
+    sched = BatchScheduler(model, max_batch=4, max_wait_ms=1.0)
+    try:
+        blocker = sched.submit("blocker")  # worker enters speak_batch
+        deadline = time.monotonic() + 5.0
+        while model.dispatched != ["blocker"]:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        doomed = sched.submit("doomed", deadline=Deadline.after(0.05))
+        time.sleep(0.15)  # expire while the worker is still blocked
+        model.release.set()
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=5.0)
+        assert blocker.result(timeout=5.0) is not None
+        assert "doomed" not in model.dispatched
+        assert sched.stats["expired"] == 1
+    finally:
+        model.release.set()
+        sched.shutdown()
+
+
+def test_scheduler_cancelled_item_dropped():
+    from sonata_tpu.synth import BatchScheduler
+
+    model = _BlockingModel()
+    sched = BatchScheduler(model, max_batch=4, max_wait_ms=1.0)
+    try:
+        blocker = sched.submit("blocker")
+        deadline = time.monotonic() + 5.0
+        while model.dispatched != ["blocker"]:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        dl = Deadline.none()
+        fut = sched.submit("hung-up", deadline=dl)
+        dl.cancel()  # client disconnected
+        model.release.set()
+        blocker.result(timeout=5.0)
+        # poll: the worker cancels the future in its next gather pass
+        # (cf.wait never reports a bare-cancelled future as done)
+        deadline = time.monotonic() + 5.0
+        while not fut.cancelled():
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        assert "hung-up" not in model.dispatched
+        assert sched.stats["cancelled"] == 1
+    finally:
+        model.release.set()
+        sched.shutdown()
+
+
+def test_scheduler_rejects_expired_at_submit():
+    from sonata_tpu.synth import BatchScheduler
+
+    sched = BatchScheduler(_BlockingModel(), max_batch=1, max_wait_ms=1.0)
+    try:
+        dl = Deadline.after(-1.0)  # already expired
+        with pytest.raises(DeadlineExceeded):
+            sched.submit("late", deadline=dl)
+    finally:
+        sched.shutdown()
+
+
+def test_scheduler_submit_shutdown_race_fails_future():
+    """Satellite pin: a submit that passes the _closed check but lands
+    its item after shutdown()'s drain must still resolve the future
+    (OperationError), not leave the caller blocked forever."""
+    from sonata_tpu.synth import BatchScheduler
+
+    voice = tiny_voice(seed=9)
+    sched = BatchScheduler(voice, max_batch=1, max_wait_ms=1.0)
+
+    class RacingQueue:
+        """Delegates to the real queue, but the first real item's put
+        triggers a full shutdown first — deterministically reproducing
+        the submit/shutdown interleaving."""
+
+        def __init__(self, q):
+            self._q = q
+            self._armed = True
+
+        def put_nowait(self, item):
+            if item is not None and self._armed:
+                self._armed = False
+                sched.shutdown()  # drain runs BEFORE the item lands
+            return self._q.put_nowait(item)
+
+        def __getattr__(self, name):
+            return getattr(self._q, name)
+
+    sched._queue = RacingQueue(sched._queue)
+    fut = sched.submit("raced")
+    with pytest.raises(OperationError, match="shut down"):
+        fut.result(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# service-level: overload and deadline through the gRPC service code
+# (no network; fake contexts — fast and deterministic)
+# ---------------------------------------------------------------------------
+
+class _AbortCalled(Exception):
+    def __init__(self, code, msg):
+        self.code = code
+        self.msg = msg
+        super().__init__(f"{code}: {msg}")
+
+
+class _Ctx:
+    def __init__(self, remaining=None):
+        self._remaining = remaining
+        self.callbacks = []
+
+    def time_remaining(self):
+        return self._remaining
+
+    def add_callback(self, cb):
+        self.callbacks.append(cb)
+
+    def abort(self, code, msg):
+        raise _AbortCalled(code, msg)
+
+
+@pytest.fixture(scope="module")
+def batching_service(tmp_path_factory):
+    import grpc
+
+    from sonata_tpu.frontends import grpc_messages as pb
+    from sonata_tpu.frontends import grpc_server as srv
+    from voices import write_tiny_voice
+
+    cfg = str(write_tiny_voice(tmp_path_factory.mktemp("serving_voice")))
+    runtime = ServingRuntime(max_in_flight=2, max_queue_depth=0,
+                             request_timeout_s=30.0)
+    service = srv.SonataGrpcService(continuous_batching=True,
+                                    runtime=runtime)
+    info = service.LoadVoice(pb.VoicePath(config_path=cfg), _Ctx())
+    # warm the jit cache so test timings aren't dominated by compiles
+    list(service.SynthesizeUtterance(
+        pb.Utterance(voice_id=info.voice_id, text="Warm up."), _Ctx()))
+    yield service, info.voice_id, grpc, pb
+    service.shutdown()
+
+
+def test_service_overload_resource_exhausted(batching_service):
+    """Acceptance pin: more concurrent requests than max_in_flight +
+    max_queue_depth → the excess fails fast with RESOURCE_EXHAUSTED."""
+    service, vid, grpc, pb = batching_service
+    v = service._voices[vid]
+    real = v.voice.speak_batch
+    release = threading.Event()
+
+    def slow(sentences, speakers=None, scales=None):
+        release.wait(10.0)
+        return real(sentences, speakers=speakers, scales=scales)
+
+    v.voice.speak_batch = slow
+    outcomes = []
+
+    def fire():
+        try:
+            outcomes.append(("ok", len(list(service.SynthesizeUtterance(
+                pb.Utterance(voice_id=vid, text="Load test."), _Ctx())))))
+        except _AbortCalled as e:
+            outcomes.append(("abort", e.code))
+
+    try:
+        threads = [threading.Thread(target=fire) for _ in range(5)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)  # let all five reach admission
+        release.set()
+        for t in threads:
+            t.join(timeout=15.0)
+    finally:
+        release.set()
+        v.voice.speak_batch = real
+    codes = sorted(o[1].name for o in outcomes if o[0] == "abort")
+    oks = [o for o in outcomes if o[0] == "ok"]
+    assert len(oks) == 2  # capacity
+    assert codes == ["RESOURCE_EXHAUSTED"] * 3
+    # and the metrics plane saw the sheds
+    parsed = parse_prometheus_text(service.runtime.registry.render())
+    shed = {tuple(sorted(l.items())): n
+            for l, n in parsed["sonata_shed_total"]}
+    assert shed[(("source", "admission"),)] >= 3
+
+
+def test_service_deadline_exceeded_before_dispatch(batching_service):
+    """Acceptance pin: a request whose deadline is shorter than the queue
+    wait aborts DEADLINE_EXCEEDED and its sentence never reaches
+    speak_batch."""
+    service, vid, grpc, pb = batching_service
+    v = service._voices[vid]
+    real = v.voice.speak_batch
+    release = threading.Event()
+    dispatched = []
+
+    def slow(sentences, speakers=None, scales=None):
+        dispatched.extend(sentences)
+        release.wait(10.0)
+        return real(sentences, speakers=speakers, scales=scales)
+
+    v.voice.speak_batch = slow
+    outcomes = []
+
+    def fire_blocker():
+        outcomes.append(("blocker", len(list(service.SynthesizeUtterance(
+            pb.Utterance(voice_id=vid, text="Blocker sentence."),
+            _Ctx())))))
+
+    def fire_doomed():
+        try:
+            list(service.SynthesizeUtterance(
+                pb.Utterance(voice_id=vid, text="Doomed sentence."),
+                _Ctx(remaining=0.2)))
+            outcomes.append(("doomed", "ok"))
+        except _AbortCalled as e:
+            outcomes.append(("doomed", e.code))
+
+    try:
+        t1 = threading.Thread(target=fire_blocker)
+        t1.start()
+        deadline = time.monotonic() + 5.0
+        while not dispatched:  # blocker inside speak_batch
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        t2 = threading.Thread(target=fire_doomed)
+        t2.start()
+        t2.join(timeout=15.0)
+        release.set()
+        t1.join(timeout=15.0)
+    finally:
+        release.set()
+        v.voice.speak_batch = real
+    assert ("doomed", grpc.StatusCode.DEADLINE_EXCEEDED) in outcomes
+    # only the blocker's single sentence ever reached the device
+    assert len(dispatched) == 1
+    assert v.scheduler.stats["expired"] >= 1
+
+
+def test_check_health_rpc(batching_service):
+    service, vid, grpc, pb = batching_service
+    h = service.CheckHealth(pb.Empty(), _Ctx())
+    assert h.live is True
+    assert h.version
+    service.warmup_and_mark_ready()
+    h = service.CheckHealth(pb.Empty(), _Ctx())
+    assert h.ready is True
+
+
+def test_warmup_after_shutdown_never_flips_ready():
+    """A shutdown that begins while the background warmup is still
+    synthesizing must win: the late set_ready is suppressed, so a
+    draining replica never rejoins the serving set."""
+    from sonata_tpu.frontends import grpc_server as srv
+
+    service = srv.SonataGrpcService()  # no voices: warmup is instant
+    service.shutdown()
+    service.warmup_and_mark_ready()
+    assert not service.runtime.health.ready
+    assert service.runtime.health.reason == "shutting down"
+
+
+def test_stream_ttfb_timestamps():
+    """Stage timestamps: streams stamp creation and first item; ttfb_s
+    is None before the first item and positive after."""
+    from sonata_tpu.synth import SpeechSynthesizer
+
+    synth = SpeechSynthesizer(tiny_voice(seed=4))
+    stream = synth.synthesize_lazy("One sentence here.")
+    assert stream.ttfb_s is None
+    next(iter(stream))
+    assert stream.ttfb_s is not None and stream.ttfb_s >= 0.0
+    rt_stream = synth.synthesize_streamed("Another sentence with words.")
+    for _ in rt_stream:
+        break
+    assert rt_stream.ttfb_s is not None and rt_stream.ttfb_s >= 0.0
+    rt_stream.cancel()

@@ -142,6 +142,14 @@ def test_currency_magnitude_words_decline_cents_reading():
         "$ one point two five million raised"
     assert _words(norm_de("3,5 € millionen kosten")) == \
         "3,5 € millionen kosten".replace("3,5 €", "drei komma fünf €")
+    # review finding r07: integer amounts take the same guard — "$3
+    # billion" is "three billion", not "three dollars billion"
+    assert _words(norm_en("a $3 billion deal")) == \
+        "a $ three billion deal"
+    assert _words(norm_en("$20 million raised")) == \
+        "$ twenty million raised"
+    # no magnitude word follows → the plain currency reading stands
+    assert _words(norm_en("$3 each")) == "three dollars each"
 
 
 def test_currency_three_fractional_digits_fall_through():

@@ -5,6 +5,9 @@
 #   1. "Run test suite"  — python -m pytest tests/ -q
 #   2. "Compile check (graft entry, CPU)" — dryrun_multichip on the
 #      virtual 8-device CPU mesh
+#   3. "Serving smoke" — boot the gRPC server with a fake voice, probe
+#      /metrics /healthz /readyz, assert exposition format parses and
+#      readiness flips after warmup (tools/serving_smoke.py)
 #
 # The workflow's dependency-install step is intentionally skipped: this
 # environment (and any dev box that can run the suite at all) already has
@@ -26,12 +29,12 @@ import jax, sys
 print(f"env: python {sys.version.split()[0]}, jax {jax.__version__}")
 EOF
 
-echo "-- step 1/2: python -m pytest tests/ -q $*" | tee -a "$LOG"
+echo "-- step 1/3: python -m pytest tests/ -q $*" | tee -a "$LOG"
 JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     --continue-on-collection-errors "$@" 2>&1 | tee -a "$LOG"
 rc_tests=${PIPESTATUS[0]}
 
-echo "-- step 2/2: graft-entry compile check (8-device CPU mesh)" | tee -a "$LOG"
+echo "-- step 2/3: graft-entry compile check (8-device CPU mesh)" | tee -a "$LOG"
 python - <<'EOF' 2>&1 | tee -a "$LOG"
 import os
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
@@ -43,5 +46,9 @@ m.dryrun_multichip(8)
 EOF
 rc_graft=${PIPESTATUS[0]}
 
-echo "== pytest rc=$rc_tests graft rc=$rc_graft ==" | tee -a "$LOG"
-[ "$rc_tests" -eq 0 ] && [ "$rc_graft" -eq 0 ]
+echo "-- step 3/3: serving smoke (gRPC + /metrics + /healthz + /readyz)" | tee -a "$LOG"
+JAX_PLATFORMS=cpu python tools/serving_smoke.py 2>&1 | tee -a "$LOG"
+rc_smoke=${PIPESTATUS[0]}
+
+echo "== pytest rc=$rc_tests graft rc=$rc_graft smoke rc=$rc_smoke ==" | tee -a "$LOG"
+[ "$rc_tests" -eq 0 ] && [ "$rc_graft" -eq 0 ] && [ "$rc_smoke" -eq 0 ]

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""CI serving smoke: boot the gRPC server with a fake voice, probe the
+metrics/health plane, and assert the serving-runtime contract end to end.
+
+Checks (exit 0 only if all hold):
+
+1. server boots with an ephemeral gRPC port and metrics HTTP port;
+2. ``/healthz`` is 200 from the start, ``/readyz`` is 503 before warmup;
+3. LoadVoice over the real wire + one-utterance warmup flips ``/readyz``
+   to 200 (the rolling-restart readiness gate);
+4. ``/metrics`` serves Prometheus text that the strict parser accepts,
+   including queue-depth, shed, and TTFB-histogram series;
+5. ``CheckHealth`` over gRPC agrees with the HTTP plane.
+
+Run: ``JAX_PLATFORMS=cpu python tools/serving_smoke.py`` (used by
+tools/run_ci_local.sh and .github/workflows/ci.yml).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tests"))
+
+
+def http_get(url: str) -> tuple[int, str]:
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.getcode(), resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import grpc
+
+    from sonata_tpu.frontends import grpc_messages as pb
+    from sonata_tpu.frontends.grpc_server import create_server
+    from sonata_tpu.serving import parse_prometheus_text
+    from voices import write_tiny_voice
+
+    cfg = str(write_tiny_voice(Path(tempfile.mkdtemp(prefix="smoke_voice"))))
+    server, port = create_server(0, continuous_batching=True,
+                                 metrics_port=0, request_timeout_s=60.0)
+    server.start()
+    runtime = server.sonata_runtime
+    base = f"http://127.0.0.1:{runtime.http_port}"
+    print(f"smoke: grpc on :{port}, metrics on {base}")
+
+    failures: list[str] = []
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        print(f"smoke: {'PASS' if ok else 'FAIL'} {name} {detail}")
+        if not ok:
+            failures.append(name)
+
+    code, _ = http_get(base + "/healthz")
+    check("healthz live at boot", code == 200, f"(code {code})")
+    code, body = http_get(base + "/readyz")
+    check("readyz 503 before warmup", code == 503, f"(code {code})")
+
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+
+    def unary(name, req, resp_cls):
+        return channel.unary_unary(
+            f"/sonata_grpc.sonata_grpc/{name}",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=resp_cls.decode)(req)
+
+    info = unary("LoadVoice", pb.VoicePath(config_path=cfg), pb.VoiceInfo)
+    check("LoadVoice over wire", bool(info.voice_id))
+    h = unary("CheckHealth", pb.Empty(), pb.HealthStatus)
+    check("CheckHealth not ready pre-warmup", h.live and not h.ready,
+          f"({h.reason})")
+
+    server.sonata_service.warmup_and_mark_ready()
+    code, body = http_get(base + "/readyz")
+    check("readyz flips 200 after warmup", code == 200, f"(code {code})")
+    h = unary("CheckHealth", pb.Empty(), pb.HealthStatus)
+    check("CheckHealth ready post-warmup", h.live and h.ready,
+          f"({h.reason})")
+
+    # one real synthesis so latency histograms and per-voice series move
+    results = list(channel.unary_stream(
+        "/sonata_grpc.sonata_grpc/SynthesizeUtterance",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.SynthesisResult.decode)(
+        pb.Utterance(voice_id=info.voice_id, text="Smoke test sentence.")))
+    check("SynthesizeUtterance streams audio",
+          len(results) >= 1 and len(results[0].wav_samples) > 0)
+
+    code, text = http_get(base + "/metrics")
+    check("/metrics is 200", code == 200)
+    try:
+        parsed = parse_prometheus_text(text)
+    except ValueError as e:
+        parsed = {}
+        check("exposition format parses", False, f"({e})")
+    else:
+        check("exposition format parses", True,
+              f"({len(parsed)} series names)")
+    for required in ("sonata_ready", "sonata_in_flight",
+                     "sonata_shed_total", "sonata_requests_total",
+                     "sonata_ttfb_seconds_bucket",
+                     "sonata_scheduler_queue_depth"):
+        check(f"series {required}", required in parsed)
+    ttfb_total = sum(v for _labels, v in
+                     parsed.get("sonata_ttfb_seconds_count", []))
+    check("ttfb histogram observed the request", ttfb_total >= 1)
+
+    server.stop(grace=None)
+    server.sonata_service.shutdown()
+    if failures:
+        print(f"smoke: {len(failures)} FAILED: {failures}")
+        return 1
+    print("smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
