@@ -146,6 +146,38 @@ def main() -> None:
             "probe": pol.get("probe"),
         }))
 
+    # replica-pool row: batched throughput fanned across one replica per
+    # local device (1 on a single-chip host — the row then documents the
+    # single-replica baseline; forced multi-device CPU hosts show the
+    # router spreading work).  Reported with the replica count so runs
+    # on different host shapes stay comparable.
+    import jax
+
+    from sonata_tpu.serving import ReplicaPool
+
+    pool = ReplicaPool.for_voice(voice)
+    try:
+        phon = list(voice.phonemize_text(SENTENCE))
+        pool.speak_many(phon)  # warm every routed path once
+        burst = phon * 8
+        t0 = time.perf_counter()
+        audio_s = sum(len(a.samples) for a in pool.speak_many(burst)
+                      ) / synth.audio_output_info().sample_rate
+        elapsed = time.perf_counter() - t0
+        view = pool.stats_view()
+        print(json.dumps({
+            "metric": "replica_pool_audio_s_per_s",
+            "value": round(audio_s / elapsed, 2),
+            "unit": "audio_seconds_per_second",
+            "vs_baseline": None,
+            "replicas": len(pool.replicas),
+            "devices": [str(r.device) for r in pool.replicas],
+            "pool": {k: view[k] for k in ("routed", "dispatches",
+                                          "healthy_replicas")},
+        }))
+    finally:
+        pool.shutdown()
+
 
 if __name__ == "__main__":
     main()

@@ -59,6 +59,15 @@ def build_parser() -> argparse.ArgumentParser:
                    default=REALTIME_DEFAULT_PADDING)
     p.add_argument("--backend", choices=("xla",), default="xla",
                    help="compute backend (XLA/PJRT only)")
+    p.add_argument("--replicas", type=int, default=0,
+                   help="fan batched synthesis across a replica pool: one "
+                        "device-pinned copy of the voice per chip with "
+                        "least-loaded routing and per-replica circuit "
+                        "breaking.  N>0 = that many replicas, -1 = one "
+                        "per local device, 0 = off unless "
+                        "$SONATA_REPLICAS is set (parallel/batched mode "
+                        "and the stdin JSON loop; lazy/realtime modes "
+                        "keep the single default device)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--info", action="store_true",
                    help="print voice metadata as JSON and exit")
@@ -263,7 +272,22 @@ def main(argv=None) -> int:
         policy = getattr(voice, "dispatch_policy", None)
         if policy is not None:  # visible serving shape (backend-adaptive)
             log.info(policy.describe())
-        synth = SpeechSynthesizer(voice)
+        pool = None
+        replicas = args.replicas
+        if not replicas:
+            from ..serving.replicas import env_replica_count
+
+            if env_replica_count() > 0:
+                replicas = -1  # env-enabled; the pool resolves the count
+        if replicas:
+            from ..serving import ReplicaPool
+
+            pool = ReplicaPool.for_voice(
+                voice, replicas if replicas > 0 else None, name="cli")
+            log.info("replica pool over %d device(s): %s",
+                     len(pool.replicas),
+                     [str(r.device) for r in pool.replicas])
+        synth = SpeechSynthesizer(voice, replica_pool=pool)
         runtime = None
         if args.metrics_port is not None or os.environ.get(
                 "SONATA_METRICS_PORT"):
@@ -280,7 +304,12 @@ def main(argv=None) -> int:
                 # real counters (the CLI has no per-request RTF
                 # aggregation path, so no rtf_counter here)
                 runtime.register_voice(
-                    "cli", dispatch_stats=synth.dispatch_stats)
+                    "cli", dispatch_stats=synth.dispatch_stats,
+                    scheduler=pool, replica_pool=pool)
+                if pool is not None:
+                    runtime.health.add_readiness_gate(
+                        "replicas:cli",
+                        lambda: pool.healthy_count() > 0)
                 runtime.health.set_ready("voice loaded")
         _apply_scales(synth, args)
         text = args.text
@@ -292,6 +321,8 @@ def main(argv=None) -> int:
             else:
                 stdin_json_loop(synth, args)
         finally:
+            if pool is not None:
+                pool.shutdown()
             if runtime is not None:
                 runtime.close()
     except SonataError as e:

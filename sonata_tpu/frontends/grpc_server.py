@@ -72,18 +72,29 @@ def voice_id_for(config_path: str) -> str:
 
 class _Voice:
     def __init__(self, voice: PiperVoice, config_path: str, voice_id: str,
-                 continuous_batching: bool = False):
+                 continuous_batching: bool = False, replicas: int = 0):
         self.voice = voice
-        self.synth = SpeechSynthesizer(voice)
         self.config_path = config_path
         self.voice_id = voice_id
         self.rtf = RtfCounter()  # aggregate serving metrics (SURVEY §5)
         self.rtf_logged_at = 0  # watermark for periodic aggregate logging
         self.scheduler = None
-        if continuous_batching:
+        self.pool = None
+        if replicas:
+            # replica pool: one device-pinned copy of the voice per chip,
+            # each with its own continuous-batching scheduler; the pool
+            # slots into the scheduler's place (same submit/stats/shutdown
+            # surface), so every downstream path is shared
+            from ..serving.replicas import ReplicaPool
+
+            self.pool = ReplicaPool.for_voice(
+                voice, replicas if replicas > 0 else None, name=voice_id)
+            self.scheduler = self.pool
+        elif continuous_batching:
             from ..synth.scheduler import BatchScheduler
 
             self.scheduler = BatchScheduler(voice)
+        self.synth = SpeechSynthesizer(voice, replica_pool=self.pool)
 
 
 def _status_for(e: SonataError) -> grpc.StatusCode:
@@ -105,13 +116,31 @@ class SonataGrpcService:
 
     def __init__(self, mesh=None, seed: int = 0,
                  continuous_batching: bool = False,
-                 runtime: Optional[ServingRuntime] = None):
+                 runtime: Optional[ServingRuntime] = None,
+                 replicas: int = 0):
         self._voices: dict[str, _Voice] = {}
         self._lock = threading.RLock()
         self._loading: dict[str, threading.Lock] = {}
         self._mesh = mesh
         self._seed = seed
         self._continuous_batching = continuous_batching
+        #: 0 = no pool; >0 = that many replicas; <0 = one per local
+        #: device.  SONATA_REPLICAS>0 turns the pool on even without the
+        #: flag (resolve_replica_count applies the env inside the pool).
+        self._replicas = replicas
+        if not replicas:
+            from ..serving.replicas import env_replica_count
+
+            if env_replica_count() > 0:
+                self._replicas = -1  # env-enabled: env decides the count
+        # checked AFTER env resolution: SONATA_REPLICAS must not smuggle
+        # a pool past the exclusion either
+        if self._replicas and mesh is not None:
+            raise OperationError(
+                "--replicas (or SONATA_REPLICAS) and --mesh-devices are "
+                "mutually exclusive: a mesh spans the chips as one SPMD "
+                "dispatch, a replica pool gives each chip its own "
+                "failure domain")
         self.runtime = runtime if runtime is not None else ServingRuntime()
         self._draining = threading.Event()
 
@@ -213,8 +242,18 @@ class SonataGrpcService:
                                                  mesh=self._mesh)
                     except SonataError as e:
                         context.abort(_status_for(e), str(e))
-                    v = _Voice(voice, request.config_path, vid,
-                               continuous_batching=self._continuous_batching)
+                    try:
+                        v = _Voice(
+                            voice, request.config_path, vid,
+                            continuous_batching=self._continuous_batching,
+                            replicas=self._replicas)
+                    except SonataError as e:
+                        # pool/scheduler construction failed (e.g. params
+                        # don't fit N times): release the loaded voice's
+                        # worker threads and map the status instead of
+                        # leaking it behind an UNKNOWN
+                        voice.close()
+                        context.abort(_status_for(e), str(e))
                     with self._lock:
                         self._voices[vid] = v
                     break
@@ -223,10 +262,21 @@ class SonataGrpcService:
                         self._loading.pop(vid, None)
         log.info("loaded voice %s from %s", vid, request.config_path)
         # export the voice's existing observability (RTF aggregate,
-        # dispatch counters, scheduler queue) on the metrics plane
+        # dispatch counters, scheduler queue, per-replica gauges) on the
+        # metrics plane
         self.runtime.register_voice(vid, rtf_counter=v.rtf,
                                     dispatch_stats=v.synth.dispatch_stats,
-                                    scheduler=v.scheduler)
+                                    scheduler=v.scheduler,
+                                    replica_pool=v.pool)
+        if v.pool is not None:
+            # zero healthy replicas must flip /readyz: the load balancer
+            # routes around this host until a probe restores a replica
+            self.runtime.health.add_readiness_gate(
+                f"replicas:{vid}",
+                lambda pool=v.pool: pool.healthy_count() > 0)
+            log.info("voice %s: replica pool over %d device(s): %s", vid,
+                     len(v.pool.replicas),
+                     [str(r.device) for r in v.pool.replicas])
         # resolve + surface the backend-adaptive dispatch policy at load
         # time, so the serving shape (coalescing on/off, batch/wait knobs,
         # probe constants) is in the log before traffic arrives
@@ -404,12 +454,20 @@ class SonataGrpcService:
         return pb.Empty()
 
     def _close_voice(self, v: _Voice) -> None:
-        """Tear one voice down in dependency order: scheduler first (its
-        queued futures fail with the OperationError the docstring
+        """Tear one voice down in dependency order: scheduler/pool first
+        (its queued futures fail with the OperationError the docstring
         promises, before the model underneath disappears), then the
-        voice's own worker threads, then the metrics series."""
+        voice's own worker threads, then the readiness gate and metrics
+        series."""
         if v.scheduler is not None:
-            v.scheduler.shutdown()
+            v.scheduler.shutdown()  # a ReplicaPool drains every replica
+        if v.pool is not None:
+            for replica in v.pool.replicas:
+                close = getattr(replica.model, "close", None)
+                if close is not None:
+                    close()
+            self.runtime.health.remove_readiness_gate(
+                f"replicas:{v.voice_id}")
         v.voice.close()
         self.runtime.unregister_voice(v.voice_id)
 
@@ -514,8 +572,14 @@ class SonataGrpcService:
             voices = list(self._voices.values())
         try:
             for v in voices:
-                for _audio in v.synth.synthesize_parallel("Ready."):
-                    pass
+                if v.pool is not None:
+                    # every replica must compile its executables before
+                    # readiness — routed warmup would warm one chip and
+                    # leave the others to pay cold compiles under traffic
+                    v.pool.warmup(list(v.synth.phonemize_text("Ready.")))
+                else:
+                    for _audio in v.synth.synthesize_parallel("Ready."):
+                        pass
             # a shutdown that began while the warmup synthesized (slow
             # cold compile) must win: never flip a draining replica back
             # into the serving set.  Check and set under the same lock
@@ -583,7 +647,8 @@ def create_server(port: Optional[int] = None, *, mesh=None, seed: int = 0,
                   max_in_flight: Optional[int] = None,
                   max_queue_depth: Optional[int] = None,
                   request_timeout_s: Optional[float] = None,
-                  metrics_port: Optional[int] = None
+                  metrics_port: Optional[int] = None,
+                  replicas: int = 0
                   ) -> tuple[grpc.Server, int]:
     from concurrent.futures import ThreadPoolExecutor
 
@@ -595,7 +660,7 @@ def create_server(port: Optional[int] = None, *, mesh=None, seed: int = 0,
                                  request_timeout_s=request_timeout_s)
     service = SonataGrpcService(mesh=mesh, seed=seed,
                                 continuous_batching=continuous_batching,
-                                runtime=runtime)
+                                runtime=runtime, replicas=replicas)
     server = grpc.server(ThreadPoolExecutor(max_workers=max_workers,
                                             thread_name_prefix="sonata_grpc"))
     server.add_generic_rpc_handlers((_Handler(service),))
@@ -636,6 +701,14 @@ def main(argv=None) -> int:
     ap.add_argument("--continuous-batching", action="store_true",
                     help="coalesce concurrent requests into shared device "
                          "dispatches")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="run a replica pool: one device-pinned copy of "
+                         "each voice per chip with least-loaded routing "
+                         "and per-replica circuit breaking (implies "
+                         "continuous batching per replica).  N>0 = that "
+                         "many replicas, -1 = one per local device, 0 = "
+                         "off unless $SONATA_REPLICAS is set.  Mutually "
+                         "exclusive with --mesh-devices")
     ap.add_argument("--mesh-devices", type=int, default=0,
                     help="attach an N-device jax mesh to every loaded "
                          "voice (0 = single device)")
@@ -681,13 +754,16 @@ def main(argv=None) -> int:
                          model_parallel=args.model_parallel)
     elif args.seq_parallel > 1 or args.model_parallel > 1:
         ap.error("--seq-parallel/--model-parallel require --mesh-devices")
+    if args.replicas and args.mesh_devices:
+        ap.error("--replicas and --mesh-devices are mutually exclusive")
 
     server, port = create_server(args.port, host=args.host, mesh=mesh,
                                  continuous_batching=args.continuous_batching,
                                  request_timeout_s=args.request_timeout_s,
                                  metrics_port=args.metrics_port,
                                  max_in_flight=args.max_in_flight,
-                                 max_queue_depth=args.max_queue_depth)
+                                 max_queue_depth=args.max_queue_depth,
+                                 replicas=args.replicas)
     server.start()
     log.info("sonata-tpu gRPC server v%s listening on %s:%d",
              __version__, args.host, port)

@@ -221,6 +221,33 @@ class PiperVoice(BaseModel):
                                 n_speakers=config.num_speakers)
         return cls(config, params, seed=seed, compute_dtype=compute_dtype)
 
+    def replica_for_device(self, device, *,
+                           seed_offset: int = 0) -> "PiperVoice":
+        """A copy of this voice pinned to one device (replica-pool serving).
+
+        ``jax.device_put`` commits the params to ``device``; every jitted
+        dispatch then runs on that chip (a committed operand places the
+        whole computation), so N replicas built from one loaded voice
+        occupy N chips with independent executables, RNG streams
+        (``seed_offset`` keeps replica draws distinct), and jit caches —
+        the isolation the pool's circuit breaker relies on.  Mutually
+        exclusive with a mesh: a mesh makes all chips one SPMD dispatch,
+        a pool makes each chip its own failure domain.
+        """
+        if self.mesh is not None:
+            raise OperationError(
+                "replica pools and device meshes are mutually exclusive "
+                "(a mesh already spans the local chips as one dispatch)")
+        params = jax.device_put(self.params, device)
+        replica = PiperVoice(
+            self.config, params, seed=self._seed + seed_offset,
+            tashkeel=self._tashkeel,
+            compute_dtype=("bfloat16" if self.compute_dtype is not None
+                           else None),
+            dispatch_policy=self._dispatch_policy)
+        replica.device = device
+        return replica
+
     # ------------------------------------------------------------------
     # Model protocol
     # ------------------------------------------------------------------

@@ -156,6 +156,28 @@ def sequence_mask(lengths, max_len: int):
     return (idx < lengths[:, None]).astype(jnp.float32)[..., None]
 
 
+def per_row_normal(rng, shape):
+    """Standard-normal draws with **per-row** keys: row ``i`` of the
+    ``[B, ...]`` output is drawn from ``fold_in(rng, i)`` over the
+    per-row shape alone.
+
+    A single batch-shaped draw makes every row's values a function of the
+    whole batch shape — so padding the batch (mesh data-axis rounding, a
+    coalesced group's dummy rows) silently changes every *real* row's
+    noise, and sharded vs unsharded dispatches of the same sentence
+    diverge (the 6 former test_parallel xfails).  Per-row keys make a
+    row's draw depend only on (key, row index, row shape): batch
+    neighbors and padding rows cannot perturb it, which is also the
+    correctness contract continuous batching needs — a request's audio
+    must not depend on whatever shared its dispatch.  Row shapes stay
+    bucket-stable because both the text and frame axes are bucketed
+    identically with or without a mesh.
+    """
+    keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+        jnp.arange(shape[0]))
+    return jax.vmap(lambda k: jax.random.normal(k, shape[1:]))(keys)
+
+
 def text_encoder(p: Params, hp: VitsHyperParams, ids, x_mask, mesh=None):
     x = p["emb"][ids] * math.sqrt(hp.hidden_channels)  # [B, T, H]
     seq = 0 if mesh is None else mesh.shape.get("seq", 1)
@@ -191,7 +213,7 @@ def duration_predictor_reverse(p: Params, hp: VitsHyperParams, x, x_mask,
     # noise_w may be a scalar or a per-row [B] vector (coalesced batches
     # carry per-request scales)
     noise_w = jnp.reshape(jnp.asarray(noise_w, jnp.float32), (-1, 1, 1))
-    z = jax.random.normal(rng, (b, t, 2)) * noise_w * x_mask
+    z = per_row_normal(rng, (b, t, 2)) * noise_w * x_mask
 
     # reversed flow stack: Flip/ConvFlow pairs (skipping ConvFlow #0), then
     # the elementwise affine
@@ -253,14 +275,20 @@ def generate_path(w_ceil, x_mask, max_frames: int):
     ``w_ceil: [B, T]`` → ``path: [B, T, F]`` with ``path[b, t, f] = 1`` iff
     frame ``f`` belongs to phoneme ``t``.  Pure broadcasting — no scatter,
     no dynamic shapes; the MXU eats the downstream einsum.
+
+    The exclusive prefix sum is ``cum - w`` (exact: durations are small
+    integers), NOT the textbook zero-pad-and-slice concatenate.  On a
+    mesh whose seq axis shards the T dimension, XLA's SPMD partitioner
+    miscompiles the slice+concat shift (observed on jax 0.4.37: path
+    rows off by one frame vs the unsharded graph for identical
+    ``w_ceil`` — the former test_parallel mesh-numeric failures), while
+    the subtraction form partitions correctly under every sharding.
     """
     w = w_ceil * x_mask[..., 0]
     cum = jnp.cumsum(w, axis=1)  # [B, T]
     f = jnp.arange(max_frames)[None, None, :]
     upper = f < cum[..., None]
-    lower = f >= jnp.concatenate(
-        [jnp.zeros_like(cum[:, :1]), cum[:, :-1]], axis=1
-    )[..., None]
+    lower = f >= (cum - w)[..., None]
     return (upper & lower).astype(jnp.float32)
 
 
@@ -273,7 +301,7 @@ def acoustics(p: Params, hp: VitsHyperParams, m_p, logs_p, w_ceil, x_mask,
     path = generate_path(w_ceil, x_mask, max_frames)  # [B, T, F]
     m_p_f = jnp.einsum("btf,btc->bfc", path, m_p)
     logs_p_f = jnp.einsum("btf,btc->bfc", path, logs_p)
-    noise = jax.random.normal(rng, m_p_f.shape)
+    noise = per_row_normal(rng, m_p_f.shape)
     noise_scale = jnp.reshape(jnp.asarray(noise_scale, jnp.float32),
                               (-1, 1, 1))  # scalar or per-row [B]
     z_p = m_p_f + noise * jnp.exp(logs_p_f) * noise_scale

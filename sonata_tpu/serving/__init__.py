@@ -36,6 +36,7 @@ from .metrics import (
     resolve_metrics_port,
     start_http_server,
 )
+from .replicas import ReplicaPool, resolve_replica_count
 
 __all__ = [
     "AdmissionController",
@@ -48,6 +49,8 @@ __all__ = [
     "parse_prometheus_text",
     "resolve_metrics_port",
     "start_http_server",
+    "ReplicaPool",
+    "resolve_replica_count",
     "ServingRuntime",
 ]
 
@@ -141,22 +144,28 @@ class ServingRuntime:
 
     # -- per-voice observability wiring --------------------------------------
     def register_voice(self, voice_id: str, *, rtf_counter=None,
-                       dispatch_stats=None, scheduler=None) -> None:
+                       dispatch_stats=None, scheduler=None,
+                       replica_pool=None) -> None:
         """Export an existing voice's counters as labeled gauge series.
 
         Everything is callback-based: the scrape reads live state, the
         hot path pays nothing.  ``dispatch_stats`` is the zero-arg
         callable from ``PiperVoice.dispatch_stats`` /
-        ``SpeechSynthesizer.dispatch_stats``.
+        ``SpeechSynthesizer.dispatch_stats``.  ``replica_pool`` adds the
+        per-replica series (outstanding, dispatches, breaker state,
+        device id) and pool-level routing counters.
         """
         r = self.registry
         lbl = {"voice": voice_id}
         owned = self._voice_series.setdefault(voice_id, [])
 
-        def voice_gauge(name, help, fn):
+        def labeled_gauge(name, help, fn, labels):
             metric = r.gauge(name, help)
-            metric.labels(**lbl).set_function(fn)
-            owned.append(metric)
+            metric.labels(**labels).set_function(fn)
+            owned.append((metric, labels))
+
+        def voice_gauge(name, help, fn):
+            labeled_gauge(name, help, fn, lbl)
 
         if rtf_counter is not None:
             def stat(attr):
@@ -190,8 +199,11 @@ class ServingRuntime:
                         "Items waiting in the batch scheduler, per voice.",
                         lambda: float(scheduler.queue_depth()))
 
+            # stats_view() instead of raw .stats: a ReplicaPool passed as
+            # the voice's scheduler aggregates its per-replica scheduler
+            # counters under the same keys
             def sched_stat(key):
-                return lambda: float(scheduler.stats.get(key, 0))
+                return lambda: float(scheduler.stats_view().get(key, 0))
 
             for key, help in (
                     ("requests", "Scheduler items submitted"),
@@ -203,15 +215,67 @@ class ServingRuntime:
                     ("shed", "Scheduler items rejected on a full queue")):
                 voice_gauge(f"sonata_scheduler_{key}",
                             f"{help}, per voice.", sched_stat(key))
+        if replica_pool is not None:
+            self._register_replica_pool(voice_id, replica_pool,
+                                        labeled_gauge, voice_gauge)
+
+    def _register_replica_pool(self, voice_id, pool, labeled_gauge,
+                               voice_gauge) -> None:
+        """Per-replica gauges + pool-level routing/breaker counters.
+
+        Replica series carry a ``replica`` label next to ``voice``; the
+        breaker state gauge is numeric (0 closed / 1 half-open / 2 open)
+        so a dashboard can alert on ``> 0``.
+        """
+        for replica in pool.replicas:
+            rl = {"voice": voice_id, "replica": str(replica.index)}
+
+            def attr(r, name):
+                return lambda: float(getattr(r, name))
+
+            labeled_gauge("sonata_replica_outstanding",
+                          "Requests routed to a replica and not yet "
+                          "resolved.", attr(replica, "outstanding"), rl)
+            labeled_gauge("sonata_replica_dispatches",
+                          "Successful device dispatches, per replica.",
+                          attr(replica, "dispatches"), rl)
+            labeled_gauge("sonata_replica_dispatch_failures",
+                          "Failed device dispatches, per replica.",
+                          attr(replica, "dispatch_failures"), rl)
+            labeled_gauge("sonata_replica_breaker_state",
+                          "Circuit breaker: 0 closed, 1 half-open, "
+                          "2 open.", attr(replica, "state"), rl)
+            labeled_gauge("sonata_replica_device",
+                          "JAX device id this replica is pinned to.",
+                          lambda r=replica: float(r.device_id), rl)
+
+        def pool_stat(key):
+            return lambda: float(pool.stats.get(key, 0))
+
+        for key, help in (
+                ("routed", "Requests routed into the replica pool"),
+                ("resubmitted", "Requests resubmitted to another replica "
+                                "after a replica fault"),
+                ("failed", "Requests that failed out of the pool"),
+                ("breaker_opens", "Circuit-breaker trips"),
+                ("recovered", "Breakers closed again by a successful "
+                              "trial")):
+            voice_gauge(f"sonata_pool_{key}", f"{help}, per voice.",
+                        pool_stat(key))
+        voice_gauge("sonata_pool_healthy_replicas",
+                    "Replicas currently accepting traffic, per voice.",
+                    lambda: float(pool.healthy_count()))
+        voice_gauge("sonata_pool_replicas",
+                    "Total replicas in the pool, per voice.",
+                    lambda: float(len(pool.replicas)))
 
     def unregister_voice(self, voice_id: str) -> None:
         """Drop a voice's labeled series after UnloadVoice — exactly the
-        ones register_voice created (recorded per voice, so the two
-        methods cannot drift apart), releasing the closures that would
-        otherwise pin the unloaded voice's objects."""
-        lbl = {"voice": voice_id}
-        for metric in self._voice_series.pop(voice_id, []):
-            metric.remove(**lbl)
+        (metric, labels) pairs register_voice created (recorded per
+        voice, so the two methods cannot drift apart), releasing the
+        closures that would otherwise pin the unloaded voice's objects."""
+        for metric, labels in self._voice_series.pop(voice_id, []):
+            metric.remove(**labels)
 
     def close(self) -> None:
         if self.http is not None:

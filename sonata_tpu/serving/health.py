@@ -35,6 +35,13 @@ class HealthState:
         self._ready = threading.Event()
         self._reason = "starting: voices not loaded"
         self._ready_at: Optional[float] = None
+        #: named predicates evaluated at every readiness read: the
+        #: process is ready only when the event is set AND every gate
+        #: holds.  This is how live conditions (e.g. "this voice's
+        #: replica pool has a healthy replica") flip /readyz without
+        #: anyone having to call set_not_ready at the right moment —
+        #: and flip it back on recovery just as automatically.
+        self._gates: dict = {}
         if registry is not None:
             registry.gauge(
                 "sonata_up", "Process liveness (1 = live)."
@@ -61,10 +68,36 @@ class HealthState:
     # -- readiness -----------------------------------------------------------
     @property
     def ready(self) -> bool:
-        return self._ready.is_set()
+        return self._ready.is_set() and self._failing_gate() is None
+
+    def _failing_gate(self) -> Optional[str]:
+        """Name of the first failing readiness gate, or None.  A gate
+        that raises counts as failing (fail-safe: an error evaluating
+        health must read as unhealthy, never as healthy)."""
+        with self._lock:
+            gates = list(self._gates.items())
+        for name, fn in gates:
+            try:
+                if not fn():
+                    return name
+            except Exception:
+                return name
+        return None
+
+    def add_readiness_gate(self, name: str, fn) -> None:
+        """Register a zero-arg predicate that must hold for readiness."""
+        with self._lock:
+            self._gates[name] = fn
+
+    def remove_readiness_gate(self, name: str) -> None:
+        with self._lock:
+            self._gates.pop(name, None)
 
     @property
     def reason(self) -> str:
+        gate = self._failing_gate()
+        if gate is not None and self._ready.is_set():
+            return f"readiness gate failing: {gate}"
         with self._lock:
             return self._reason
 
@@ -85,6 +118,7 @@ class HealthState:
         return self._ready.wait(timeout)
 
     def snapshot(self) -> dict:
+        ready = self.ready
+        reason = self.reason
         with self._lock:
-            return {"live": self._live, "ready": self._ready.is_set(),
-                    "reason": self._reason}
+            return {"live": self._live, "ready": ready, "reason": reason}

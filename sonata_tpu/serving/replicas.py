@@ -1,0 +1,554 @@
+"""Multi-device replica pool: route, batch, and fail over across chips.
+
+A host with 4 or 8 accelerator chips serving through one
+:class:`~sonata_tpu.synth.scheduler.BatchScheduler` uses exactly one chip
+— the scheduler owns a single worker issuing ``speak_batch`` against
+whatever device JAX picked by default — and a single device fault kills
+the whole voice.  This module is the standard next step for an inference
+stack (cf. Orca's iteration-level scheduling, OSDI '22; AlpaServe's
+replica placement, OSDI '23): **replica-pool serving**.
+
+- :class:`ReplicaPool` owns one :class:`Replica` per JAX local device
+  (or a ``SONATA_REPLICAS=N`` prefix subset).  Each replica holds its
+  own device-placed copy of the model (``jax.device_put`` of the params
+  at pool construction pins every dispatch to that replica's chip — a
+  committed operand places the whole XLA computation) and its own
+  ``BatchScheduler``, so continuous batching happens *per chip*.
+- The **router** submits each request to the healthy replica with the
+  least outstanding work.  Deadlines and admission compose unchanged:
+  the pool exposes the scheduler's ``submit/speak/queue_depth/stats``
+  surface, so everything upstream (gRPC deadline propagation, admission
+  shedding, metrics) works identically with or without a pool.
+- **Fault isolation**: a replica whose device dispatches fail
+  ``SONATA_REPLICA_BREAKER_THRESHOLD`` consecutive times (default 3) is
+  circuit-broken — drained (its scheduler shut down; queued work fails
+  out and is resubmitted), and every request that failed on it is
+  resubmitted **exactly once** to a healthy replica, so a single sick
+  chip degrades capacity instead of failing requests.  After
+  ``SONATA_REPLICA_PROBE_INTERVAL_S`` (default 5 s) the breaker goes
+  **half-open**: the router hands the replica one trial request; success
+  closes the breaker, failure re-opens it for another probe interval.
+- **Health integration**: ``healthy_count()`` backs a readiness gate —
+  a pool with zero healthy replicas flips ``/readyz`` (see
+  :meth:`~sonata_tpu.serving.health.HealthState.add_readiness_gate`)
+  so the load balancer routes around the whole host.
+
+Everything is testable on CPU: ``XLA_FLAGS
+=--xla_force_host_platform_device_count=4`` gives four independent host
+devices, and the pool behaves identically (tests/test_replicas.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from concurrent.futures import CancelledError, Future
+from typing import Callable, Optional, Sequence
+
+from ..core import OperationError
+from .admission import Overloaded
+from .deadlines import Deadline, DeadlineExceeded
+
+log = logging.getLogger("sonata.serving")
+
+REPLICAS_ENV = "SONATA_REPLICAS"
+BREAKER_THRESHOLD_ENV = "SONATA_REPLICA_BREAKER_THRESHOLD"
+PROBE_INTERVAL_ENV = "SONATA_REPLICA_PROBE_INTERVAL_S"
+DEFAULT_BREAKER_THRESHOLD = 3
+DEFAULT_PROBE_INTERVAL_S = 5.0
+
+# breaker states; exported as the numeric value of the
+# sonata_replica_breaker_state gauge (0 = serving, 1 = probing, 2 = out)
+CLOSED, HALF_OPEN, OPEN = 0, 1, 2
+_STATE_NAMES = {CLOSED: "closed", HALF_OPEN: "half-open", OPEN: "open"}
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def env_replica_count() -> int:
+    """``SONATA_REPLICAS`` parsed as a count: 0 when unset, non-positive,
+    or garbage — the one place frontends ask "did the env turn the pool
+    on?" (string truthiness would read the documented ``0 = off`` as
+    on)."""
+    return max(0, _env_int(REPLICAS_ENV, 0))
+
+
+def resolve_replica_count(replicas: Optional[int] = None,
+                          n_devices: Optional[int] = None) -> int:
+    """How many replicas to run: explicit arg > ``SONATA_REPLICAS`` >
+    one per local device; always clamped to [1, local device count]."""
+    if n_devices is None:
+        import jax
+
+        n_devices = max(len(jax.local_devices()), 1)
+    if replicas is None or replicas <= 0:
+        replicas = _env_int(REPLICAS_ENV, 0)
+    if replicas <= 0:
+        replicas = n_devices
+    return max(1, min(replicas, n_devices))
+
+
+def resolve_replica_devices(replicas: Optional[int] = None) -> list:
+    """The device prefix the pool will occupy (deterministic order, so
+    two pools in one process stack onto the same chips predictably)."""
+    import jax
+
+    devices = list(jax.local_devices())
+    return devices[:resolve_replica_count(replicas, len(devices))]
+
+
+class _BreakerModel:
+    """Model wrapper that reports dispatch outcomes to its replica.
+
+    Failure counting must happen at *dispatch* granularity — K requests
+    sharing one failed ``speak_batch`` are one fault, not K — so the
+    breaker taps the model call itself rather than the per-request
+    futures.  Everything else delegates to the wrapped model.
+    """
+
+    def __init__(self, model, replica: "Replica"):
+        self._model = model
+        self._replica = replica
+
+    def speak_batch(self, *args, **kwargs):
+        try:
+            out = self._model.speak_batch(*args, **kwargs)
+        except Exception:
+            self._replica._record_dispatch(ok=False)
+            raise
+        self._replica._record_dispatch(ok=True)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._model, name)
+
+
+class Replica:
+    """One device's serving lane: model copy + scheduler + breaker."""
+
+    def __init__(self, index: int, model, device=None,
+                 scheduler_kwargs: Optional[dict] = None,
+                 pool: "Optional[ReplicaPool]" = None):
+        self.index = index
+        self.device = device
+        self.model = _BreakerModel(model, self)
+        self._scheduler_kwargs = dict(scheduler_kwargs or {})
+        self._pool = pool
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.dispatches = 0        # successful device dispatches
+        self.dispatch_failures = 0  # failed device dispatches
+        self.submitted = 0         # requests routed here (lifetime)
+        self.outstanding = 0       # routed, not yet resolved
+        self.opened_at: Optional[float] = None
+        self.next_probe_at: Optional[float] = None
+        self.scheduler = self._new_scheduler()
+
+    def _new_scheduler(self):
+        from ..synth.scheduler import BatchScheduler
+
+        return BatchScheduler(self.model, **self._scheduler_kwargs)
+
+    @property
+    def device_id(self) -> int:
+        return getattr(self.device, "id", self.index)
+
+    def _record_dispatch(self, *, ok: bool) -> None:
+        pool = self._pool
+        if pool is not None:
+            pool._on_dispatch(self, ok)
+
+    def snapshot(self) -> dict:
+        return {"index": self.index, "device": str(self.device),
+                "state": _STATE_NAMES[self.state],
+                "outstanding": self.outstanding,
+                "submitted": self.submitted,
+                "dispatches": self.dispatches,
+                "dispatch_failures": self.dispatch_failures,
+                "queue_depth": self.scheduler.queue_depth()}
+
+
+class ReplicaPool:
+    """Route requests across per-device replicas with fault isolation.
+
+    Duck-type-compatible with :class:`BatchScheduler` (``submit`` /
+    ``speak`` / ``queue_depth`` / ``stats`` / ``stats_view`` /
+    ``shutdown``), so frontends swap a pool in wherever a scheduler went.
+    """
+
+    def __init__(self, models: Sequence, devices: Optional[Sequence] = None,
+                 *, breaker_threshold: Optional[int] = None,
+                 probe_interval_s: Optional[float] = None,
+                 scheduler_kwargs: Optional[dict] = None,
+                 on_health_change: Optional[Callable[[int], None]] = None,
+                 name: str = "pool"):
+        if not models:
+            raise OperationError("a replica pool needs at least one model")
+        if devices is not None and len(devices) != len(models):
+            raise OperationError(
+                f"{len(models)} models for {len(devices)} devices")
+        self.name = name
+        self.breaker_threshold = max(1, (
+            breaker_threshold if breaker_threshold is not None
+            else _env_int(BREAKER_THRESHOLD_ENV, DEFAULT_BREAKER_THRESHOLD)))
+        self.probe_interval_s = max(0.01, (
+            probe_interval_s if probe_interval_s is not None
+            else _env_float(PROBE_INTERVAL_ENV, DEFAULT_PROBE_INTERVAL_S)))
+        self._lock = threading.RLock()
+        self._closed = False
+        self._on_health_change = on_health_change
+        #: pool-level counters (replica-level ones live on each Replica)
+        self.stats = {"routed": 0, "resubmitted": 0, "failed": 0,
+                      "breaker_opens": 0, "recovered": 0}
+        self.replicas = [
+            Replica(i, m, device=(devices[i] if devices else None),
+                    scheduler_kwargs=scheduler_kwargs, pool=self)
+            for i, m in enumerate(models)]
+        self._probe_wake = threading.Event()
+        self._prober = threading.Thread(target=self._probe_loop,
+                                        name="sonata_replica_probe",
+                                        daemon=True)
+        self._prober.start()
+
+    # -- construction ---------------------------------------------------------
+    @classmethod
+    def for_voice(cls, voice, replicas: Optional[int] = None,
+                  **kwargs) -> "ReplicaPool":
+        """One replica per local device (or the ``SONATA_REPLICAS`` /
+        ``replicas`` prefix), each with the voice's params
+        ``jax.device_put`` onto its chip."""
+        devices = resolve_replica_devices(replicas)
+        models = [voice.replica_for_device(d, seed_offset=i)
+                  for i, d in enumerate(devices)]
+        return cls(models, devices, **kwargs)
+
+    # -- scheduler-compatible surface ----------------------------------------
+    def submit(self, phonemes: str, speaker: Optional[int] = None,
+               scales=None,
+               deadline: Optional[Deadline] = None) -> "Future":
+        """Route one request to the least-loaded healthy replica.
+
+        Returns a pool-level future.  A dispatch-level failure on the
+        chosen replica resubmits the request exactly once to a different
+        healthy replica before the client sees an error; request-level
+        errors (bad speaker, expired deadline, full queue) propagate
+        unchanged — they would fail identically anywhere.
+        """
+        if self._closed:
+            raise OperationError("replica pool is shut down")
+        outer: "Future" = Future()
+        with self._lock:
+            self.stats["routed"] += 1
+        self._route(outer, phonemes, speaker, scales, deadline,
+                    resubmits_left=1, exclude=())
+        return outer
+
+    def speak(self, phonemes: str, timeout: Optional[float] = None,
+              speaker: Optional[int] = None, scales=None,
+              deadline: Optional[Deadline] = None):
+        return self.submit(phonemes, speaker=speaker, scales=scales,
+                           deadline=deadline).result(timeout)
+
+    def speak_many(self, phoneme_list: Sequence[str], *, speaker=None,
+                   scales=None, deadline: Optional[Deadline] = None,
+                   timeout: Optional[float] = None) -> list:
+        """Submit a batch of sentences across the pool and gather results
+        in order (the CLI's / batched stream's fan-out)."""
+        futures = [self.submit(p, speaker=speaker, scales=scales,
+                               deadline=deadline) for p in phoneme_list]
+        return [f.result(timeout) for f in futures]
+
+    def warmup(self, phoneme_list: Sequence[str]) -> None:
+        """Run the given sentences through EVERY healthy replica (not the
+        router) and wait.  Readiness warmup must compile each chip's
+        executables — routed traffic would warm only the least-loaded
+        replica and leave the rest to pay cold XLA compiles under real
+        load."""
+        futures = [r.scheduler.submit(p)
+                   for r in self.replicas if r.state == CLOSED
+                   for p in phoneme_list]
+        for fut in futures:
+            fut.result()
+
+    def queue_depth(self) -> int:
+        return sum(r.scheduler.queue_depth() for r in self.replicas)
+
+    def stats_view(self) -> dict:
+        """Aggregate scheduler stats across replicas plus the pool's own
+        routing/breaker counters — same keys a lone ``BatchScheduler``
+        exposes, so log lines and benches read either transparently."""
+        agg = {"requests": 0, "dispatches": 0, "shed": 0, "expired": 0,
+               "cancelled": 0}
+        for r in self.replicas:
+            for k, v in r.scheduler.stats_view().items():
+                if k in agg:
+                    agg[k] += v
+        agg["coalescing_ratio"] = round(
+            agg["requests"] / max(agg["dispatches"], 1), 3)
+        with self._lock:
+            agg.update(self.stats)
+            agg["replicas"] = len(self.replicas)
+            agg["healthy_replicas"] = self._healthy_count_locked()
+        return agg
+
+    def shutdown(self) -> None:
+        """Drain the whole pool: every replica's scheduler shuts down and
+        fails its queued work (no resubmission — the pool is closing)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._probe_wake.set()
+        for r in self.replicas:
+            r.scheduler.shutdown()
+        self._prober.join(timeout=5.0)
+
+    # -- health ---------------------------------------------------------------
+    def _healthy_count_locked(self) -> int:
+        return sum(1 for r in self.replicas if r.state != OPEN)
+
+    def healthy_count(self) -> int:
+        """Replicas currently accepting traffic (closed or probing)."""
+        with self._lock:
+            return self._healthy_count_locked()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"name": self.name, "closed": self._closed,
+                    "healthy": self._healthy_count_locked(),
+                    "stats": dict(self.stats),
+                    "replicas": [r.snapshot() for r in self.replicas]}
+
+    def _notify_health(self) -> None:
+        cb = self._on_health_change
+        if cb is not None:
+            try:
+                cb(self.healthy_count())
+            except Exception:
+                log.exception("replica-pool health callback failed")
+
+    # -- routing --------------------------------------------------------------
+    def _pick(self, exclude: tuple) -> Replica:
+        with self._lock:
+            # a half-open replica with nothing in flight gets the next
+            # request as its trial — that's how the breaker closes again
+            for r in self.replicas:
+                if (r.state == HALF_OPEN and r.outstanding == 0
+                        and r not in exclude):
+                    r.outstanding += 1
+                    r.submitted += 1
+                    return r
+            closed = [r for r in self.replicas
+                      if r.state == CLOSED and r not in exclude]
+            if not closed:
+                raise Overloaded(
+                    f"replica pool {self.name!r}: no healthy replica "
+                    f"available ({self._healthy_count_locked()} of "
+                    f"{len(self.replicas)} non-open)")
+            best = min(closed, key=lambda r: r.outstanding)
+            best.outstanding += 1
+            best.submitted += 1
+            return best
+
+    def _release(self, replica: Replica) -> None:
+        with self._lock:
+            if replica.outstanding > 0:
+                replica.outstanding -= 1
+
+    def _route(self, outer: "Future", phonemes, speaker, scales, deadline,
+               *, resubmits_left: int, exclude: tuple) -> None:
+        tried = list(exclude)
+        while True:
+            try:
+                replica = self._pick(tuple(tried))
+            except Overloaded as e:
+                self._fail(outer, e)
+                return
+            try:
+                inner = replica.scheduler.submit(
+                    phonemes, speaker=speaker, scales=scales,
+                    deadline=deadline)
+            except (Overloaded, DeadlineExceeded) as e:
+                # request-level refusal: a full per-replica queue or an
+                # already-dead deadline would refuse anywhere — surface it
+                self._release(replica)
+                self._fail(outer, e)
+                return
+            except OperationError as e:
+                self._release(replica)
+                if "shut down" in str(e) and not self._closed:
+                    # raced a concurrent breaker-open drain on this
+                    # replica: no dispatch happened, so retrying another
+                    # replica does not spend the resubmit budget
+                    tried.append(replica)
+                    continue
+                self._fail(outer, e)
+                return
+            break
+        inner.add_done_callback(
+            lambda fut, r=replica: self._on_done(
+                outer, fut, r, phonemes, speaker, scales, deadline,
+                resubmits_left))
+
+    def _on_done(self, outer: "Future", inner: "Future", replica: Replica,
+                 phonemes, speaker, scales, deadline,
+                 resubmits_left: int) -> None:
+        self._release(replica)
+        try:
+            result = inner.result()
+        except CancelledError:
+            outer.cancel()
+            return
+        except (DeadlineExceeded, Overloaded) as e:
+            self._fail(outer, e)  # the request's own fault, not the chip's
+            return
+        except Exception as e:
+            # replica-fault path (device dispatch error, or the replica
+            # was drained under us): fail over — once
+            if (resubmits_left > 0 and not self._closed
+                    and (deadline is None or deadline.alive())):
+                with self._lock:
+                    self.stats["resubmitted"] += 1
+                log.warning("pool %s: resubmitting request off replica %d "
+                            "(%s)", self.name, replica.index, e)
+                self._route(outer, phonemes, speaker, scales, deadline,
+                            resubmits_left=resubmits_left - 1,
+                            exclude=(replica,))
+                return
+            self._fail(outer, e)
+            return
+        try:
+            outer.set_result(result)
+        except Exception:
+            pass  # outer was cancelled; tolerated like the scheduler does
+
+    def _fail(self, outer: "Future", exc: Exception) -> None:
+        with self._lock:
+            self.stats["failed"] += 1
+        try:
+            outer.set_exception(exc)
+        except Exception:
+            pass
+
+    # -- breaker --------------------------------------------------------------
+    def _on_dispatch(self, replica: Replica, ok: bool) -> None:
+        """Dispatch-granular breaker bookkeeping (called by the
+        replica's :class:`_BreakerModel` around every ``speak_batch``)."""
+        to_drain = None
+        with self._lock:
+            if ok:
+                replica.dispatches += 1
+                replica.consecutive_failures = 0
+                if replica.state == HALF_OPEN:
+                    replica.state = CLOSED
+                    self.stats["recovered"] += 1
+                    log.info("pool %s: replica %d trial dispatch "
+                             "succeeded; breaker closed", self.name,
+                             replica.index)
+                    notify = True
+                else:
+                    notify = False
+            else:
+                replica.dispatch_failures += 1
+                replica.consecutive_failures += 1
+                trip = (replica.state == HALF_OPEN
+                        or (replica.state == CLOSED
+                            and replica.consecutive_failures
+                            >= self.breaker_threshold))
+                notify = trip
+                if trip:
+                    replica.state = OPEN
+                    replica.opened_at = time.monotonic()
+                    replica.next_probe_at = (replica.opened_at
+                                             + self.probe_interval_s)
+                    self.stats["breaker_opens"] += 1
+                    to_drain = replica.scheduler
+                    log.error(
+                        "pool %s: replica %d circuit-broken after %d "
+                        "consecutive dispatch failures; draining "
+                        "(next probe in %.1fs)", self.name, replica.index,
+                        replica.consecutive_failures,
+                        self.probe_interval_s)
+        if to_drain is not None:
+            # drain off-thread: shutdown() joins the scheduler worker —
+            # the very thread this callback may be running on
+            threading.Thread(
+                target=to_drain.shutdown,
+                name=f"sonata_replica_drain_{replica.index}",
+                daemon=True).start()
+            self._probe_wake.set()  # re-arm the prober's timer
+        if notify:
+            self._notify_health()
+
+    def force_open(self, index: int, reason: str = "operator") -> None:
+        """Trip one replica's breaker by hand (ops escape hatch; also
+        what the CI smoke uses to prove readiness survives a dead chip)."""
+        with self._lock:
+            replica = self.replicas[index]
+            if replica.state == OPEN:
+                return
+            replica.state = OPEN
+            replica.opened_at = time.monotonic()
+            replica.next_probe_at = replica.opened_at + self.probe_interval_s
+            self.stats["breaker_opens"] += 1
+            sched = replica.scheduler
+        log.warning("pool %s: replica %d force-opened (%s)", self.name,
+                    index, reason)
+        threading.Thread(target=sched.shutdown,
+                         name=f"sonata_replica_drain_{index}",
+                         daemon=True).start()
+        self._probe_wake.set()
+        self._notify_health()
+
+    def _probe_loop(self) -> None:
+        """Flip OPEN replicas to HALF_OPEN once their probe time comes;
+        the router then hands each exactly one trial request."""
+        while not self._closed:
+            with self._lock:
+                due = [r for r in self.replicas
+                       if r.state == OPEN and r.next_probe_at is not None]
+                now = time.monotonic()
+                wait = min((r.next_probe_at - now for r in due),
+                           default=self.probe_interval_s)
+            if wait > 0:
+                self._probe_wake.wait(timeout=wait)
+                self._probe_wake.clear()
+                continue
+            changed = False
+            with self._lock:
+                if self._closed:
+                    # shutdown() may have drained the replicas between
+                    # our loop check and here — installing a fresh
+                    # scheduler now would leak its worker thread
+                    return
+                now = time.monotonic()
+                for r in self.replicas:
+                    if (r.state == OPEN and r.next_probe_at is not None
+                            and now >= r.next_probe_at):
+                        # fresh scheduler: the old one was drained at trip
+                        # time.  Push the next probe out now, so a trial
+                        # that fails before its own _on_dispatch runs
+                        # cannot re-probe in a tight loop.
+                        r.next_probe_at = now + self.probe_interval_s
+                        r.consecutive_failures = 0
+                        r.scheduler = r._new_scheduler()
+                        r.state = HALF_OPEN
+                        changed = True
+                        log.info("pool %s: replica %d half-open; next "
+                                 "request is its trial", self.name, r.index)
+            if changed:
+                self._notify_health()

@@ -53,10 +53,21 @@ def synthesis_thread_pool() -> ThreadPoolExecutor:
 
 
 class SpeechSynthesizer:
-    """Wraps a model; adds output-config processing and stream modes."""
+    """Wraps a model; adds output-config processing and stream modes.
 
-    def __init__(self, model: Model):
+    ``replica_pool``: optional
+    :class:`~sonata_tpu.serving.replicas.ReplicaPool` — when present,
+    batched synthesis fans its sentences out across the pool's
+    per-device replicas (least-loaded routing, circuit-broken chips
+    skipped) instead of one ``speak_batch`` on the default device.
+    Lazy/realtime streams keep using the wrapped model directly: their
+    latency profile wants one device's stream coalescers, not a
+    round-trip through the pool router.
+    """
+
+    def __init__(self, model: Model, replica_pool=None):
         self.model = model
+        self.replica_pool = replica_pool
 
     # -- delegation (reference :205-247) ------------------------------------
     def audio_output_info(self):
@@ -85,7 +96,11 @@ class SpeechSynthesizer:
 
     def close(self) -> None:
         """Release the wrapped model's resources (worker threads); the
-        synthesizer delegates like every other model method."""
+        synthesizer delegates like every other model method.  An attached
+        replica pool drains first, so its queued work fails out before
+        the models underneath disappear."""
+        if self.replica_pool is not None:
+            self.replica_pool.shutdown()
         close = getattr(self.model, "close", None)
         if close is not None:
             close()
@@ -230,7 +245,22 @@ class SpeechStreamBatched(_StageTimestamps):
                  output_config: Optional[AudioOutputConfig]):
         super().__init__()
         sentences = list(phonemes)
-        audios = synth.model.speak_batch(sentences) if sentences else []
+        if not sentences:
+            audios = []
+        elif synth.replica_pool is not None:
+            # fan the sentences across the replica pool: each sentence
+            # rides a per-device scheduler (coalescing with concurrent
+            # requests there), results gather in input order.  The
+            # ORIGINAL voice's fallback config travels as explicit
+            # per-request speaker/scales — the replicas are device-pinned
+            # copies whose own configs never see this voice's
+            # SetSynthesisOptions/CLI-scale mutations.
+            sc = synth.get_fallback_synthesis_config()
+            sid = sc.speaker[1] if getattr(sc, "speaker", None) else None
+            audios = synth.replica_pool.speak_many(sentences, speaker=sid,
+                                                   scales=sc)
+        else:
+            audios = synth.model.speak_batch(sentences)
         self._results = [synth._post_process(a, output_config)
                          for a in audios]
         self._idx = 0

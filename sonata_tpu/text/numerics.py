@@ -52,11 +52,14 @@ class NumberGrammar:
     #: "3." vs a sentence-final cardinal).  Returns False ⇒ leave the
     #: match unexpanded.  None ⇒ every pattern match is an ordinal.
     ordinal_guard: Optional[Callable[["re.Match[str]"], bool]] = None
-    #: number-scaling words ("$3.5 billion"): a fractional currency
-    #: amount followed by one of these is a scaled quantity, not
-    #: dollars-and-cents — the currency pass declines and the decimal
-    #: pass reads the number.  Lowercased.
+    #: number-scaling words ("$3.5 billion"): a currency amount followed
+    #: by one of these is a scaled quantity, not dollars-and-cents — the
+    #: currency pass reads number, magnitude, then the major unit
+    #: ("three point five billion dollars").  Lowercased.
     magnitudes: tuple = ()
+    #: spoken minus sign: "-12.5 C" reads "minus twelve point five C";
+    #: without it the expansion leaves a bare hyphen the G2P drops.
+    minus_word: str = "minus"
 
     def read_digits(self, digits: str) -> str:
         """Fractional digits read one by one ("14" → "one four")."""
@@ -76,26 +79,32 @@ def _sub_currency(text: str, g: NumberGrammar) -> str:
     # "$1,000" to "$\x1f1000", so the tag sits exactly here — spelling
     # it out beats relying on Python's \s happening to treat U+001F as
     # whitespace.  3+ fractional digits fall through to the decimal
-    # pass ("$1.999" is not an amount in cents).
+    # pass ("$1.999" is not an amount in cents).  The optional trailing
+    # word is captured so a magnitude ("billion") can reorder the
+    # reading; any other word is put back verbatim.
     pat = re.compile(
         rf"(?:(?P<pre>[{syms}])[\s\x1f]?(?P<a>\d+)"
         rf"(?:{dec}(?P<af>\d{{1,2}})(?!\d))?(?!{dec}\d)"
         rf"|(?P<b>\d+)(?:{dec}(?P<bf>\d{{1,2}})(?!\d))?(?!{dec}\d)"
-        rf"[\s\x1f]?(?P<post>[{syms}]))")
+        rf"[\s\x1f]?(?P<post>[{syms}]))"
+        rf"(?:\s+(?P<nxt>[^\W\d_]+))?")
 
     def _one(m: re.Match) -> str:
         sym = m.group("pre") or m.group("post")
         whole = int(m.group("a") or m.group("b"))
         frac = m.group("af") or m.group("bf")
-        if g.magnitudes:
-            # "$3.5 billion" / "$3 billion" are scaled numbers, not an
-            # amount in dollars-and-cents followed by a stray word:
-            # decline the currency reading and let the decimal/cardinal
-            # pass speak the number (the symbol stays, as pinned by
-            # test_currency_magnitude_words_decline_cents_reading)
-            nxt = re.match(r"\s*([^\W\d_]+)", m.string[m.end():])
-            if nxt and nxt.group(1).lower() in g.magnitudes:
-                return m.group(0)
+        nxt = m.group("nxt")
+        if nxt is not None and g.magnitudes and nxt.lower() in g.magnitudes:
+            # "$3.5 billion" / "$3 billion" are scaled amounts, not
+            # dollars-and-cents followed by a stray word: read the
+            # figure, the magnitude, then the major unit — "three point
+            # five billion dollars" (an integer-only guard here used to
+            # leave the bare symbol behind: "$ three point five billion")
+            num = g.cardinal(whole)
+            if frac:
+                num += " " + g.point_word + " " + g.read_digits(frac)
+            many_major = g.currency[sym][1]
+            return " " + num + " " + nxt + " " + many_major + " "
         one_major, many_major, one_minor, many_minor = g.currency[sym]
         out = g.cardinal(whole) + " " + (
             one_major if whole == 1 else many_major)
@@ -105,6 +114,8 @@ def _sub_currency(text: str, g: NumberGrammar) -> str:
             cents = int(frac) * (10 if len(frac) == 1 else 1)
             out += " " + g.cardinal(cents) + " " + (
                 one_minor if cents == 1 else many_minor)
+        if nxt is not None:  # non-magnitude word: back into the text
+            out += " " + nxt
         return " " + out + " "
 
     return pat.sub(_one, text)
@@ -164,6 +175,22 @@ def _sub_decimals(text: str, g: NumberGrammar) -> str:
 _DEGROUPED = "\x1f"
 
 
+def _sub_negatives(text: str, g: NumberGrammar) -> str:
+    """A sign directly before a number becomes the grammar's minus word
+    ("-12.5 C" → "minus 12.5 C", read on by the decimal/integer passes).
+
+    Only a *leading* sign counts: a digit or word character before the
+    hyphen means a range ("3-5"), a date span ("2021-2022"), or a
+    hyphenated token — those keep their hyphen.  U+2212 (real minus)
+    gets the same treatment.  A currency symbol may sit between sign and
+    digits ("-$5" → "minus $5", which the currency pass then reads).
+    """
+    syms = "".join(re.escape(s) for s in g.currency)
+    ahead = rf"(?=[{syms}]?\d)" if syms else r"(?=\d)"
+    return re.sub(rf"(?<![\w.,{_DEGROUPED}−-])[-−]{ahead}",
+                  g.minus_word + " ", text)
+
+
 def _sub_group_separators(text: str, g: NumberGrammar) -> str:
     """1,000,000 (en) / 1.000.000 (de/es/fr) → plain integer (tagged
     ``_DEGROUPED``), so the later passes read one number, not three —
@@ -179,10 +206,12 @@ def _sub_group_separators(text: str, g: NumberGrammar) -> str:
 
 def expand_numerics(text: str, g: NumberGrammar) -> str:
     """Rewrite every numeric shape in ``text`` through grammar ``g``;
-    pass order: thousands groups (tagging their digits) → currency →
+    pass order: negative signs (so "-12.5" reaches the later passes as
+    "minus 12.5") → thousands groups (tagging their digits) → currency →
     ordinal → year (tag-blind) → decimal.  Bare integers are left for
     the caller's existing ``expand_numbers`` pass (kept separate so
     packs without a grammar lose nothing)."""
+    text = _sub_negatives(text, g)
     text = _sub_group_separators(text, g)
     text = _sub_currency(text, g)
     text = _sub_ordinals(text, g)
@@ -258,6 +287,7 @@ def en_grammar() -> NumberGrammar:
                   "£": ("pound", "pounds", "penny", "pence")},
         magnitudes=("hundred", "thousand", "million", "billion",
                     "trillion"),
+        minus_word="minus",
     )
 
 
@@ -331,6 +361,7 @@ def de_grammar() -> NumberGrammar:
                   "$": ("dollar", "dollar", "sent", "sent")},
         magnitudes=("hundert", "tausend", "million", "millionen",
                     "milliarde", "milliarden", "billion", "billionen"),
+        minus_word="minus",
     )
 
 
@@ -370,6 +401,7 @@ def es_grammar() -> NumberGrammar:
         ordinal_fem=lambda n: re.sub("o$", "a", _es_ordinal(n)),
         magnitudes=("cien", "mil", "millón", "millones", "billón",
                     "billones"),
+        minus_word="menos",
     )
 
 
@@ -412,4 +444,5 @@ def fr_grammar() -> NumberGrammar:
                   "$": ("dollar", "dollars", "centime", "centimes")},
         magnitudes=("cent", "cents", "mille", "million", "millions",
                     "milliard", "milliards"),
+        minus_word="moins",
     )

@@ -16,22 +16,15 @@ from sonata_tpu.models import PiperVoice
 
 from voices import tiny_voice
 
-# ISSUE 2 triage of the 6 pre-existing (since the seed) mesh-numeric
-# failures: NOT a tolerance or shim issue.  A mesh pads the dispatch —
-# batch rows up to a multiple of the data axis (4 rows → 8 on make_mesh(8);
-# observed lengths 512 vs 544 in test_sharded_batch_matches_unsharded) and
-# frames across seq shards — and the model draws duration/decoder noise
-# with ONE per-dispatch PRNG key over batch-shaped tensors, so padded
-# shapes change every real row's draws relative to the unsharded dispatch.
-# These equivalence tests therefore require draw-stability across padding,
-# which needs per-row `jax.random.fold_in` keys in the batch path — a
-# numerics-affecting refactor tracked in ROADMAP "Open items", not a test
-# fix.  strict=False: they pass again wherever the padded shapes coincide.
-mesh_numeric_xfail = pytest.mark.xfail(
-    strict=False,
-    reason="mesh padding changes the shapes the per-dispatch PRNG key "
-           "draws over → sharded vs unsharded draws diverge; needs "
-           "per-row fold_in keys (ROADMAP open item)")
+# The 6 mesh-numeric equivalence tests in this file were xfailed between
+# ISSUE 2 and ISSUE 3: a mesh pads the dispatch (batch rows up to a
+# multiple of the data axis; 4 rows → 8 on make_mesh(8)) and the model
+# used to draw duration/decoder noise with ONE per-dispatch PRNG key over
+# batch-shaped tensors, so padded shapes changed every real row's draws
+# relative to the unsharded dispatch.  Since `vits.per_row_normal`
+# (per-row `fold_in(key, row)` keys over bucket-stable per-row shapes) a
+# row's draw no longer depends on its batch neighbors or padding rows,
+# and the sharded-vs-unsharded equivalence holds unconditionally.
 
 
 def test_mesh_shapes():
@@ -72,7 +65,6 @@ def test_tensor_parallel_param_shardings():
                jtu.tree_leaves(sh["dp"]))
 
 
-@mesh_numeric_xfail
 def test_tensor_parallel_streaming_matches_unsharded():
     """Streaming (stage coalescer + window decoders) on a dp+sp+tp mesh
     produces the same audio as a single device."""
@@ -87,7 +79,6 @@ def test_tensor_parallel_streaming_matches_unsharded():
     assert np.allclose(plain, tp, atol=2e-4)
 
 
-@mesh_numeric_xfail
 def test_tensor_parallel_batch_matches_unsharded():
     """dp+sp+tp 3-axis mesh produces the same audio as a single device
     (the TP all-reduces are numerically transparent at f32 tolerance)."""
@@ -104,7 +95,6 @@ def test_tensor_parallel_batch_matches_unsharded():
                            np.asarray(am.samples.data), atol=2e-4)
 
 
-@mesh_numeric_xfail
 def test_sharded_batch_matches_unsharded():
     mesh = make_mesh(8)
     v_plain = tiny_voice(seed=11)
@@ -167,7 +157,6 @@ def test_ring_attention_jits_and_shards():
     assert bool(jnp.isfinite(out).all())
 
 
-@mesh_numeric_xfail
 def test_streaming_with_mesh_ignores_dummy_rows():
     mesh = make_mesh(8)
     v = tiny_voice(seed=5)
@@ -254,7 +243,6 @@ def test_seq_parallel_transformer_matches_baseline():
                                    atol=2e-5)
 
 
-@mesh_numeric_xfail
 def test_seq_parallel_batch_matches_unsharded(monkeypatch):
     """speak_batch on a seq_parallel=2 mesh produces the same audio as the
     single-device path — and the encoder really goes through the ring
@@ -345,7 +333,6 @@ def test_full_batch_hlo_shards_frame_domain():
     assert hlo.count("collective-permute") >= 4
 
 
-@mesh_numeric_xfail
 def test_long_utterance_spans_seq_shards():
     """A genuinely long utterance (frame bucket >= 256 ⇒ 128 frames per
     shard at seq=2) produces identical audio sharded vs unsharded — the

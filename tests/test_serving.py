@@ -294,6 +294,12 @@ def test_serving_runtime_register_unregister_voice():
         stats = {"requests": 3, "dispatches": 2, "shed": 1, "expired": 0,
                  "cancelled": 0}
 
+        @classmethod
+        def stats_view(cls):
+            # the contract register_voice reads (BatchScheduler and
+            # ReplicaPool both expose it)
+            return dict(cls.stats)
+
         @staticmethod
         def queue_depth():
             return 5

@@ -132,22 +132,23 @@ def test_currency_single_fractional_digit():
         "zwölf euro fünfzig sent gesamt"
 
 
-def test_currency_magnitude_words_decline_cents_reading():
-    # review finding r06: "$3.5 billion" is a scaled number, not three
-    # dollars fifty cents — the currency pass declines and the decimal
-    # pass reads the figure
+def test_currency_magnitude_words_read_scaled_amount():
+    # review findings r06/r07 + ISSUE-3 satellite: "$3.5 billion" is a
+    # scaled amount — read figure, magnitude, then the major unit.  The
+    # old guard merely declined the cents reading and left a bare "$"
+    # behind ("$ three point five billion")
     assert _words(norm_en("a $3.5 billion deal")) == \
-        "a $ three point five billion deal"
+        "a three point five billion dollars deal"
     assert _words(norm_en("$1.25 million raised")) == \
-        "$ one point two five million raised"
+        "one point two five million dollars raised"
     assert _words(norm_de("3,5 € millionen kosten")) == \
-        "3,5 € millionen kosten".replace("3,5 €", "drei komma fünf €")
-    # review finding r07: integer amounts take the same guard — "$3
-    # billion" is "three billion", not "three dollars billion"
+        "drei komma fünf millionen euro kosten"
+    # integer amounts take the same reading — "three billion dollars",
+    # not "three dollars billion" (r07) and not "$ three billion"
     assert _words(norm_en("a $3 billion deal")) == \
-        "a $ three billion deal"
+        "a three billion dollars deal"
     assert _words(norm_en("$20 million raised")) == \
-        "$ twenty million raised"
+        "twenty million dollars raised"
     # no magnitude word follows → the plain currency reading stands
     assert _words(norm_en("$3 each")) == "three dollars each"
 
@@ -175,6 +176,41 @@ def test_currency_fr():
     assert _words(norm_fr("12,50 € merci")) == \
         "douze euros cinquante centimes merci"
     assert _words(norm_fr("1 € suffit")) == "un euro suffit"
+
+
+# -- negative numbers -------------------------------------------------------
+
+def test_negative_decimal_reads_minus():
+    # ISSUE-3 satellite: "-12.5 C" used to expand to "- twelve point
+    # five C" (bare hyphen survives into the G2P, which drops it)
+    assert _words(norm_en("-12.5 C outside")) == \
+        "minus twelve point five c outside"
+    assert _words(norm_de("-12,5 Grad")) == "minus zwölf komma fünf grad"
+
+
+def test_negative_integer_reads_minus():
+    assert _words(norm_en("it is -5 degrees")) == \
+        "it is minus five degrees"
+    assert _words(norm_en("-5")) == "minus five"
+    assert _words(norm_es("-3 grados")) == "menos tres grados"
+    assert _words(norm_fr("-3 degrés")) == "moins trois degrés"
+
+
+def test_negative_currency_reads_minus():
+    # review finding: the sign sits before the SYMBOL in "-$5", so a
+    # digit-only lookahead left the bare hyphen behind
+    assert _words(norm_en("-$5 fee")) == "minus five dollars fee"
+    assert _words(norm_en("a -€2.50 adjustment")) == \
+        "a minus two euros fifty cents adjustment"
+
+
+def test_hyphen_ranges_keep_their_hyphen():
+    # a digit before the hyphen means a range or span, not a sign
+    out = _words(norm_en("3-5 items"))
+    assert "minus" not in out and "three" in out and "five" in out
+    assert "minus" not in _words(norm_en("2021-2022"))
+    # U+2212 (typographic minus) gets the same sign treatment
+    assert _words(norm_en("−4 outside")) == "minus four outside"
 
 
 # -- interactions -----------------------------------------------------------

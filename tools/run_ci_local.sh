@@ -7,7 +7,12 @@
 #      virtual 8-device CPU mesh
 #   3. "Serving smoke" — boot the gRPC server with a fake voice, probe
 #      /metrics /healthz /readyz, assert exposition format parses and
-#      readiness flips after warmup (tools/serving_smoke.py)
+#      readiness flips after warmup; then re-boot with a 2-replica pool
+#      on 2 forced host devices and assert per-replica gauges + breaker
+#      readiness semantics (tools/serving_smoke.py)
+#   4. "Multi-device lane" — test_replicas on a forced 4-device CPU
+#      host (the replica-pool acceptance shape), plus test_parallel on
+#      its 8-device virtual mesh (make_mesh(8) needs all 8)
 #
 # The workflow's dependency-install step is intentionally skipped: this
 # environment (and any dev box that can run the suite at all) already has
@@ -46,9 +51,21 @@ m.dryrun_multichip(8)
 EOF
 rc_graft=${PIPESTATUS[0]}
 
-echo "-- step 3/3: serving smoke (gRPC + /metrics + /healthz + /readyz)" | tee -a "$LOG"
+echo "-- step 3/4: serving smoke (gRPC + /metrics + /healthz + /readyz + replicas)" | tee -a "$LOG"
 JAX_PLATFORMS=cpu python tools/serving_smoke.py 2>&1 | tee -a "$LOG"
 rc_smoke=${PIPESTATUS[0]}
 
-echo "== pytest rc=$rc_tests graft rc=$rc_graft smoke rc=$rc_smoke ==" | tee -a "$LOG"
-[ "$rc_tests" -eq 0 ] && [ "$rc_graft" -eq 0 ] && [ "$rc_smoke" -eq 0 ]
+echo "-- step 4/4: multi-device lane (replica pool on 4 forced devices)" | tee -a "$LOG"
+XLA_FLAGS=--xla_force_host_platform_device_count=4 JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_replicas.py -q \
+    --continue-on-collection-errors 2>&1 | tee -a "$LOG"
+rc_replicas=${PIPESTATUS[0]}
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_parallel.py -q \
+    --continue-on-collection-errors 2>&1 | tee -a "$LOG"
+rc_parallel=${PIPESTATUS[0]}
+
+echo "== pytest rc=$rc_tests graft rc=$rc_graft smoke rc=$rc_smoke" \
+     "replicas rc=$rc_replicas parallel rc=$rc_parallel ==" | tee -a "$LOG"
+[ "$rc_tests" -eq 0 ] && [ "$rc_graft" -eq 0 ] && [ "$rc_smoke" -eq 0 ] \
+    && [ "$rc_replicas" -eq 0 ] && [ "$rc_parallel" -eq 0 ]

@@ -10,7 +10,10 @@ Checks (exit 0 only if all hold):
    to 200 (the rolling-restart readiness gate);
 4. ``/metrics`` serves Prometheus text that the strict parser accepts,
    including queue-depth, shed, and TTFB-histogram series;
-5. ``CheckHealth`` over gRPC agrees with the HTTP plane.
+5. ``CheckHealth`` over gRPC agrees with the HTTP plane;
+6. a second server boot with ``replicas=2`` on the 2 forced host
+   devices: per-replica gauges appear in ``/metrics``, and readiness
+   survives one breaker-open replica (flipping only at zero healthy).
 
 Run: ``JAX_PLATFORMS=cpu python tools/serving_smoke.py`` (used by
 tools/run_ci_local.sh and .github/workflows/ci.yml).
@@ -26,6 +29,13 @@ import urllib.request
 from pathlib import Path
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the replica-pool phase needs >= 2 devices; force a 2-device CPU host
+# unless the caller already forced a count (idempotent under conftest)
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2"
+                               ).strip()
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tests"))
 
@@ -118,6 +128,79 @@ def main() -> int:
     ttfb_total = sum(v for _labels, v in
                      parsed.get("sonata_ttfb_seconds_count", []))
     check("ttfb histogram observed the request", ttfb_total >= 1)
+
+    server.stop(grace=None)
+    server.sonata_service.shutdown()
+
+    # ---- replica-pool phase: fresh server over the 2 forced devices ----
+    import jax
+
+    # long probe interval: the half-open prober would otherwise restore a
+    # force-opened replica mid-smoke and race the zero-healthy check
+    os.environ["SONATA_REPLICA_PROBE_INTERVAL_S"] = "600"
+    n_dev = len(jax.local_devices())
+    check("host has >= 2 devices for the replica phase", n_dev >= 2,
+          f"({n_dev} devices)")
+    server, port = create_server(0, replicas=2, metrics_port=0,
+                                 request_timeout_s=60.0)
+    server.start()
+    runtime = server.sonata_runtime
+    base = f"http://127.0.0.1:{runtime.http_port}"
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    info = unary("LoadVoice", pb.VoicePath(config_path=cfg), pb.VoiceInfo)
+    v = server.sonata_service._voices[info.voice_id]
+    check("voice runs a 2-replica pool",
+          v.pool is not None and len(v.pool.replicas) == 2)
+    server.sonata_service.warmup_and_mark_ready()
+    code, _ = http_get(base + "/readyz")
+    check("readyz 200 with pool warmed", code == 200, f"(code {code})")
+    check("warmup dispatched on every replica",
+          all(r.dispatches > 0 for r in v.pool.replicas),
+          str([r.snapshot() for r in v.pool.replicas]))
+    code, text = http_get(base + "/metrics")
+    try:
+        parsed = parse_prometheus_text(text)
+    except ValueError as e:
+        parsed = {}
+        check("replica exposition parses", False, f"({e})")
+    else:
+        check("replica exposition parses", True)
+    for required in ("sonata_replica_dispatches",
+                     "sonata_replica_breaker_state",
+                     "sonata_replica_outstanding", "sonata_replica_device",
+                     "sonata_pool_routed", "sonata_pool_healthy_replicas"):
+        series = parsed.get(required, [])
+        check(f"series {required}", bool(series),
+              f"({len(series)} series)")
+    replica_labels = {lbl.get("replica")
+                      for lbl, _v in parsed.get(
+                          "sonata_replica_dispatches", [])}
+    check("per-replica series for both replicas",
+          replica_labels == {"0", "1"}, f"({replica_labels})")
+
+    # one breaker-open replica must degrade capacity, not readiness
+    v.pool.force_open(0, "smoke")
+    code, _ = http_get(base + "/readyz")
+    check("readyz survives one breaker-open replica", code == 200,
+          f"(code {code})")
+    parsed_now = parse_prometheus_text(http_get(base + "/metrics")[1])
+    healthy = [val for _lbl, val in
+               parsed_now.get("sonata_pool_healthy_replicas", [])]
+    check("healthy-replica gauge dropped to 1", healthy == [1.0],
+          f"({healthy})")
+    results = list(channel.unary_stream(
+        "/sonata_grpc.sonata_grpc/SynthesizeUtterance",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.SynthesisResult.decode)(
+        pb.Utterance(voice_id=info.voice_id,
+                     text="Still serving on one replica.")))
+    check("synthesis survives a broken replica",
+          len(results) >= 1 and len(results[0].wav_samples) > 0)
+    # zero healthy replicas is the line readiness must not survive
+    v.pool.force_open(1, "smoke")
+    code, _ = http_get(base + "/readyz")
+    check("readyz 503 at zero healthy replicas", code == 503,
+          f"(code {code})")
 
     server.stop(grace=None)
     server.sonata_service.shutdown()
