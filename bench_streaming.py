@@ -31,7 +31,8 @@ def main() -> None:
                 ("streaming_ttfb_p50_at_8_streams", "ms"),
                 ("stream_decode_coalescing_ratio", "requests_per_dispatch"),
                 ("stream_stage_coalescing_ratio", "requests_per_dispatch"),
-                ("dispatch_policy_coalesce", "bool")):
+                ("dispatch_policy_coalesce", "bool"),
+                ("trace_overhead", "ratio_traced_over_untraced")):
             print(json.dumps({
                 "metric": metric, "value": None, "unit": unit,
                 "vs_baseline": None,
@@ -71,6 +72,54 @@ def main() -> None:
         "value": round(p50 * 1000.0, 2),
         "unit": "ms",
         "vs_baseline": None,  # the reference publishes no TTFB numbers
+    }))
+
+    # tracing overhead on the default config (the ≤2% always-on budget):
+    # identical single-stream TTFB runs with a request trace active vs
+    # not, interleaved so clock drift hits both arms equally.  Traced
+    # runs exercise the real span set (phonemize, encode-ids,
+    # encode-acoustics, decode-window per chunk, postprocess no-op).
+    from sonata_tpu.serving import tracing as _tracing
+
+    _tracer = _tracing.Tracer(enabled=True, recent=8, slowest=4)
+
+    def _one_ttfb(traced: bool) -> float:
+        t0 = time.perf_counter()
+        if traced:
+            with _tracer.trace_request("bench-stream"):
+                stream = synth.synthesize_streamed(SENTENCE,
+                                                   chunk_size=55,
+                                                   chunk_padding=3)
+                next(iter(stream))
+                dt = time.perf_counter() - t0
+                for _chunk in stream:
+                    pass
+        else:
+            stream = synth.synthesize_streamed(SENTENCE, chunk_size=55,
+                                               chunk_padding=3)
+            next(iter(stream))
+            dt = time.perf_counter() - t0
+            for _chunk in stream:
+                pass
+        return dt
+
+    traced_ts, untraced_ts = [], []
+    for i in range(18):  # alternate arms
+        (traced_ts if i % 2 == 0 else untraced_ts).append(
+            _one_ttfb(traced=i % 2 == 0))
+    p50_traced = statistics.median(traced_ts)
+    p50_untraced = statistics.median(untraced_ts)
+    sample = _tracer.recent_traces()
+    print(json.dumps({
+        "metric": "trace_overhead",
+        "value": round(p50_traced / max(p50_untraced, 1e-9), 4),
+        "unit": "ratio_traced_over_untraced",
+        "vs_baseline": None,
+        "ttfb_p50_traced_ms": round(p50_traced * 1e3, 2),
+        "ttfb_p50_untraced_ms": round(p50_untraced * 1e3, 2),
+        "spans_per_trace": (len(sample[0].spans_snapshot())
+                            if sample else 0),
+        "runs_per_arm": len(traced_ts),
     }))
 
     # concurrent streaming load: N clients, aggregate audio throughput

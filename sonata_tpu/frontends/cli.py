@@ -25,6 +25,8 @@ from pathlib import Path
 
 from ..core import SonataError
 from ..models import from_config_path
+from ..serving import tracing
+from ..serving.logs import configure_logging
 from ..synth import AudioOutputConfig, SpeechSynthesizer
 
 log = logging.getLogger("sonata.cli")
@@ -81,6 +83,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "on this port while the process runs (0 = "
                         "ephemeral; default $SONATA_METRICS_PORT or "
                         "disabled) — useful with the stdin JSON loop")
+    p.add_argument("--log-level", default=None,
+                   choices=("DEBUG", "INFO", "WARNING", "ERROR",
+                            "CRITICAL"),
+                   help="log level (default $SONATA_LOG or INFO)")
+    p.add_argument("--log-format", default=None,
+                   choices=("text", "json"),
+                   help="log line format; json emits one structured "
+                        "object per line with request_id/voice fields "
+                        "(default $SONATA_LOG_FORMAT or text)")
     return p
 
 
@@ -143,7 +154,17 @@ def process_synthesis_request(synth: SpeechSynthesizer, args, text: str,
 
     With ``--timeout-s`` (or ``SONATA_REQUEST_TIMEOUT_S``) the stream is
     checked between items and fails with DeadlineExceeded when the
-    request runs over — same contract as the gRPC server."""
+    request runs over — same contract as the gRPC server.  Each request
+    gets its own trace (generated request id), so the stdin JSON loop's
+    ``--metrics-port`` plane serves ``/debug/traces`` exactly like the
+    gRPC server's."""
+    with tracing.default_tracer().trace_request(
+            "cli-synthesize", mode=args.mode):
+        _process_synthesis_request(synth, args, text, out_path)
+
+
+def _process_synthesis_request(synth: SpeechSynthesizer, args, text: str,
+                               out_path: str | None) -> None:
     t0 = time.perf_counter()
     deadline = _deadline_for(args)
 
@@ -162,18 +183,23 @@ def process_synthesis_request(synth: SpeechSynthesizer, args, text: str,
                 cancel()
             raise
 
+    # construct the stream before the emit span opens: batched mode does
+    # its device work here, and those spans (phonemize, encode-ids,
+    # dispatch) belong to the pipeline, not to emission
+    stream = _stream_for(synth, args, text)
     if out_path == "-":
-        stream = guarded(_stream_for(synth, args, text))
         raw = sys.stdout.buffer
-        for audio in stream:
-            raw.write(audio.as_wave_bytes())  # raw samples (main.rs:167-182)
-            raw.flush()
+        with tracing.span("stream-emit"):
+            for audio in guarded(stream):
+                raw.write(audio.as_wave_bytes())  # raw samples
+                raw.flush()                       # (main.rs:167-182)
     elif out_path:
         from ..audio import AudioSamples, write_wave_samples_to_file
 
         merged = AudioSamples()
-        for audio in guarded(_stream_for(synth, args, text)):
-            merged.merge(audio.samples)
+        with tracing.span("stream-emit"):
+            for audio in guarded(stream):
+                merged.merge(audio.samples)
         write_wave_samples_to_file(
             out_path, merged.to_i16(),
             synth.audio_output_info().sample_rate)
@@ -181,8 +207,8 @@ def process_synthesis_request(synth: SpeechSynthesizer, args, text: str,
                  (time.perf_counter() - t0) * 1e3)
     else:
         # no sink: drain and report timing (useful for benchmarking)
-        n = sum(len(a.samples)
-                for a in guarded(_stream_for(synth, args, text)))
+        with tracing.span("stream-emit"):
+            n = sum(len(a.samples) for a in guarded(stream))
         sr = synth.audio_output_info().sample_rate
         elapsed = time.perf_counter() - t0
         print(f"synthesized {n / sr:.2f}s of audio in {elapsed * 1e3:.1f} ms "
@@ -237,9 +263,9 @@ def stdin_json_loop(synth: SpeechSynthesizer, args) -> None:
 
 
 def main(argv=None) -> int:
-    logging.basicConfig(
-        level=os.environ.get("SONATA_LOG", "INFO").upper(),
-        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    # default logging so flag/import errors are visible; re-run below
+    # once --log-level/--log-format are parsed
+    configure_logging(env_level_var="SONATA_LOG")
     # repeat CLI invocations reuse compiled executables from disk instead
     # of re-paying the cold XLA compile on every run
     from ..utils.jax_cache import (
@@ -248,6 +274,9 @@ def main(argv=None) -> int:
     pin_platform_from_env()  # SONATA_PLATFORM=cpu|tpu|...
     enable_persistent_compile_cache()
     args = build_parser().parse_args(argv)
+    if args.log_level or args.log_format:
+        configure_logging(args.log_level, args.log_format,
+                          env_level_var="SONATA_LOG")
     try:
         if args.info:
             # metadata comes straight from the JSON config; don't pay the
@@ -326,7 +355,12 @@ def main(argv=None) -> int:
             if runtime is not None:
                 runtime.close()
     except SonataError as e:
-        print(f"error: {e}", file=sys.stderr)
+        # through the structured pipeline (not a bare stderr print), so
+        # json mode stays one-object-per-line for log shippers — but a
+        # fatal error must reach the user even at --log-level CRITICAL
+        log.error("error: %s", e)
+        if not log.isEnabledFor(logging.ERROR):
+            print(f"error: {e}", file=sys.stderr)
         return 1
     return 0
 

@@ -52,7 +52,14 @@ import grpc
 from .. import __version__
 from ..core import FailedToLoadResource, OperationError, SonataError
 from ..models import PiperVoice, from_config_path
-from ..serving import Deadline, DeadlineExceeded, Overloaded, ServingRuntime
+from ..serving import (
+    Deadline,
+    DeadlineExceeded,
+    Overloaded,
+    ServingRuntime,
+    tracing,
+)
+from ..serving.logs import configure_logging
 from ..synth import AudioOutputConfig, SpeechSynthesizer
 from ..utils.profiling import RtfCounter
 from . import grpc_messages as pb
@@ -364,13 +371,34 @@ class SonataGrpcService:
             raise DeadlineExceeded("request cancelled") from None
 
     def _admitted(self, request, context, rpc: str, body):
-        """Run a streaming RPC body inside one admission slot; sheds with
-        RESOURCE_EXHAUSTED when the controller is at capacity."""
+        """Run a streaming RPC body inside one admission slot and one
+        request trace; sheds with RESOURCE_EXHAUSTED when the controller
+        is at capacity.
+
+        The trace (``serving/tracing.py``) is the request's span tree:
+        its id comes from ``x-request-id`` metadata when the client sent
+        one (so client-side and server-side traces correlate), else it is
+        generated.  Everything the body logs while the trace is active
+        carries the request_id (see ``serving/logs.py``); an admission
+        shed still produces a finished (error-status) trace, so shed
+        requests are debuggable too.
+        """
+        from contextlib import ExitStack
+
         rt = self.runtime
         try:
-            with rt.admission.admit():
-                rt.requests.labels(rpc=rpc).inc()
-                yield from body(request, context)
+            with rt.tracer.trace_request(
+                    rpc,
+                    request_id=tracing.request_id_from_context(context),
+                    voice=getattr(request, "voice_id", None) or ""):
+                with ExitStack() as stack:
+                    # the span covers only slot ACQUISITION (the shed /
+                    # wait cost); the stack holds the slot for the body
+                    # with real exception info reaching release
+                    with tracing.span("admission"):
+                        stack.enter_context(rt.admission.admit())
+                    rt.requests.labels(rpc=rpc).inc()
+                    yield from body(request, context)
         except Overloaded as e:
             self._abort_sonata(context, rpc, e)
 
@@ -402,15 +430,19 @@ class SonataGrpcService:
                 futures = [v.scheduler.submit(sentence, speaker=sid,
                                               scales=sc, deadline=deadline)
                            for sentence in v.synth.phonemize_text(request.text)]
-                for fut in futures:
-                    audio = self._await_future(fut, deadline)
-                    v.rtf.record(audio)
-                    if first_at is None:
-                        first_at = time.monotonic()
-                        rt.ttfb.observe(first_at - t0)
-                    yield pb.SynthesisResult(
-                        wav_samples=audio.as_wave_bytes(),
-                        rtf=audio.real_time_factor())
+                with tracing.span("stream-emit") as emit_sp:
+                    for fut in futures:
+                        audio = self._await_future(fut, deadline)
+                        v.rtf.record(audio)
+                        if first_at is None:
+                            first_at = time.monotonic()
+                            rt.ttfb.observe(first_at - t0)
+                            emit_sp.annotate(
+                                ttfb_ms=round((first_at - t0) * 1e3, 3))
+                        yield pb.SynthesisResult(
+                            wav_samples=audio.as_wave_bytes(),
+                            rtf=audio.real_time_factor())
+                    emit_sp.annotate(items=len(futures))
                 rt.synth_latency.observe(time.monotonic() - t0)
                 self._maybe_log_rtf(v)
                 return
@@ -419,17 +451,23 @@ class SonataGrpcService:
                 stream = v.synth.synthesize_parallel(request.text, cfg)
             else:
                 stream = v.synth.synthesize_lazy(request.text, cfg)
-            for audio in stream:
-                if deadline.cancelled:
-                    return  # client went away; stop synthesizing
-                deadline.raise_if_expired()
-                v.rtf.record(audio)
-                if first_at is None:
-                    first_at = time.monotonic()
-                    rt.ttfb.observe(stream.ttfb_s or (first_at - t0))
-                yield pb.SynthesisResult(
-                    wav_samples=audio.as_wave_bytes(),
-                    rtf=audio.real_time_factor())  # main.rs:345-348
+            with tracing.span("stream-emit") as emit_sp:
+                n_items = 0
+                for audio in stream:
+                    if deadline.cancelled:
+                        return  # client went away; stop synthesizing
+                    deadline.raise_if_expired()
+                    v.rtf.record(audio)
+                    n_items += 1
+                    if first_at is None:
+                        first_at = time.monotonic()
+                        rt.ttfb.observe(stream.ttfb_s or (first_at - t0))
+                        emit_sp.annotate(
+                            ttfb_ms=round((first_at - t0) * 1e3, 3))
+                    yield pb.SynthesisResult(
+                        wav_samples=audio.as_wave_bytes(),
+                        rtf=audio.real_time_factor())  # main.rs:345-348
+                emit_sp.annotate(items=n_items)
             rt.synth_latency.observe(time.monotonic() - t0)
             self._maybe_log_rtf(v)
         except DeadlineExceeded as e:
@@ -530,17 +568,22 @@ class SonataGrpcService:
             stream = v.synth.synthesize_streamed(
                 request.text, cfg, chunk_size=chunk_size,
                 chunk_padding=chunk_padding)
-            first = True
-            for chunk in stream:
-                if deadline.cancelled:
-                    return  # client went away; the producer is cancelled
-                    # by the finally below
-                deadline.raise_if_expired()
-                if first:
-                    first = False
-                    rt.ttfb.observe(stream.ttfb_s
-                                    or (time.monotonic() - t0))
-                yield pb.WaveSamples(wav_samples=chunk.as_wave_bytes())
+            with tracing.span("stream-emit") as emit_sp:
+                first = True
+                n_chunks = 0
+                for chunk in stream:
+                    if deadline.cancelled:
+                        return  # client went away; the producer is
+                        # cancelled by the finally below
+                    deadline.raise_if_expired()
+                    n_chunks += 1
+                    if first:
+                        first = False
+                        ttfb = stream.ttfb_s or (time.monotonic() - t0)
+                        rt.ttfb.observe(ttfb)
+                        emit_sp.annotate(ttfb_ms=round(ttfb * 1e3, 3))
+                    yield pb.WaveSamples(wav_samples=chunk.as_wave_bytes())
+                emit_sp.annotate(chunks=n_chunks)
             rt.synth_latency.observe(time.monotonic() - t0)
         except DeadlineExceeded as e:
             rt.expired.inc()
@@ -679,9 +722,9 @@ def create_server(port: Optional[int] = None, *, mesh=None, seed: int = 0,
 
 
 def main(argv=None) -> int:
-    logging.basicConfig(
-        level=os.environ.get("SONATA_GRPC", "INFO").upper(),
-        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    # default logging so import-time/flag errors are visible; re-run
+    # below once the --log-level/--log-format flags are parsed
+    configure_logging(env_level_var="SONATA_GRPC")
     # compiled executables persist across boots; with --prewarm, a re-boot
     # loads its shapes from disk in seconds instead of re-running XLA
     from ..utils.jax_cache import (
@@ -743,7 +786,19 @@ def main(argv=None) -> int:
                          "--max-in-flight before shedding with "
                          "RESOURCE_EXHAUSTED (default "
                          "$SONATA_MAX_QUEUE_DEPTH or 128)")
+    ap.add_argument("--log-level", default=None,
+                    choices=("DEBUG", "INFO", "WARNING", "ERROR",
+                             "CRITICAL"),
+                    help="server log level (default $SONATA_GRPC or INFO)")
+    ap.add_argument("--log-format", default=None,
+                    choices=("text", "json"),
+                    help="log line format; json emits one structured "
+                         "object per line with request_id/voice/replica "
+                         "fields (default $SONATA_LOG_FORMAT or text)")
     args = ap.parse_args(argv)
+    if args.log_level or args.log_format:
+        configure_logging(args.log_level, args.log_format,
+                          env_level_var="SONATA_GRPC")
 
     mesh = None
     if args.mesh_devices:

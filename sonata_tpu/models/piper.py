@@ -41,6 +41,7 @@ from ..core import (
     OperationError,
     Phonemes,
 )
+from ..serving import tracing
 from ..text import text_to_phonemes
 from ..text.tashkeel import TashkeelEngine, get_default_engine
 from ..utils.buckets import (
@@ -291,11 +292,12 @@ class PiperVoice(BaseModel):
         # expanding after (in the normalizer) would feed the letter map
         # vowel-less consonant skeletons.
         if self._tashkeel is not None:
-            from ..text.rule_g2p import (
-                arabic_number_to_words, expand_numbers)
+            with tracing.span("text-normalize", stage="tashkeel"):
+                from ..text.rule_g2p import (
+                    arabic_number_to_words, expand_numbers)
 
-            text = expand_numbers(text, arabic_number_to_words)
-            text = self._tashkeel.diacritize(text)
+                text = expand_numbers(text, arabic_number_to_words)
+                text = self._tashkeel.diacritize(text)
         return text_to_phonemes(
             text, voice=self.config.espeak_voice,
             remove_lang_switch_flags=True,
@@ -543,7 +545,9 @@ class PiperVoice(BaseModel):
         if not phoneme_batches:
             return []
         sc = self.get_fallback_synthesis_config()
-        ids_list = [self._encode_phonemes(p) for p in phoneme_batches]
+        with tracing.span("encode-ids") as sp:
+            ids_list = [self._encode_phonemes(p) for p in phoneme_batches]
+            sp.annotate(sentences=len(ids_list))
         n = len(ids_list)
         if speakers is not None and len(speakers) != n:
             raise OperationError(
@@ -588,23 +592,29 @@ class PiperVoice(BaseModel):
                 lengths[i] = int(wl[row])
                 row_ms[i] = ms
 
-        while gi < len(chunks) or pending:
-            # until the frame estimator has a real observation, keep one
-            # dispatch in flight: a cold underestimate would otherwise clip
-            # every in-flight group and pay an overflow rerun for each,
-            # instead of the documented single first-batch retry
-            depth = self.PIPELINE_DEPTH if self._fpi_observed else 1
-            while gi < len(chunks) and len(pending) < depth:
-                chunk = chunks[gi]
-                gi += 1
-                ticket = self._enqueue_batch(
-                    [ids_list[i] for i in chunk], sc,
-                    speakers=([speakers[i] for i in chunk]
-                              if speakers is not None else None),
-                    scales=([scales[i] for i in chunk]
-                            if scales is not None else None))
-                pending.append((chunk, ticket))
-            drain_one()
+        # direct callers (no scheduler) get their device work as a
+        # "dispatch" span too; under the batch scheduler this is a no-op
+        # (the worker thread carries no trace context — the scheduler
+        # records the shared dispatch span itself)
+        with tracing.span("dispatch", sentences=n, groups=len(chunks)):
+            while gi < len(chunks) or pending:
+                # until the frame estimator has a real observation, keep
+                # one dispatch in flight: a cold underestimate would
+                # otherwise clip every in-flight group and pay an overflow
+                # rerun for each, instead of the documented single
+                # first-batch retry
+                depth = self.PIPELINE_DEPTH if self._fpi_observed else 1
+                while gi < len(chunks) and len(pending) < depth:
+                    chunk = chunks[gi]
+                    gi += 1
+                    ticket = self._enqueue_batch(
+                        [ids_list[i] for i in chunk], sc,
+                        speakers=([speakers[i] for i in chunk]
+                                  if speakers is not None else None),
+                        scales=([scales[i] for i in chunk]
+                                if scales is not None else None))
+                    pending.append((chunk, ticket))
+                drain_one()
 
         info = self.audio_output_info()
         return [
@@ -1162,6 +1172,19 @@ class PiperVoice(BaseModel):
         if sid is not None:
             args.append(sid)
         f = self._estimate_frame_bucket(weighted_ids)
+        with self._jit_lock:
+            cached = (b, t, f) in self._full_cache
+        # dispatch attribution for whoever opened the channel (the batch
+        # scheduler, around speak_batch): the padded shape this batch
+        # actually ran at, what the padding cost, and whether this shape
+        # paid an XLA compile — the single biggest TTFB outlier cause.
+        # Group-wise: one speak_batch may issue several device programs,
+        # and a cold group must never be shadowed by a later cached one
+        tracing.annotate_dispatch_group(
+            batch_bucket=b, text_bucket=t, frame_bucket=f, rows=n_real,
+            padding_rows=b - n_real,
+            padding_ratio=round((b - n_real) / b, 3),
+            compile="cached" if cached else "cold")
         out = self._full_fn(b, t, f)(*args)  # async dispatch
         self._prefetch_to_host(out)
         return {"out": out, "args": args, "b": b, "t": t, "f": f,
@@ -1215,7 +1238,8 @@ class PiperVoice(BaseModel):
     def stream_synthesis(self, phonemes: str, chunk_size: int,
                          chunk_padding: int) -> Iterator[Audio]:
         sc = self.get_fallback_synthesis_config()
-        ids = self._encode_phonemes(phonemes)
+        with tracing.span("encode-ids"):
+            ids = self._encode_phonemes(phonemes)
         info = self.audio_output_info()
         hop = self.hp.hop_length
 
@@ -1225,7 +1249,10 @@ class PiperVoice(BaseModel):
         # ONE batched acoustics dispatch (the reference gives each stream
         # its own blocking session, grpc/src/main.rs:381-409 — linear
         # degradation under load; here the device sees a batch)
-        z_row, total_frames, f, sid0 = self._stream_stages.start(ids, sc)
+        with tracing.span("encode-acoustics") as enc_sp:
+            z_row, total_frames, f, sid0 = self._stream_stages.start(ids,
+                                                                     sc)
+            enc_sp.annotate(frame_bucket=f)
         total_frames = min(total_frames, f)
         enc_ms = (time.perf_counter() - t_enc0) * 1000.0
 
@@ -1249,7 +1276,8 @@ class PiperVoice(BaseModel):
         while submitted:
             plan, start, width, fut = submitted.pop(0)
             t0 = time.perf_counter()
-            wav = fut.result()
+            with tracing.span("decode-window", width=width):
+                wav = fut.result()
             shift = plan.win_start - start  # window moved left by padding
             lo = (shift + plan.trim_left) * hop
             hi = (shift + plan.width - plan.trim_right) * hop

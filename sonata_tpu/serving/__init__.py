@@ -14,6 +14,9 @@ from millions of users).  Four orthogonal pieces:
   text exposition over a stdlib HTTP server.
 - :mod:`.health` — liveness plus warmup-gated readiness for rolling
   restarts.
+- :mod:`.tracing` — request-scoped span trees (Dapper-style) with
+  coalesced-dispatch attribution, ring-buffered and served from the
+  same HTTP plane at ``/debug/traces`` / ``/debug/slowest``.
 
 :class:`ServingRuntime` bundles one of each with the standard instrument
 set and the glue that exports existing observability (``RtfCounter``,
@@ -27,6 +30,7 @@ from __future__ import annotations
 import time
 from typing import Optional
 
+from . import tracing
 from .admission import AdmissionController, Overloaded
 from .deadlines import Deadline, DeadlineExceeded, default_timeout_s
 from .health import HealthState
@@ -37,6 +41,7 @@ from .metrics import (
     start_http_server,
 )
 from .replicas import ReplicaPool, resolve_replica_count
+from .tracing import Trace, Tracer
 
 __all__ = [
     "AdmissionController",
@@ -52,6 +57,9 @@ __all__ = [
     "ReplicaPool",
     "resolve_replica_count",
     "ServingRuntime",
+    "Trace",
+    "Tracer",
+    "tracing",
 ]
 
 
@@ -62,10 +70,16 @@ class ServingRuntime:
     def __init__(self, *, max_in_flight: Optional[int] = None,
                  max_queue_depth: Optional[int] = None,
                  request_timeout_s: Optional[float] = None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 tracer: Optional[Tracer] = None):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.health = HealthState(registry=self.registry)
         self.admission = AdmissionController(max_in_flight, max_queue_depth)
+        #: request-scoped tracing: the process-wide default tracer unless
+        #: one is injected (tests), so every frontend and the HTTP debug
+        #: plane share one ring buffer
+        self.tracer = tracer if tracer is not None else \
+            tracing.default_tracer()
         #: server-side default when the client sets no deadline; None
         #: disables the default (explicit arg > env > 120 s).  An
         #: explicit <= 0 means "disabled" — same contract as the env
@@ -135,7 +149,8 @@ class ServingRuntime:
         if resolved is None:
             return None
         self.http = start_http_server(self.registry, health=self.health,
-                                      port=resolved, host=host)
+                                      port=resolved, host=host,
+                                      tracer=self.tracer)
         return self.http.port
 
     @property
@@ -215,6 +230,19 @@ class ServingRuntime:
                     ("shed", "Scheduler items rejected on a full queue")):
                 voice_gauge(f"sonata_scheduler_{key}",
                             f"{help}, per voice.", sched_stat(key))
+            # time-in-queue histogram (the observability gap the
+            # shed/expired counters left): both BatchScheduler and
+            # ReplicaPool expose .queue_wait, the pool's aggregated
+            # across its replicas' schedulers
+            queue_wait = getattr(scheduler, "queue_wait", None)
+            if queue_wait is not None:
+                metric = r.histogram(
+                    "sonata_queue_wait_seconds",
+                    "Time requests spend in the batch-scheduler queue "
+                    "before a device dispatch (or drop), per voice.",
+                    buckets=queue_wait.bounds)
+                metric.attach(queue_wait, **lbl)
+                owned.append((metric, lbl))
         if replica_pool is not None:
             self._register_replica_pool(voice_id, replica_pool,
                                         labeled_gauge, voice_gauge)
@@ -227,6 +255,8 @@ class ServingRuntime:
         breaker state gauge is numeric (0 closed / 1 half-open / 2 open)
         so a dashboard can alert on ``> 0``.
         """
+        r = self.registry
+        owned = self._voice_series.setdefault(voice_id, [])
         for replica in pool.replicas:
             rl = {"voice": voice_id, "replica": str(replica.index)}
 
@@ -242,6 +272,16 @@ class ServingRuntime:
             labeled_gauge("sonata_replica_dispatch_failures",
                           "Failed device dispatches, per replica.",
                           attr(replica, "dispatch_failures"), rl)
+            # counter semantics via a scrape-time callback, like the rest
+            # of the replica series: resubmissions used to be visible
+            # only as the pool-level aggregate — this names the replica
+            # whose failures pushed requests elsewhere
+            resub = r.counter(
+                "sonata_replica_resubmits_total",
+                "Requests that failed on this replica and were "
+                "resubmitted to another.")
+            resub.labels(**rl).set_function(attr(replica, "resubmits"))
+            owned.append((resub, rl))
             labeled_gauge("sonata_replica_breaker_state",
                           "Circuit breaker: 0 closed, 1 half-open, "
                           "2 open.", attr(replica, "state"), rl)

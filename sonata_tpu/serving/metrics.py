@@ -136,6 +136,22 @@ class Metric:
         with self._lock:
             self._children.pop(key, None)
 
+    def attach(self, hist, **labels) -> None:
+        """Expose an externally-owned
+        :class:`~sonata_tpu.utils.profiling.Histogram` as this metric's
+        series for ``labels`` — the histogram twin of a gauge callback:
+        the owner (e.g. the batch scheduler's queue-wait histogram) keeps
+        observing on its hot path, the scrape reads a snapshot."""
+        if self.type != "histogram":
+            raise ValueError(
+                f"attach() needs a histogram metric, {self.name!r} is "
+                f"{self.type}")
+        key: _LabelKey = tuple(sorted(labels.items()))
+        with self._lock:
+            child = _Child()
+            child._hist = hist
+            self._children[key] = child
+
     # unlabeled convenience: metric.inc() == metric.labels().inc()
     def inc(self, amount: float = 1.0) -> None:
         self.labels().inc(amount)
@@ -226,12 +242,31 @@ class MetricsRegistry:
         return "".join(m.render() for m in metrics)
 
 
+def _unescape_label(v: str) -> str:
+    """Invert :func:`_escape_label` (``\\\\`` ``\\n`` ``\\"``), so parsed
+    label values round-trip to exactly what ``labels(...)`` was given."""
+    out = []
+    i = 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt,
+                                                             "\\" + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
 def parse_prometheus_text(text: str) -> Dict[str, list]:
     """Strict-enough exposition parser: ``{series_name: [(labels, value)]}``.
 
     Raises ``ValueError`` on malformed lines.  Used by the tests and the
     CI serving smoke to assert ``render()`` output actually parses —
-    the exporter ships with its own format check.
+    the exporter ships with its own format check.  Label values are
+    unescaped, so ``render()`` → ``parse`` round-trips exactly.
     """
     import re
 
@@ -258,7 +293,8 @@ def parse_prometheus_text(text: str) -> Dict[str, list]:
             if consumed:
                 raise ValueError(
                     f"line {lineno}: bad label syntax {labelblock!r}")
-            labels = dict(label_re.findall(labelblock))
+            labels = {k: _unescape_label(v)
+                      for k, v in label_re.findall(labelblock)}
         if raw == "+Inf":
             value = math.inf
         elif raw == "-Inf":
@@ -279,9 +315,10 @@ class _Handler(BaseHTTPRequestHandler):
     # set per-server via type() in start_http_server
     registry: MetricsRegistry = None
     health = None
+    tracer = None
 
     def do_GET(self):  # noqa: N802 (http.server API)
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path == "/metrics":
             body = self.registry.render().encode("utf-8")
             self._reply(200, body, CONTENT_TYPE)
@@ -295,8 +332,72 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 reason = (self.health.reason or "not ready").encode()
                 self._reply(503, b"not ready: " + reason + b"\n")
+        elif path in ("/debug/traces", "/debug/slowest"):
+            self._reply_traces(path, query)
+        elif path == "/debug/profile":
+            self._reply_profile(query)
         else:
             self._reply(404, b"not found\n")
+
+    # -- request-trace debug plane (serving/tracing.py) ----------------------
+    def _reply_traces(self, path: str, query: str) -> None:
+        import json
+        from urllib.parse import parse_qs
+
+        if self.tracer is None:
+            self._reply(404, b"tracing not enabled on this server\n")
+            return
+        params = parse_qs(query)
+        traces = (self.tracer.slowest_traces() if path == "/debug/slowest"
+                  else self.tracer.recent_traces())
+        try:
+            limit = int(params.get("limit", ["0"])[0])
+        except ValueError:
+            limit = 0
+        if limit > 0:
+            traces = traces[:limit]
+        if params.get("format", [""])[0] == "chrome":
+            body = json.dumps(self.tracer.chrome_trace(traces))
+        else:
+            body = json.dumps({
+                "count": len(traces),
+                "order": ("slowest-first" if path == "/debug/slowest"
+                          else "newest-first"),
+                "traces": [t.to_dict() for t in traces]})
+        self._reply(200, body.encode("utf-8"),
+                    "application/json; charset=utf-8")
+
+    def _reply_profile(self, query: str) -> None:
+        import json
+        from urllib.parse import parse_qs
+
+        from ..utils.profiling import capture_profile
+
+        if self.tracer is None:
+            # same gate as /debug/traces: no tracer, no debug plane — a
+            # device capture blocks a handler thread and writes to disk,
+            # which an operator who disabled tracing did not sign up for
+            self._reply(404, b"tracing not enabled on this server\n")
+            return
+        params = parse_qs(query)
+        try:
+            seconds = float(params.get("seconds", ["2"])[0])
+        except ValueError:
+            self._reply(400, b"seconds must be a number\n")
+            return
+        try:
+            log_dir = capture_profile(seconds)
+        except RuntimeError as e:  # capture already running
+            self._reply(409, (str(e) + "\n").encode())
+            return
+        except Exception as e:  # jax profiler unavailable on this build
+            self._reply(503, f"profiler capture failed: {e}\n".encode())
+            return
+        body = json.dumps({"log_dir": log_dir, "seconds": seconds,
+                           "view": "tensorboard --logdir <log_dir> "
+                                   "(or load into Perfetto/XProf)"})
+        self._reply(200, body.encode("utf-8"),
+                    "application/json; charset=utf-8")
 
     def _reply(self, code: int, body: bytes,
                content_type: str = "text/plain; charset=utf-8") -> None:
@@ -346,11 +447,16 @@ def resolve_metrics_port(port: Optional[int] = None) -> Optional[int]:
 
 def start_http_server(registry: MetricsRegistry, health=None,
                       port: Optional[int] = None,
-                      host: Optional[str] = None) -> MetricsHTTPServer:
-    """Serve ``/metrics``, ``/healthz``, ``/readyz`` in a daemon thread."""
+                      host: Optional[str] = None,
+                      tracer=None) -> MetricsHTTPServer:
+    """Serve ``/metrics``, ``/healthz``, ``/readyz`` — plus, when a
+    :class:`~sonata_tpu.serving.tracing.Tracer` is given,
+    ``/debug/traces``, ``/debug/slowest``, and ``/debug/profile`` — in a
+    daemon thread."""
     host = host or os.environ.get(METRICS_HOST_ENV, "127.0.0.1")
     handler = type("BoundHandler", (_Handler,),
-                   {"registry": registry, "health": health})
+                   {"registry": registry, "health": health,
+                    "tracer": tracer})
     httpd = ThreadingHTTPServer((host, port or 0), handler)
     httpd.daemon_threads = True
     return MetricsHTTPServer(httpd)

@@ -48,6 +48,8 @@ from concurrent.futures import CancelledError, Future
 from typing import Callable, Optional, Sequence
 
 from ..core import OperationError
+from ..utils.profiling import QUEUE_WAIT_BUCKETS_S, Histogram
+from . import tracing
 from .admission import Overloaded
 from .deadlines import Deadline, DeadlineExceeded
 
@@ -147,6 +149,16 @@ class Replica:
         self.device = device
         self.model = _BreakerModel(model, self)
         self._scheduler_kwargs = dict(scheduler_kwargs or {})
+        if pool is not None:
+            # one pool-shared queue-wait histogram: the per-voice metric
+            # aggregates across replicas (and survives breaker-driven
+            # scheduler recycling, which would reset a per-scheduler one)
+            self._scheduler_kwargs.setdefault("queue_wait_hist",
+                                              pool.queue_wait)
+        attrs = {"replica": index}
+        if device is not None:
+            attrs["device"] = str(device)
+        self._scheduler_kwargs.setdefault("trace_attrs", attrs)
         self._pool = pool
         self.state = CLOSED
         self.consecutive_failures = 0
@@ -154,6 +166,8 @@ class Replica:
         self.dispatch_failures = 0  # failed device dispatches
         self.submitted = 0         # requests routed here (lifetime)
         self.outstanding = 0       # routed, not yet resolved
+        self.resubmits = 0         # requests that failed here and were
+        #                            retried on another replica
         self.opened_at: Optional[float] = None
         self.next_probe_at: Optional[float] = None
         self.scheduler = self._new_scheduler()
@@ -179,6 +193,7 @@ class Replica:
                 "submitted": self.submitted,
                 "dispatches": self.dispatches,
                 "dispatch_failures": self.dispatch_failures,
+                "resubmits": self.resubmits,
                 "queue_depth": self.scheduler.queue_depth()}
 
 
@@ -214,6 +229,8 @@ class ReplicaPool:
         #: pool-level counters (replica-level ones live on each Replica)
         self.stats = {"routed": 0, "resubmitted": 0, "failed": 0,
                       "breaker_opens": 0, "recovered": 0}
+        #: shared across every replica's scheduler (see Replica.__init__)
+        self.queue_wait = Histogram(QUEUE_WAIT_BUCKETS_S)
         self.replicas = [
             Replica(i, m, device=(devices[i] if devices else None),
                     scheduler_kwargs=scheduler_kwargs, pool=self)
@@ -253,8 +270,12 @@ class ReplicaPool:
         outer: "Future" = Future()
         with self._lock:
             self.stats["routed"] += 1
+        # captured here, on the request thread: the resubmit path runs on
+        # a future-callback thread where the ambient context is gone, yet
+        # its spans must land in THIS request's trace
         self._route(outer, phonemes, speaker, scales, deadline,
-                    resubmits_left=1, exclude=())
+                    resubmits_left=1, exclude=(),
+                    tctx=tracing.current(), t_first=time.monotonic())
         return outer
 
     def speak(self, phonemes: str, timeout: Optional[float] = None,
@@ -370,7 +391,8 @@ class ReplicaPool:
                 replica.outstanding -= 1
 
     def _route(self, outer: "Future", phonemes, speaker, scales, deadline,
-               *, resubmits_left: int, exclude: tuple) -> None:
+               *, resubmits_left: int, exclude: tuple,
+               tctx=None, t_first: Optional[float] = None) -> None:
         tried = list(exclude)
         while True:
             try:
@@ -381,7 +403,7 @@ class ReplicaPool:
             try:
                 inner = replica.scheduler.submit(
                     phonemes, speaker=speaker, scales=scales,
-                    deadline=deadline)
+                    deadline=deadline, trace_ctx=tctx)
             except (Overloaded, DeadlineExceeded) as e:
                 # request-level refusal: a full per-replica queue or an
                 # already-dead deadline would refuse anywhere — surface it
@@ -402,11 +424,12 @@ class ReplicaPool:
         inner.add_done_callback(
             lambda fut, r=replica: self._on_done(
                 outer, fut, r, phonemes, speaker, scales, deadline,
-                resubmits_left))
+                resubmits_left, tctx, t_first))
 
     def _on_done(self, outer: "Future", inner: "Future", replica: Replica,
                  phonemes, speaker, scales, deadline,
-                 resubmits_left: int) -> None:
+                 resubmits_left: int, tctx=None,
+                 t_first: Optional[float] = None) -> None:
         self._release(replica)
         try:
             result = inner.result()
@@ -421,13 +444,34 @@ class ReplicaPool:
             # was drained under us): fail over — once
             if (resubmits_left > 0 and not self._closed
                     and (deadline is None or deadline.alive())):
+                now = time.monotonic()
+                added_ms = (round((now - t_first) * 1e3, 3)
+                            if t_first is not None else None)
                 with self._lock:
                     self.stats["resubmitted"] += 1
-                log.warning("pool %s: resubmitting request off replica %d "
-                            "(%s)", self.name, replica.index, e)
+                    replica.resubmits += 1
+                hop = 1 + (1 - resubmits_left)  # 1 resubmit budget today
+                request_id = tctx[0].request_id if tctx else None
+                if tctx is not None:
+                    # make the failover visible to the request itself:
+                    # without this span the retried request's trace shows
+                    # a clean dispatch and silently absorbs the latency
+                    trace, parent = tctx
+                    trace.new_span(
+                        "resubmit", parent=parent, start=now, end=now,
+                        attrs={"failed_replica": replica.index,
+                               "retry_hop": hop,
+                               "latency_before_retry_ms": added_ms,
+                               "error": f"{type(e).__name__}: {e}"})
+                log.warning(
+                    "pool %s: resubmitting request off replica %d "
+                    "(hop %d, %.1f ms already spent: %s)", self.name,
+                    replica.index, hop, added_ms or 0.0, e,
+                    extra={"replica": replica.index,
+                           "request_id": request_id})
                 self._route(outer, phonemes, speaker, scales, deadline,
                             resubmits_left=resubmits_left - 1,
-                            exclude=(replica,))
+                            exclude=(replica,), tctx=tctx, t_first=t_first)
                 return
             self._fail(outer, e)
             return

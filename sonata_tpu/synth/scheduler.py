@@ -42,28 +42,38 @@ from typing import Optional
 
 from ..audio import Audio
 from ..core import Model, OperationError
+from ..serving import tracing
 from ..serving.admission import Overloaded
 from ..serving.deadlines import Deadline, DeadlineExceeded
+from ..utils.profiling import QUEUE_WAIT_BUCKETS_S, Histogram
 
 MAX_QUEUE_ENV = "SONATA_SCHED_MAX_QUEUE"
 DEFAULT_MAX_QUEUE = 1024
 
 
 class _Item:
-    __slots__ = ("phonemes", "speaker", "scales", "deadline", "future")
+    __slots__ = ("phonemes", "speaker", "scales", "deadline", "future",
+                 "t_submit", "tctx")
 
-    def __init__(self, phonemes, speaker, scales, deadline, future):
+    def __init__(self, phonemes, speaker, scales, deadline, future,
+                 tctx=None):
         self.phonemes = phonemes
         self.speaker = speaker
         self.scales = scales
         self.deadline = deadline
         self.future = future
+        self.t_submit = time.monotonic()
+        #: (trace, parent span) captured at submit time — spans recorded
+        #: by the worker thread land in the submitting request's trace
+        self.tctx = tctx
 
 
 class BatchScheduler:
     def __init__(self, model: Model, *, max_batch: Optional[int] = None,
                  max_wait_ms: Optional[float] = None,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None,
+                 queue_wait_hist: Optional[Histogram] = None,
+                 trace_attrs: Optional[dict] = None):
         self._model = model
         # knobs default from the model's backend-adaptive dispatch policy
         # (utils/dispatch_policy): on a CPU backend that degrades to
@@ -97,6 +107,20 @@ class BatchScheduler:
         self.stats = {"requests": 0, "dispatches": 0, "shed": 0,
                       "expired": 0, "cancelled": 0}
         self._stats_lock = threading.Lock()
+        #: time-in-queue (submit → gather) per item, including items the
+        #: gather loop dropped — the queue-wait half of the coalescing
+        #: latency story the aggregate shed/expired counters cannot tell.
+        #: A ReplicaPool passes one shared histogram to all its replicas'
+        #: schedulers so the per-voice view aggregates.
+        self.queue_wait = (queue_wait_hist if queue_wait_hist is not None
+                           else Histogram(QUEUE_WAIT_BUCKETS_S))
+        #: merged into every dispatch span (replica index, device, ...).
+        #: Default: the model's pinned device when it has one.
+        if trace_attrs is None:
+            device = getattr(model, "device", None)
+            trace_attrs = {"device": str(device)} if device is not None \
+                else {}
+        self._trace_attrs = dict(trace_attrs)
         # maxsize counts the sentinel too, but one slot of slack on a
         # 1024-deep bound is noise; <= 0 means unbounded (tests only)
         self._queue: "queue.Queue" = queue.Queue(maxsize=max(max_queue, 0))
@@ -127,7 +151,11 @@ class BatchScheduler:
     def submit(self, phonemes: str,
                speaker: Optional[int] = None,
                scales=None,
-               deadline: Optional[Deadline] = None) -> "Future[Audio]":
+               deadline: Optional[Deadline] = None,
+               trace_ctx=None) -> "Future[Audio]":
+        """``trace_ctx``: (trace, parent span) for callers submitting off
+        the request thread (the replica pool's resubmit path); defaults
+        to the ambient :func:`tracing.current` context."""
         if self._closed.is_set():
             raise OperationError("scheduler is shut down")
         if deadline is not None and not deadline.alive():
@@ -159,7 +187,9 @@ class BatchScheduler:
                     raise OperationError(
                         f"scales.{attr} missing or non-numeric")
         fut: "Future[Audio]" = Future()
-        item = _Item(phonemes, speaker, scales, deadline, fut)
+        item = _Item(phonemes, speaker, scales, deadline, fut,
+                     tctx=trace_ctx if trace_ctx is not None
+                     else tracing.current())
         try:
             self._queue.put_nowait(item)
         except queue.Full:
@@ -233,11 +263,26 @@ class BatchScheduler:
         propagation: a backed-up queue sheds dead work instead of
         synthesizing audio nobody is waiting for."""
         live = []
+        now = time.monotonic()
         for item in batch:
             dl = item.deadline
             if dl is None or dl.alive():
                 live.append(item)
-            elif dl.cancelled:
+                continue
+            # a dropped item still spent real time in the queue: both the
+            # histogram and the trace must say so, or the slowest traces
+            # would be exactly the ones with a hole where the wait went.
+            # Span BEFORE resolving the future (same invariant as
+            # _dispatch): the waiter may export the trace the instant
+            # its future resolves
+            self.queue_wait.observe(now - item.t_submit)
+            outcome = "cancelled" if dl.cancelled else "expired"
+            if item.tctx is not None:
+                trace, parent = item.tctx
+                trace.new_span("queue-wait", parent=parent,
+                               start=item.t_submit, end=now,
+                               attrs={"outcome": outcome})
+            if dl.cancelled:
                 self._bump("cancelled")
                 item.future.cancel()  # nobody is reading the result
             else:
@@ -255,16 +300,48 @@ class BatchScheduler:
         futures = [i.future for i in batch]
         self._bump("requests", len(batch))
         self._bump("dispatches")
+        t0 = time.monotonic()
+        for item in batch:
+            self.queue_wait.observe(t0 - item.t_submit)
+        # dispatch attribution (the Orca question: which batch did this
+        # request ride in, with whom, at what padding cost): ONE shared
+        # span per device dispatch, recorded into every participating
+        # trace under the same dispatch_id.  The model fills bucket shape
+        # / padding / compile-vs-cached through the annotation channel.
+        traced = [i for i in batch if i.tctx is not None]
+        attrs: dict = {}
+        if traced:
+            attrs = {"dispatch_id": tracing.new_id(),
+                     "batch_size": len(batch),
+                     "request_ids": [i.tctx[0].request_id for i in traced],
+                     **self._trace_attrs}
+        err: Optional[Exception] = None
         try:
-            # speakers/scales are part of the Model protocol
-            audios = self._model.speak_batch(sentences, speakers=speakers,
-                                             scales=scales)
+            with tracing.dispatch_scope(attrs):
+                # speakers/scales are part of the Model protocol
+                audios = self._model.speak_batch(sentences,
+                                                 speakers=speakers,
+                                                 scales=scales)
         except Exception as e:
+            err = e
+        # record spans BEFORE resolving the futures: the waiting request
+        # thread may finish (and export) its trace the instant its future
+        # resolves, and the dispatch attribution must already be there
+        t1 = time.monotonic()
+        if err is not None and traced:
+            attrs["error"] = f"{type(err).__name__}: {err}"
+        for item in traced:
+            trace, parent = item.tctx
+            trace.new_span("queue-wait", parent=parent,
+                           start=item.t_submit, end=t0)
+            trace.new_span("dispatch", parent=parent, start=t0, end=t1,
+                           attrs=attrs)
+        if err is not None:
             for fut in futures:
-                _try_set_exception(fut, e)
-            return
-        for fut, audio in zip(futures, audios):
-            _try_set_result(fut, audio)
+                _try_set_exception(fut, err)
+        else:
+            for fut, audio in zip(futures, audios):
+                _try_set_result(fut, audio)
 
 
 def _try_set_result(fut: Future, value) -> None:

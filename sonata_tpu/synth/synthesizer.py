@@ -33,6 +33,7 @@ from typing import Iterator, Optional, Union
 
 from ..audio import Audio, AudioSamples, write_wave_samples_to_file
 from ..core import Model, OperationError, Phonemes
+from ..serving import tracing
 from .output import AudioOutputConfig
 
 _POOL: Optional[ThreadPoolExecutor] = None
@@ -74,7 +75,14 @@ class SpeechSynthesizer:
         return self.model.audio_output_info()
 
     def phonemize_text(self, text: str) -> Phonemes:
-        return self.model.phonemize_text(text)
+        # the one G2P entry point every stream mode and frontend funnels
+        # through — a span here covers the whole pipeline's CPU-side text
+        # stage (no-op without an active request trace)
+        with tracing.span("phonemize") as sp:
+            phonemes = self.model.phonemize_text(text)
+            sp.annotate(sentences=len(getattr(phonemes, "sentences",
+                                              phonemes)))
+        return phonemes
 
     def get_language(self):
         return self.model.get_language()
@@ -118,14 +126,16 @@ class SpeechSynthesizer:
                       output_config: Optional[AudioOutputConfig]) -> Audio:
         if output_config is None:
             return audio
-        processed = output_config.apply(audio.samples,
-                                        audio.info.sample_rate)
-        if output_config.stream_normalization == "global":
-            # one fixed gain for every chunk of the stream — seam-free
-            # (the default replicates the reference's per-chunk peak
-            # normalization, samples.rs:51-75)
-            processed.peak_normalize = False
-        return Audio(processed, audio.info, inference_ms=audio.inference_ms)
+        with tracing.span("postprocess"):
+            processed = output_config.apply(audio.samples,
+                                            audio.info.sample_rate)
+            if output_config.stream_normalization == "global":
+                # one fixed gain for every chunk of the stream — seam-free
+                # (the default replicates the reference's per-chunk peak
+                # normalization, samples.rs:51-75)
+                processed.peak_normalize = False
+            return Audio(processed, audio.info,
+                         inference_ms=audio.inference_ms)
 
     @staticmethod
     def _check_output_config(output_config) -> None:
@@ -297,19 +307,27 @@ class RealtimeSpeechStream(_StageTimestamps):
         self._queue: "queue.Queue" = queue.Queue()
         self._synth = synth
         self._cancelled = threading.Event()
+        # the producer runs on a pool thread where the request's trace
+        # context is gone; capture it here (the request thread) and
+        # re-activate it there, so the model's encode/decode spans land
+        # in the right trace
+        tctx = tracing.current()
 
         def produce():
+            trace, parent = tctx if tctx is not None else (None, None)
             try:
-                chunks_done = 1
-                for sentence in phonemes:
-                    size = min(chunk_size * chunks_done, 1024)
-                    for chunk in synth.model.stream_synthesis(
-                            sentence, size, chunk_padding):
-                        if self._cancelled.is_set():
-                            return
-                        chunk = synth._post_process(chunk, output_config)
-                        self._queue.put(chunk)
-                        chunks_done += 1
+                with tracing.use_trace(trace, parent):
+                    chunks_done = 1
+                    for sentence in phonemes:
+                        size = min(chunk_size * chunks_done, 1024)
+                        for chunk in synth.model.stream_synthesis(
+                                sentence, size, chunk_padding):
+                            if self._cancelled.is_set():
+                                return
+                            chunk = synth._post_process(chunk,
+                                                        output_config)
+                            self._queue.put(chunk)
+                            chunks_done += 1
             except Exception as e:  # forwarded, then stream ends (:374-378)
                 self._queue.put(e)
             finally:

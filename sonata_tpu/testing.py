@@ -104,6 +104,17 @@ class FakeModel(BaseModel):
                 raise OperationError(f"unknown speaker id {sid}")
         self.calls.append(("speak_batch", list(phoneme_batches), speakers,
                            scales))
+        # dispatch attribution parity with PiperVoice: the fake pads
+        # nothing and never compiles, and says so on the channel (no-op
+        # outside a scheduler dispatch), so span-tree tests and the CI
+        # smoke can assert the attribution contract without jax
+        from .serving import tracing
+
+        tracing.annotate_dispatch_group(
+            batch_bucket=len(phoneme_batches),
+            text_bucket=max((len(p) for p in phoneme_batches), default=0),
+            rows=len(phoneme_batches), padding_rows=0, padding_ratio=0.0,
+            compile="none")
         out = []
         for i, p in enumerate(phoneme_batches):
             sc = scales[i] if scales and i < len(scales) and scales[i] else None

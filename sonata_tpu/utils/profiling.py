@@ -61,6 +61,13 @@ class RtfCounter:
 DEFAULT_LATENCY_BUCKETS_S = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                              1.0, 2.5, 5.0, 10.0, 30.0)
 
+#: Queue-wait buckets (seconds): a request's time in the batch scheduler
+#: queue is normally sub-millisecond (the gather window) but stretches to
+#: seconds when the voice is backed up — the low end needs resolution the
+#: latency buckets don't have.
+QUEUE_WAIT_BUCKETS_S = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                        0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
 
 @dataclass
 class HistogramSnapshot:
@@ -132,6 +139,35 @@ def trace(log_dir: str) -> Iterator[None]:
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+#: the jax profiler cannot nest captures; serialize /debug/profile hits
+_PROFILE_LOCK = threading.Lock()
+
+
+def capture_profile(seconds: float, log_dir: Optional[str] = None) -> str:
+    """Capture a ``jax.profiler`` device trace for ``seconds`` and return
+    the log directory (view with Tensorboard/XProf or Perfetto).
+
+    What the metrics plane's ``/debug/profile?seconds=`` endpoint runs:
+    the tracing layer answers *where a request's wall time went*; this
+    answers *what the device was doing meanwhile*.  Raises
+    ``RuntimeError`` when a capture is already running (the profiler
+    cannot nest).
+    """
+    import tempfile
+
+    seconds = min(max(float(seconds), 0.1), 60.0)
+    if log_dir is None:
+        log_dir = tempfile.mkdtemp(prefix="sonata_profile_")
+    if not _PROFILE_LOCK.acquire(blocking=False):
+        raise RuntimeError("a profiler capture is already running")
+    try:
+        with trace(log_dir):
+            time.sleep(seconds)
+    finally:
+        _PROFILE_LOCK.release()
+    return log_dir
 
 
 @contextlib.contextmanager

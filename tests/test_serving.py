@@ -210,7 +210,67 @@ def test_label_escaping_roundtrip():
     g.labels(path='a"b\\c\nd').set(1)
     parsed = parse_prometheus_text(r.render())
     ((labels, value),) = parsed["sonata_esc"]
-    assert value == 1.0  # and the line parsed at all
+    assert value == 1.0
+    # full round trip: the parser unescapes back to the original value
+    assert labels == {"path": 'a"b\\c\nd'}
+
+
+def test_label_escaping_roundtrip_edge_values():
+    # the nasty corners: trailing backslash next to a quote escape,
+    # consecutive escapes, a value that is ONLY escape characters
+    r = MetricsRegistry()
+    g = r.gauge("sonata_esc2", "More escapes.")
+    values = ['\\', '\\"', '\n\n', 'a\\nb', '"', "plain"]
+    for i, v in enumerate(values):
+        g.labels(k=v, idx=str(i)).set(float(i))
+    parsed = parse_prometheus_text(r.render())
+    got = {l["idx"]: l["k"] for l, _v in parsed["sonata_esc2"]}
+    assert got == {str(i): v for i, v in enumerate(values)}
+
+
+def test_histogram_inf_bucket_roundtrip_including_empty():
+    # +Inf bucket semantics survive render → parse, even for a labeled
+    # series that has never observed anything (all-zero cumulative rows)
+    r = MetricsRegistry()
+    h = r.histogram("sonata_rt_seconds", "RT.", buckets=(0.1, 1.0))
+    h.labels(voice="warm").observe(0.05)
+    h.labels(voice="warm").observe(50.0)  # beyond the last bound
+    h.labels(voice="cold")  # series exists, zero observations
+    parsed = parse_prometheus_text(r.render())
+    rows = {(l["voice"], l["le"]): v
+            for l, v in parsed["sonata_rt_seconds_bucket"]}
+    import math
+
+    assert rows[("warm", "0.1")] == 1.0
+    assert rows[("warm", "+Inf")] == 2.0
+    assert rows[("cold", "+Inf")] == 0.0
+    counts = {l["voice"]: v for l, v in parsed["sonata_rt_seconds_count"]}
+    assert counts == {"warm": 2.0, "cold": 0.0}
+    # and a literal +Inf VALUE (not just the le label) parses as inf
+    g = r.gauge("sonata_inf_value", "Inf gauge.")
+    g.set(math.inf)
+    parsed = parse_prometheus_text(r.render())
+    assert parsed["sonata_inf_value"][0][1] == math.inf
+
+
+def test_exemplar_free_counter_roundtrip():
+    # counters render without OpenMetrics exemplars (no '# EOF', no '#'
+    # exemplar suffix); the strict parser must take the labeled and
+    # unlabeled forms as-is and reject an exemplar if one ever appears
+    r = MetricsRegistry()
+    c = r.counter("sonata_requests_test_total", "Reqs.")
+    c.inc(3)
+    c.labels(rpc="Synthesize", code="OK").inc()
+    text = r.render()
+    assert "#" not in text.replace("# HELP", "").replace("# TYPE", "")
+    parsed = parse_prometheus_text(text)
+    series = {tuple(sorted(l.items())): v
+              for l, v in parsed["sonata_requests_test_total"]}
+    assert series[()] == 3.0
+    assert series[(("code", "OK"), ("rpc", "Synthesize"))] == 1.0
+    with pytest.raises(ValueError):
+        parse_prometheus_text(
+            'sonata_requests_test_total 3 # {trace_id="abc"} 1.0\n')
 
 
 # ---------------------------------------------------------------------------

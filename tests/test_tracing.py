@@ -1,0 +1,476 @@
+"""Request-scoped tracing: span trees, dispatch attribution, exports.
+
+Everything here runs on the FakeModel / fake-scheduler layer — no jax
+compiles — except the pool tests, which reuse the replica machinery with
+fake models exactly like tests/test_replicas.py does.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from sonata_tpu.serving import (
+    MetricsRegistry,
+    ServingRuntime,
+    parse_prometheus_text,
+    tracing,
+)
+from sonata_tpu.serving.logs import (
+    JsonLineFormatter,
+    TextFormatter,
+    TraceContextFilter,
+)
+from sonata_tpu.serving.replicas import ReplicaPool
+from sonata_tpu.synth.scheduler import BatchScheduler
+from sonata_tpu.testing import FakeModel
+
+
+# ---------------------------------------------------------------------------
+# core span machinery
+# ---------------------------------------------------------------------------
+
+def test_trace_request_builds_a_tree():
+    tracer = tracing.Tracer(enabled=True)
+    with tracer.trace_request("req", request_id="r1", voice="v") as tr:
+        with tracing.span("phonemize", sentences=2) as sp:
+            assert sp.name == "phonemize"
+            with tracing.span("text-normalize"):
+                pass
+    assert tr.status == "ok"
+    d = tr.to_dict()
+    assert d["request_id"] == "r1"
+    assert d["attrs"]["voice"] == "v"
+    by_name = {s["name"]: s for s in d["spans"]}
+    assert set(by_name) == {"req", "phonemize", "text-normalize"}
+    # parent links form a tree rooted at the request span
+    root = by_name["req"]
+    assert root["parent_id"] is None
+    assert by_name["phonemize"]["parent_id"] == root["span_id"]
+    assert (by_name["text-normalize"]["parent_id"]
+            == by_name["phonemize"]["span_id"])
+    assert by_name["phonemize"]["attrs"]["sentences"] == 2
+    assert all("duration_ms" in s for s in d["spans"])
+
+
+def test_trace_error_status_and_span_error_attr():
+    tracer = tracing.Tracer(enabled=True)
+    with pytest.raises(ValueError):
+        with tracer.trace_request("req") as tr:
+            with tracing.span("phonemize"):
+                raise ValueError("boom")
+    assert tr.status == "error: ValueError"
+    sp = [s for s in tr.spans_snapshot() if s.name == "phonemize"][0]
+    assert "boom" in sp.attrs["error"]
+
+
+def test_hooks_noop_without_active_trace():
+    # the always-on contract: instrumented library code must not care
+    with tracing.span("anything") as sp:
+        sp.annotate(x=1)  # NULL_SPAN swallows it
+    assert tracing.current_trace() is None
+    tracing.annotate_dispatch(x=1)  # no open dispatch scope: no-op
+
+
+def test_annotate_dispatch_group_aggregates_worst_case():
+    # one speak_batch → several device programs: the headline fields
+    # must keep the outlier (a cold compile, the max padding), wherever
+    # in the group sequence it happened
+    attrs = {}
+    with tracing.dispatch_scope(attrs):
+        tracing.annotate_dispatch_group(batch_bucket=8, padding_ratio=0.0,
+                                        compile="cached")
+        tracing.annotate_dispatch_group(batch_bucket=4, padding_ratio=0.5,
+                                        compile="cold")
+        tracing.annotate_dispatch_group(batch_bucket=2, padding_ratio=0.1,
+                                        compile="cached")
+    assert attrs["compile"] == "cold"          # any cold group wins
+    assert attrs["padding_ratio"] == 0.5       # max across groups
+    assert attrs["batch_bucket"] == 8          # headline = first group
+    assert [g["batch_bucket"] for g in attrs["device_groups"]] == [8, 4, 2]
+
+
+def test_disabled_tracer_yields_none():
+    tracer = tracing.Tracer(enabled=False)
+    with tracer.trace_request("req") as tr:
+        assert tr is None
+        assert tracing.current_trace() is None
+    assert tracer.recent_traces() == []
+
+
+def test_request_id_from_metadata():
+    assert tracing.request_id_from_metadata(
+        [("x-request-id", "abc"), ("other", "1")]) == "abc"
+    assert tracing.request_id_from_metadata(
+        [("X-Request-Id", "CASED")]) == "CASED"
+    assert tracing.request_id_from_metadata([]) is None
+    assert tracing.request_id_from_metadata(None) is None
+
+
+# ---------------------------------------------------------------------------
+# ring buffers + exports
+# ---------------------------------------------------------------------------
+
+def _finished_trace(tracer, request_id, sleep_s=0.0):
+    with tracer.trace_request("req", request_id=request_id):
+        if sleep_s:
+            time.sleep(sleep_s)
+
+
+def test_recent_ring_is_bounded_and_newest_first():
+    tracer = tracing.Tracer(enabled=True, recent=3, slowest=2)
+    for i in range(5):
+        _finished_trace(tracer, f"r{i}")
+    recent = tracer.recent_traces()
+    assert [t.request_id for t in recent] == ["r4", "r3", "r2"]
+    assert tracer.find("r0") is None
+    assert tracer.find("r4") is not None
+
+
+def test_slowest_ring_keeps_the_slowest():
+    tracer = tracing.Tracer(enabled=True, recent=8, slowest=2)
+    _finished_trace(tracer, "fast1")
+    _finished_trace(tracer, "slow", sleep_s=0.05)
+    _finished_trace(tracer, "fast2")
+    _finished_trace(tracer, "slower", sleep_s=0.08)
+    _finished_trace(tracer, "fast3")
+    slowest = tracer.slowest_traces()
+    assert len(slowest) == 2  # bounded
+    assert [t.request_id for t in slowest] == ["slower", "slow"]
+
+
+def test_chrome_export_is_valid_trace_event_json():
+    tracer = tracing.Tracer(enabled=True)
+    with tracer.trace_request("req", request_id="c1"):
+        with tracing.span("phonemize"):
+            pass
+    doc = tracer.chrome_trace(tracer.recent_traces())
+    # round-trips through json and matches the trace-event schema
+    doc = json.loads(json.dumps(doc))
+    assert isinstance(doc["traceEvents"], list)
+    complete = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in complete} >= {"req", "phonemize"}
+    for e in complete:
+        assert isinstance(e["ts"], (int, float))
+        assert isinstance(e["dur"], (int, float))
+        assert e["dur"] >= 0
+        assert e["args"]["request_id"] == "c1"
+    # metadata event names the per-request virtual thread
+    assert any(e["ph"] == "M" for e in doc["traceEvents"])
+
+
+def test_trace_log_jsonl_export(tmp_path):
+    path = tmp_path / "traces.jsonl"
+    tracer = tracing.Tracer(enabled=True, log_sink=str(path))
+    _finished_trace(tracer, "logged1")
+    _finished_trace(tracer, "logged2")
+    lines = path.read_text().strip().splitlines()
+    assert len(lines) == 2
+    first = json.loads(lines[0])
+    assert first["event"] == "trace"
+    assert first["request_id"] == "logged1"
+    assert any(s["name"] == "req" for s in first["spans"])
+
+
+# ---------------------------------------------------------------------------
+# scheduler integration: queue-wait + shared dispatch attribution
+# ---------------------------------------------------------------------------
+
+def test_scheduler_records_queue_wait_and_dispatch_spans():
+    tracer = tracing.Tracer(enabled=True)
+    model = FakeModel()
+    sched = BatchScheduler(model, max_batch=8, max_wait_ms=250.0)
+    try:
+        results = {}
+
+        def run(rid):
+            with tracer.trace_request("req", request_id=rid) as tr:
+                sched.submit("phoneme string").result(10.0)
+                results[rid] = tr
+
+        # two requests inside one gather window coalesce into one batch
+        threads = [threading.Thread(target=run, args=(f"q{i}",))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        sched.shutdown()
+
+    spans = {rid: {s.name: s for s in tr.spans_snapshot()}
+             for rid, tr in results.items()}
+    for rid in ("q0", "q1"):
+        assert {"queue-wait", "dispatch"} <= set(spans[rid])
+        d = spans[rid]["dispatch"].attrs
+        # attribution: batch size, peers, padding, compile state (the
+        # fake model reports zero padding / no compile on the channel)
+        assert d["batch_size"] == 2
+        assert set(d["request_ids"]) == {"q0", "q1"}
+        assert d["padding_ratio"] == 0.0
+        assert d["compile"] == "none"
+    # ONE shared dispatch span: same dispatch_id in both traces
+    assert (spans["q0"]["dispatch"].attrs["dispatch_id"]
+            == spans["q1"]["dispatch"].attrs["dispatch_id"])
+    # queue-wait histogram observed both items
+    assert sched.queue_wait.snapshot().total == 2
+
+
+def test_scheduler_queue_wait_span_on_expired_item():
+    from sonata_tpu.serving import Deadline, DeadlineExceeded
+
+    tracer = tracing.Tracer(enabled=True)
+
+    class SlowModel(FakeModel):
+        def speak_batch(self, batches, speakers=None, scales=None):
+            time.sleep(0.15)
+            return super().speak_batch(batches, speakers=speakers,
+                                       scales=scales)
+
+    sched = BatchScheduler(SlowModel(), max_batch=1, max_wait_ms=0.0)
+    try:
+        with tracer.trace_request("req", request_id="exp") as tr:
+            # first item occupies the worker; the second expires in-queue
+            f1 = sched.submit("aaaa")
+            f2 = sched.submit("bbbb", deadline=Deadline.after(0.01))
+            with pytest.raises(DeadlineExceeded):
+                f2.result(10.0)
+            f1.result(10.0)
+    finally:
+        sched.shutdown()
+    qspans = [s for s in tr.spans_snapshot() if s.name == "queue-wait"]
+    assert any(s.attrs.get("outcome") == "expired" for s in qspans)
+
+
+def test_dispatch_error_is_attributed():
+    tracer = tracing.Tracer(enabled=True)
+
+    class BrokenModel(FakeModel):
+        def speak_batch(self, batches, speakers=None, scales=None):
+            raise RuntimeError("device on fire")
+
+    sched = BatchScheduler(BrokenModel(), max_batch=4, max_wait_ms=1.0)
+    try:
+        with tracer.trace_request("req", request_id="err") as tr:
+            with pytest.raises(RuntimeError):
+                sched.submit("xx").result(10.0)
+    finally:
+        sched.shutdown()
+    dspan = [s for s in tr.spans_snapshot() if s.name == "dispatch"][0]
+    assert "device on fire" in dspan.attrs["error"]
+
+
+# ---------------------------------------------------------------------------
+# replica pool: resubmission visibility (trace + counter)
+# ---------------------------------------------------------------------------
+
+class _FlakyModel(FakeModel):
+    """Fails every dispatch until told to heal."""
+
+    def __init__(self):
+        super().__init__()
+        self.broken = True
+
+    def speak_batch(self, batches, speakers=None, scales=None):
+        if self.broken:
+            raise RuntimeError("injected replica fault")
+        return super().speak_batch(batches, speakers=speakers,
+                                   scales=scales)
+
+
+def test_pool_resubmission_is_visible_to_the_request():
+    tracer = tracing.Tracer(enabled=True)
+    flaky, healthy = _FlakyModel(), FakeModel()
+    pool = ReplicaPool([flaky, healthy], breaker_threshold=1,
+                       probe_interval_s=600.0,
+                       scheduler_kwargs={"max_batch": 1,
+                                         "max_wait_ms": 0.0})
+    try:
+        # route deterministically to the flaky replica first
+        pool.replicas[1].outstanding += 1
+        with tracer.trace_request("req", request_id="fo1") as tr:
+            fut = pool.submit("phonemes")
+            pool.replicas[1].outstanding -= 1
+            audio = fut.result(10.0)
+        assert len(audio.samples) > 0
+        spans = {s.name: s for s in tr.spans_snapshot()}
+        assert "resubmit" in spans
+        a = spans["resubmit"].attrs
+        assert a["failed_replica"] == 0
+        assert a["retry_hop"] == 1
+        assert a["latency_before_retry_ms"] >= 0
+        assert "injected replica fault" in a["error"]
+        # the dispatch that succeeded carries the serving replica
+        dspans = [s for s in tr.spans_snapshot() if s.name == "dispatch"]
+        assert any(s.attrs.get("replica") == 1 for s in dspans)
+        assert pool.replicas[0].resubmits == 1
+        assert pool.replicas[1].resubmits == 0
+    finally:
+        pool.shutdown()
+
+
+def test_pool_resubmit_counter_on_metrics_plane():
+    flaky, healthy = _FlakyModel(), FakeModel()
+    pool = ReplicaPool([flaky, healthy], breaker_threshold=1,
+                       probe_interval_s=600.0,
+                       scheduler_kwargs={"max_batch": 1,
+                                         "max_wait_ms": 0.0})
+    rt = ServingRuntime(registry=MetricsRegistry(),
+                        tracer=tracing.Tracer(enabled=False))
+    try:
+        rt.register_voice("v1", scheduler=pool, replica_pool=pool)
+        pool.replicas[1].outstanding += 1
+        fut = pool.submit("phonemes")
+        pool.replicas[1].outstanding -= 1
+        fut.result(10.0)
+        parsed = parse_prometheus_text(rt.registry.render())
+        series = {tuple(sorted(lbl.items())): v for lbl, v in
+                  parsed["sonata_replica_resubmits_total"]}
+        assert series[(("replica", "0"), ("voice", "v1"))] == 1.0
+        assert series[(("replica", "1"), ("voice", "v1"))] == 0.0
+        # pool-aggregated queue-wait histogram rides the same voice label
+        assert "sonata_queue_wait_seconds_bucket" in parsed
+        # unregister removes exactly what register created
+        rt.unregister_voice("v1")
+        parsed = parse_prometheus_text(rt.registry.render())
+        assert "sonata_replica_resubmits_total" not in parsed
+        assert "sonata_queue_wait_seconds_bucket" not in parsed
+    finally:
+        pool.shutdown()
+        rt.close()
+
+
+# ---------------------------------------------------------------------------
+# queue-wait histogram exposition (satellite: time-in-queue gap)
+# ---------------------------------------------------------------------------
+
+def test_register_voice_exports_queue_wait_histogram():
+    model = FakeModel()
+    sched = BatchScheduler(model, max_batch=4, max_wait_ms=1.0)
+    rt = ServingRuntime(registry=MetricsRegistry(),
+                        tracer=tracing.Tracer(enabled=False))
+    try:
+        rt.register_voice("v1", scheduler=sched)
+        sched.submit("some phonemes").result(10.0)
+        assert sched.queue_wait.snapshot().total == 1
+        parsed = parse_prometheus_text(rt.registry.render())
+        buckets = [(lbl, v) for lbl, v in
+                   parsed["sonata_queue_wait_seconds_bucket"]
+                   if lbl.get("voice") == "v1"]
+        assert buckets, "per-voice queue-wait series missing"
+        inf = [v for lbl, v in buckets if lbl["le"] == "+Inf"]
+        assert inf == [1.0]
+        counts = [v for lbl, v in
+                  parsed["sonata_queue_wait_seconds_count"]
+                  if lbl.get("voice") == "v1"]
+        assert counts == [1.0]
+    finally:
+        sched.shutdown()
+        rt.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP debug plane
+# ---------------------------------------------------------------------------
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.getcode(), resp.read().decode()
+
+
+def test_debug_endpoints_serve_traces():
+    from sonata_tpu.serving.metrics import start_http_server
+
+    tracer = tracing.Tracer(enabled=True, recent=8, slowest=2)
+    for i in range(4):
+        _finished_trace(tracer, f"h{i}", sleep_s=0.001 * i)
+    server = start_http_server(MetricsRegistry(), tracer=tracer, port=0)
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        code, body = _get(base + "/debug/traces")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["order"] == "newest-first"
+        assert [t["request_id"] for t in doc["traces"][:2]] == ["h3", "h2"]
+
+        code, body = _get(base + "/debug/traces?limit=1")
+        assert len(json.loads(body)["traces"]) == 1
+
+        code, body = _get(base + "/debug/slowest")
+        doc = json.loads(body)
+        assert doc["order"] == "slowest-first"
+        assert len(doc["traces"]) <= 2  # bounded ring
+
+        code, body = _get(base + "/debug/traces?format=chrome")
+        doc = json.loads(body)
+        assert {e["name"] for e in doc["traceEvents"]
+                if e["ph"] == "X"} == {"req"}
+    finally:
+        server.stop()
+
+
+def test_debug_traces_404_without_tracer():
+    from sonata_tpu.serving.metrics import start_http_server
+
+    server = start_http_server(MetricsRegistry(), port=0)
+    try:
+        # the whole debug plane is gated on a tracer — including the
+        # profiler trigger, which costs device time and disk
+        for path in ("/debug/traces", "/debug/slowest",
+                     "/debug/profile?seconds=1"):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _get(f"http://127.0.0.1:{server.port}{path}")
+            assert exc.value.code == 404, path
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# structured logging (satellite: request_id correlation)
+# ---------------------------------------------------------------------------
+
+def _formatted(formatter, logger_name="sonata.test", msg="hello",
+               extra=None):
+    import logging
+
+    record = logging.LogRecord(logger_name, logging.INFO, __file__, 1,
+                               msg, (), None)
+    for k, v in (extra or {}).items():
+        setattr(record, k, v)
+    TraceContextFilter().filter(record)
+    return formatter.format(record)
+
+
+def test_json_log_lines_carry_request_context():
+    tracer = tracing.Tracer(enabled=True)
+    with tracer.trace_request("req", request_id="log1", voice="v9"):
+        line = _formatted(JsonLineFormatter())
+    entry = json.loads(line)
+    assert entry["message"] == "hello"
+    assert entry["request_id"] == "log1"
+    assert entry["voice"] == "v9"
+    assert entry["level"] == "INFO"
+    # outside a request: fields simply absent, line still valid JSON
+    entry = json.loads(_formatted(JsonLineFormatter()))
+    assert "request_id" not in entry
+
+
+def test_json_log_explicit_extra_wins():
+    entry = json.loads(_formatted(
+        JsonLineFormatter(), extra={"request_id": "explicit",
+                                    "replica": 3}))
+    assert entry["request_id"] == "explicit"
+    assert entry["replica"] == 3
+
+
+def test_text_log_appends_request_id():
+    tracer = tracing.Tracer(enabled=True)
+    with tracer.trace_request("req", request_id="txt1"):
+        line = _formatted(TextFormatter())
+    assert line.endswith("rid=txt1")
+    assert "hello" in line

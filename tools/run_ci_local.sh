@@ -7,9 +7,12 @@
 #      virtual 8-device CPU mesh
 #   3. "Serving smoke" — boot the gRPC server with a fake voice, probe
 #      /metrics /healthz /readyz, assert exposition format parses and
-#      readiness flips after warmup; then re-boot with a 2-replica pool
-#      on 2 forced host devices and assert per-replica gauges + breaker
-#      readiness semantics (tools/serving_smoke.py)
+#      readiness flips after warmup, assert a traced request's complete
+#      span tree (admission→stream-emit, dispatch attribution) at
+#      /debug/traces with a bounded /debug/slowest; then re-boot with a
+#      2-replica pool on 2 forced host devices and assert per-replica
+#      gauges + breaker readiness semantics + replica-attributed
+#      dispatch spans (tools/serving_smoke.py)
 #   4. "Multi-device lane" — test_replicas on a forced 4-device CPU
 #      host (the replica-pool acceptance shape), plus test_parallel on
 #      its 8-device virtual mesh (make_mesh(8) needs all 8)

@@ -9,11 +9,19 @@ Checks (exit 0 only if all hold):
 3. LoadVoice over the real wire + one-utterance warmup flips ``/readyz``
    to 200 (the rolling-restart readiness gate);
 4. ``/metrics`` serves Prometheus text that the strict parser accepts,
-   including queue-depth, shed, and TTFB-histogram series;
+   including queue-depth, shed, TTFB-histogram, and queue-wait series;
 5. ``CheckHealth`` over gRPC agrees with the HTTP plane;
-6. a second server boot with ``replicas=2`` on the 2 forced host
-   devices: per-replica gauges appear in ``/metrics``, and readiness
-   survives one breaker-open replica (flipping only at zero healthy).
+6. request-scoped tracing: a synthesis request carrying an
+   ``x-request-id`` yields a complete span tree (admission → phonemize →
+   queue-wait → dispatch → stream-emit) at ``/debug/traces``, the shared
+   dispatch span carries batch/bucket/padding/compile attribution,
+   ``/debug/traces?format=chrome`` is valid Chrome trace-event JSON, and
+   ``/debug/slowest`` stays bounded;
+7. a second server boot with ``replicas=2`` on the 2 forced host
+   devices: per-replica gauges appear in ``/metrics``, readiness
+   survives one breaker-open replica (flipping only at zero healthy),
+   and the traced request's dispatch span is attributed to a replica
+   and device.
 
 Run: ``JAX_PLATFORMS=cpu python tools/serving_smoke.py`` (used by
 tools/run_ci_local.sh and .github/workflows/ci.yml).
@@ -29,6 +37,9 @@ import urllib.request
 from pathlib import Path
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# small slowest-ring so the boundedness check exercises eviction (must be
+# set before sonata_tpu imports create the default tracer)
+os.environ.setdefault("SONATA_TRACE_SLOWEST", "4")
 # the replica-pool phase needs >= 2 devices; force a 2-device CPU host
 # unless the caller already forced a count (idempotent under conftest)
 if "xla_force_host_platform_device_count" not in os.environ.get(
@@ -101,14 +112,69 @@ def main() -> int:
     check("CheckHealth ready post-warmup", h.live and h.ready,
           f"({h.reason})")
 
-    # one real synthesis so latency histograms and per-voice series move
-    results = list(channel.unary_stream(
+    # one real synthesis so latency histograms and per-voice series move;
+    # the explicit x-request-id makes its trace findable at /debug/traces
+    synthesize = channel.unary_stream(
         "/sonata_grpc.sonata_grpc/SynthesizeUtterance",
         request_serializer=lambda m: m.encode(),
-        response_deserializer=pb.SynthesisResult.decode)(
-        pb.Utterance(voice_id=info.voice_id, text="Smoke test sentence.")))
+        response_deserializer=pb.SynthesisResult.decode)
+    results = list(synthesize(
+        pb.Utterance(voice_id=info.voice_id, text="Smoke test sentence."),
+        metadata=(("x-request-id", "smoke-trace-1"),)))
     check("SynthesizeUtterance streams audio",
           len(results) >= 1 and len(results[0].wav_samples) > 0)
+
+    # ---- request-scoped tracing (serving/tracing.py) ----
+    code, body = http_get(base + "/debug/traces")
+    check("/debug/traces is 200", code == 200)
+    import json
+
+    traces = json.loads(body).get("traces", [])
+    trace = next((t for t in traces
+                  if t["request_id"] == "smoke-trace-1"), None)
+    check("trace found by client-sent x-request-id", trace is not None)
+    if trace is not None:
+        names = {s["name"] for s in trace["spans"]}
+        check("complete span tree admission→stream-emit",
+              {"SynthesizeUtterance", "admission", "phonemize",
+               "queue-wait", "dispatch", "stream-emit"} <= names,
+              f"({sorted(names)})")
+        ids = {s["span_id"] for s in trace["spans"]}
+        check("span parent links resolve within the trace",
+              all(s["parent_id"] in ids for s in trace["spans"]
+                  if s["parent_id"] is not None))
+        dispatch = next(s for s in trace["spans"]
+                        if s["name"] == "dispatch")
+        attrs = dispatch.get("attrs", {})
+        check("dispatch span carries coalescing attribution",
+              all(k in attrs for k in ("dispatch_id", "batch_size",
+                                       "request_ids", "batch_bucket",
+                                       "padding_ratio", "compile")),
+              f"({sorted(attrs)})")
+        check("trace finished ok with a duration",
+              trace["status"] == "ok" and trace["duration_ms"] > 0)
+    code, body = http_get(base + "/debug/traces?format=chrome")
+    try:
+        chrome = json.loads(body)
+        events = chrome["traceEvents"]
+        ok = (isinstance(events, list)
+              and any(e.get("ph") == "X" and "ts" in e and "dur" in e
+                      for e in events))
+    except (ValueError, KeyError):
+        ok = False
+    check("chrome trace-event export is valid JSON", ok)
+    # boundedness: a burst of requests must not grow /debug/slowest past
+    # its configured ring (SONATA_TRACE_SLOWEST=4 above)
+    for i in range(6):
+        list(synthesize(pb.Utterance(voice_id=info.voice_id,
+                                     text=f"Bounded ring {i}.")))
+    code, body = http_get(base + "/debug/slowest")
+    slowest = json.loads(body).get("traces", [])
+    check("/debug/slowest is bounded", code == 200 and len(slowest) <= 4,
+          f"({len(slowest)} traces)")
+    durs = [t["duration_ms"] for t in slowest]
+    check("/debug/slowest is sorted slowest-first",
+          durs == sorted(durs, reverse=True))
 
     code, text = http_get(base + "/metrics")
     check("/metrics is 200", code == 200)
@@ -123,8 +189,12 @@ def main() -> int:
     for required in ("sonata_ready", "sonata_in_flight",
                      "sonata_shed_total", "sonata_requests_total",
                      "sonata_ttfb_seconds_bucket",
-                     "sonata_scheduler_queue_depth"):
+                     "sonata_scheduler_queue_depth",
+                     "sonata_queue_wait_seconds_bucket"):
         check(f"series {required}", required in parsed)
+    qw_count = sum(v for _l, v in
+                   parsed.get("sonata_queue_wait_seconds_count", []))
+    check("queue-wait histogram observed the requests", qw_count >= 1)
     ttfb_total = sum(v for _labels, v in
                      parsed.get("sonata_ttfb_seconds_count", []))
     check("ttfb histogram observed the request", ttfb_total >= 1)
@@ -193,9 +263,23 @@ def main() -> int:
         request_serializer=lambda m: m.encode(),
         response_deserializer=pb.SynthesisResult.decode)(
         pb.Utterance(voice_id=info.voice_id,
-                     text="Still serving on one replica.")))
+                     text="Still serving on one replica."),
+        metadata=(("x-request-id", "smoke-replica-trace"),)))
     check("synthesis survives a broken replica",
           len(results) >= 1 and len(results[0].wav_samples) > 0)
+    # the pool-served request's dispatch span must say WHICH chip served
+    # it — the per-request attribution aggregate gauges cannot give
+    code, body = http_get(base + "/debug/traces")
+    traces = json.loads(body).get("traces", [])
+    rt_trace = next((t for t in traces
+                     if t["request_id"] == "smoke-replica-trace"), None)
+    check("replica-phase trace found", rt_trace is not None)
+    if rt_trace is not None:
+        dspans = [s for s in rt_trace["spans"] if s["name"] == "dispatch"]
+        check("dispatch span attributed to replica 1 and its device",
+              any(s.get("attrs", {}).get("replica") == 1
+                  and "device" in s.get("attrs", {}) for s in dspans),
+              f"({[s.get('attrs') for s in dspans]})")
     # zero healthy replicas is the line readiness must not survive
     v.pool.force_open(1, "smoke")
     code, _ = http_get(base + "/readyz")
