@@ -489,6 +489,14 @@ class ReplicaPool:
             pass
 
     # -- breaker --------------------------------------------------------------
+    def _drain_off_thread(self, scheduler, index: int) -> None:
+        """Shut a scheduler down on a helper thread: ``shutdown()`` joins
+        the scheduler's worker — which may be the very thread running the
+        breaker callback — and must never run under the pool lock."""
+        threading.Thread(target=scheduler.shutdown,
+                         name=f"sonata_replica_drain_{index}",
+                         daemon=True).start()
+
     def _on_dispatch(self, replica: Replica, ok: bool) -> None:
         """Dispatch-granular breaker bookkeeping (called by the
         replica's :class:`_BreakerModel` around every ``speak_batch``)."""
@@ -530,10 +538,7 @@ class ReplicaPool:
         if to_drain is not None:
             # drain off-thread: shutdown() joins the scheduler worker —
             # the very thread this callback may be running on
-            threading.Thread(
-                target=to_drain.shutdown,
-                name=f"sonata_replica_drain_{replica.index}",
-                daemon=True).start()
+            self._drain_off_thread(to_drain, replica.index)
             self._probe_wake.set()  # re-arm the prober's timer
         if notify:
             self._notify_health()
@@ -552,9 +557,7 @@ class ReplicaPool:
             sched = replica.scheduler
         log.warning("pool %s: replica %d force-opened (%s)", self.name,
                     index, reason)
-        threading.Thread(target=sched.shutdown,
-                         name=f"sonata_replica_drain_{index}",
-                         daemon=True).start()
+        self._drain_off_thread(sched, index)
         self._probe_wake.set()
         self._notify_health()
 
@@ -572,7 +575,6 @@ class ReplicaPool:
                 self._probe_wake.wait(timeout=wait)
                 self._probe_wake.clear()
                 continue
-            changed = False
             with self._lock:
                 if self._closed:
                     # shutdown() may have drained the replicas between
@@ -580,19 +582,40 @@ class ReplicaPool:
                     # scheduler now would leak its worker thread
                     return
                 now = time.monotonic()
+                ripe = []
                 for r in self.replicas:
                     if (r.state == OPEN and r.next_probe_at is not None
                             and now >= r.next_probe_at):
-                        # fresh scheduler: the old one was drained at trip
-                        # time.  Push the next probe out now, so a trial
-                        # that fails before its own _on_dispatch runs
-                        # cannot re-probe in a tight loop.
+                        # Push the next probe out now, so a trial that
+                        # fails before its own _on_dispatch runs cannot
+                        # re-probe in a tight loop.
                         r.next_probe_at = now + self.probe_interval_s
-                        r.consecutive_failures = 0
-                        r.scheduler = r._new_scheduler()
-                        r.state = HALF_OPEN
-                        changed = True
-                        log.info("pool %s: replica %d half-open; next "
-                                 "request is its trial", self.name, r.index)
+                        ripe.append(r)
+            # Fresh schedulers are built OUTSIDE the pool lock: scheduler
+            # construction resolves the model's dispatch policy, which may
+            # run a device probe (seconds on a cold backend) — holding the
+            # lock here would stall routing/breaker bookkeeping on every
+            # OTHER healthy replica for the duration (sonata-lint
+            # lock-order pass; pinned by
+            # test_replicas.test_probe_rebuild_does_not_hold_pool_lock).
+            fresh = [(r, r._new_scheduler()) for r in ripe]
+            changed = False
+            with self._lock:
+                for r, sched in fresh:
+                    if self._closed or r.state != OPEN:
+                        # raced shutdown() (or an operator state change):
+                        # installing now would leak the worker thread
+                        self._drain_off_thread(sched, r.index)
+                        continue
+                    # the old scheduler was drained at trip time
+                    r.consecutive_failures = 0
+                    r.scheduler = sched
+                    r.state = HALF_OPEN
+                    changed = True
+                    log.info("pool %s: replica %d half-open; next "
+                             "request is its trial", self.name, r.index)
+                closed = self._closed
             if changed:
                 self._notify_health()
+            if closed:
+                return

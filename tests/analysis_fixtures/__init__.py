@@ -1,0 +1,4 @@
+# Fixture modules for tools/analysis tests.  These files are PARSED by
+# the analysis passes, never imported or executed; each contains seeded
+# violations the passes must report (tests/test_analysis.py asserts the
+# exact findings).

@@ -1,0 +1,47 @@
+"""Seeded violations: blocking calls made while holding a lock."""
+
+import queue
+import threading
+
+LOCK = threading.Lock()
+_queue: "queue.Queue" = queue.Queue()
+
+
+def blocked_queue_get():
+    with LOCK:
+        return _queue.get()  # seeded: queue.get without timeout under LOCK
+
+
+def blocked_future_result(fut):
+    with LOCK:
+        return fut.result()  # seeded: Future.result under LOCK
+
+
+def blocked_file_io(path):
+    with LOCK:
+        with open(path) as f:  # seeded: file I/O under LOCK
+            return f.read()
+
+
+def defines_callback_only():
+    """Merely DEFINING a blocking callback must not make this function
+    look blocking (the scheduler add_done_callback idiom)."""
+    def on_done(fut):
+        return fut.result()
+
+    return on_done
+
+
+def fine_calls_definer_under_lock():
+    with LOCK:
+        return defines_callback_only()  # NOT a finding
+
+
+def fine_bounded_get():
+    with LOCK:
+        return _queue.get(timeout=0.1)  # bounded: NOT a finding
+
+
+def fine_nowait():
+    with LOCK:
+        return _queue.get_nowait()  # non-blocking: NOT a finding

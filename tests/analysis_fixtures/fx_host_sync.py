@@ -1,0 +1,27 @@
+"""Seeded violations: host syncs inside jitted code + on the dispatch path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def traced_bad(x):
+    scale = float(x[0])        # seeded: tracer → python float inside jit
+    host = np.asarray(x)       # seeded: numpy materialization inside jit
+    one = x[0].item()          # seeded: .item() device sync inside jit
+    for b in {1, 2, 4}:        # seeded: set iteration inside traced code
+        x = x * b
+    return x * scale + host.sum() + one
+
+
+def make_fn():
+    def run(x):
+        return jnp.tanh(x * 2.0)  # clean traced code: no findings
+
+    return jax.jit(run)
+
+
+def dispatch_and_sync(x):
+    out = make_fn()(x)         # jit-factory idiom: this is a dispatch site
+    return jax.device_get(out)  # seeded: host sync on the dispatch path

@@ -1,0 +1,17 @@
+"""Seeded violations: env-knob registry drift (module a)."""
+
+import os
+
+
+def read_undocumented():
+    # seeded: read in code, no row in the fixture docs
+    return os.environ.get("SONATA_FX_UNDOCUMENTED")
+
+
+def read_split():
+    # seeded (with fx_knobs_b): default supplied from TWO modules
+    return os.environ.get("SONATA_FX_SPLIT", "1")
+
+
+def read_documented():
+    return os.environ.get("SONATA_FX_DOCUMENTED")  # clean: doc'd + one site

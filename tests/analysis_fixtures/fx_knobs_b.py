@@ -1,0 +1,8 @@
+"""Seeded violations: env-knob registry drift (module b)."""
+
+import os
+
+
+def read_split_elsewhere():
+    # seeded: second default-defining module for SONATA_FX_SPLIT
+    return os.environ.get("SONATA_FX_SPLIT", "2")

@@ -1,0 +1,18 @@
+"""Seeded violation: lock-order cycle (A→B in one path, B→A in another)."""
+
+import threading
+
+A_LOCK = threading.Lock()
+B_LOCK = threading.Lock()
+
+
+def take_a_then_b():
+    with A_LOCK:
+        with B_LOCK:
+            return 1
+
+
+def take_b_then_a():
+    with B_LOCK:
+        with A_LOCK:
+            return 2

@@ -1,0 +1,17 @@
+"""Seeded violation: asymmetric metric registration (series created in a
+register_* function with no ownership bookkeeping, and no unregister_*
+teardown in the module)."""
+
+
+def register_voice(registry, voice_id):
+    metric = registry.gauge("sonata_fx_leaky", "Seeded leaky series.")
+    # seeded: creates a labeled series but records nothing for teardown
+    metric.labels(voice=voice_id).set_function(lambda: 1.0)
+
+    def unrelated_helper(items):
+        # an append inside a NESTED scope must not vouch for the outer
+        # scope's unrecorded series
+        items.append(voice_id)
+        return items
+
+    return metric, unrelated_helper

@@ -40,6 +40,58 @@ try:
 except Exception:
     pass  # cache is an optimization only
 
+# ---------------------------------------------------------------------------
+# Thread hygiene: fail any test that leaks a non-daemon thread past
+# teardown (the PR 2/3 leak class: a scheduler/pool/server worker left
+# running after the object that owned it was dropped).  Daemon threads
+# are the repo's convention for owned workers and die with the process;
+# a NON-daemon leak blocks interpreter exit and is always a bug in the
+# test or the teardown path it exercises.  Opt out with
+# ``@pytest.mark.allow_thread_leak`` for tests that intentionally hold
+# threads across their boundary.
+# ---------------------------------------------------------------------------
+
+import threading
+import time as _time
+
+import pytest
+
+#: shared process-lifetime infrastructure, never torn down per test
+_THREAD_ALLOW_PREFIXES = (
+    "sonata_synth",   # global synthesis pool (one per process by design)
+)
+
+
+@pytest.fixture(autouse=True)
+def _thread_hygiene(request):
+    if request.node.get_closest_marker("allow_thread_leak"):
+        yield
+        return
+    before = {t.ident for t in threading.enumerate()}
+    yield
+
+    def leaked():
+        return [t for t in threading.enumerate()
+                if t.ident not in before and t.is_alive()
+                and not t.daemon
+                and not t.name.startswith(_THREAD_ALLOW_PREFIXES)]
+
+    # small join grace: teardown paths legitimately take a moment to
+    # wind their workers down
+    deadline = _time.monotonic() + 2.0
+    remaining = leaked()
+    while remaining and _time.monotonic() < deadline:
+        for t in remaining:
+            t.join(timeout=0.2)
+        remaining = leaked()
+    if remaining:
+        pytest.fail(
+            "test leaked non-daemon thread(s) past teardown: "
+            + ", ".join(sorted(t.name for t in remaining))
+            + " — join them in the teardown path, or mark the test "
+              "@pytest.mark.allow_thread_leak with a reason")
+
+
 # Deterministic property tests: the driver runs pytest with -x, so a
 # randomized hypothesis failure on a fresh seed would abort the whole
 # suite; derandomize makes runs reproducible (new counterexamples are

@@ -1,0 +1,271 @@
+"""sonata-lint (tools/analysis): the analysis framework's own tests.
+
+Two halves, per the lane's contract:
+
+1. **Fixture detection** — each pass must report the violations seeded
+   in ``tests/analysis_fixtures/`` (lock cycles, blocked holds,
+   host-syncs, knob drift, asymmetric metric registration) with
+   actionable file:line diagnostics.
+2. **Clean real tree** — ``run_all()`` over the repo reports zero
+   un-allowlisted findings and zero allowlist errors (the exact
+   condition the CI "static analysis" step gates on).
+
+Plus the allowlist semantics: stale anchors and unused entries are
+errors, never silent.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # `pytest` invoked without `python -m`
+    sys.path.insert(0, str(REPO))
+
+from tools.analysis import PASSES, run_all  # noqa: E402
+from tools.analysis import hostsync, knobs, lockorder, metricsdoc  # noqa: E402
+from tools.analysis.core import (  # noqa: E402
+    Allowlist,
+    AnalysisContext,
+    parse_mini_toml,
+    render_report,
+)
+
+FIXTURES = Path(__file__).resolve().parent / "analysis_fixtures"
+
+
+def fixture_ctx(*files: str, docs=()) -> AnalysisContext:
+    return AnalysisContext.build(FIXTURES, code_roots=list(files),
+                                 doc_paths=list(docs))
+
+
+def codes(diags):
+    return {d.code for d in diags}
+
+
+# ---------------------------------------------------------------------------
+# pass 1: lock-order
+# ---------------------------------------------------------------------------
+
+def test_lock_cycle_detected():
+    diags = lockorder.run(fixture_ctx("fx_lock_cycle.py"))
+    cycles = [d for d in diags if d.code == "lock-cycle"]
+    assert cycles, "seeded A→B / B→A cycle not reported"
+    assert "A_LOCK" in cycles[0].message and "B_LOCK" in cycles[0].message
+    assert cycles[0].file == "fx_lock_cycle.py"
+
+
+def test_blocked_holds_detected_with_lines():
+    ctx = fixture_ctx("fx_blocked_hold.py")
+    diags = [d for d in lockorder.run(ctx)
+             if d.code == "blocking-under-lock"]
+    by_line = {d.line: d.message for d in diags}
+    src = (FIXTURES / "fx_blocked_hold.py").read_text().splitlines()
+
+    def line_of(snippet):
+        return next(i for i, l in enumerate(src, 1) if snippet in l)
+
+    assert line_of("_queue.get()") in by_line          # unbounded get
+    assert line_of("open(path)") in by_line            # file I/O
+    result_lines = [i for i, l in enumerate(src, 1) if "fut.result()" in l]
+    assert result_lines[0] in by_line                  # future result
+    # bounded / nowait variants are NOT findings
+    assert line_of("timeout=0.1") not in by_line
+    assert line_of("get_nowait") not in by_line
+    # a function that merely DEFINES a blocking callback is not itself
+    # blocking: calling it under a lock is clean (review-pass fix — the
+    # nested def's facts must not bleed into its definer's summary)
+    assert line_of("defines_callback_only()  # NOT") not in by_line
+    assert result_lines[1] not in by_line  # the nested body itself
+
+
+def test_lock_pass_reports_nothing_on_clean_fixture():
+    diags = lockorder.run(fixture_ctx("fx_knobs_a.py"))
+    assert diags == []
+
+
+# ---------------------------------------------------------------------------
+# pass 2: host-sync
+# ---------------------------------------------------------------------------
+
+def test_hostsync_traced_violations_detected():
+    diags = hostsync.run(fixture_ctx("fx_host_sync.py"))
+    got = codes(diags)
+    assert "tracer-to-python" in got       # float()/np.asarray/.item()
+    assert "unstable-iteration" in got     # set iteration in traced code
+    assert "host-sync-on-dispatch-path" in got  # device_get after factory
+    traced = [d for d in diags if d.code == "tracer-to-python"]
+    assert len(traced) == 3  # float(), np.asarray(), .item()
+    assert all(d.file == "fx_host_sync.py" for d in diags)
+    # the clean jitted `run` produced nothing
+    assert not any("run" in d.message.split(":")[0] for d in diags)
+
+
+def test_hostsync_clean_on_lock_fixture():
+    assert hostsync.run(fixture_ctx("fx_lock_cycle.py")) == []
+
+
+# ---------------------------------------------------------------------------
+# pass 3: knobs
+# ---------------------------------------------------------------------------
+
+def test_knob_drift_detected():
+    ctx = fixture_ctx("fx_knobs_a.py", "fx_knobs_b.py",
+                      docs=["fx_docs.md"])
+    diags = knobs.run(ctx)
+    by_code = {}
+    for d in diags:
+        by_code.setdefault(d.code, []).append(d)
+    undocumented = by_code.get("undocumented-knob", [])
+    assert any("SONATA_FX_UNDOCUMENTED" in d.message for d in undocumented)
+    assert not any("SONATA_FX_DOCUMENTED" in d.message
+                   for d in undocumented)
+    split = by_code.get("split-default", [])
+    assert any("SONATA_FX_SPLIT" in d.message for d in split)
+    stale = by_code.get("stale-doc-knob", [])
+    assert any("SONATA_FX_GHOST" in d.message for d in stale)
+    assert all(d.file == "fx_docs.md" for d in stale)
+
+
+# ---------------------------------------------------------------------------
+# pass 4: metrics
+# ---------------------------------------------------------------------------
+
+def test_metric_asymmetry_and_doc_drift_detected():
+    ctx = fixture_ctx("fx_metrics.py", docs=["fx_docs.md"])
+    diags = metricsdoc.run(ctx)
+    got = codes(diags)
+    assert "unrecorded-series" in got   # labels() with no bookkeeping
+    assert "missing-unregister" in got  # no unregister_* in the module
+    ghost = [d for d in diags if d.code == "unknown-doc-metric"]
+    assert any("sonata_fx_ghost_metric" in d.message for d in ghost)
+    # the registered family itself is known → not reported
+    assert not any("sonata_fx_leaky" in d.message for d in ghost)
+
+
+# ---------------------------------------------------------------------------
+# allowlist semantics
+# ---------------------------------------------------------------------------
+
+def test_unused_allowlist_entry_is_an_error():
+    ctx = fixture_ctx("fx_lock_cycle.py")
+    allow = Allowlist([{
+        "pass": "lock-order", "file": "fx_lock_cycle.py", "line": 10,
+        "contains": "with A_LOCK:", "reason": "suppresses nothing"}])
+    diags = lockorder.run(ctx)
+    allow.apply(diags, ctx)
+    assert any("unused allowlist entry" in e for e in allow.errors)
+
+
+def test_stale_allowlist_anchor_is_an_error():
+    ctx = fixture_ctx("fx_blocked_hold.py")
+    allow = Allowlist([{
+        "pass": "lock-order", "file": "fx_blocked_hold.py", "line": 13,
+        "contains": "code that is not on this line", "reason": "stale"}])
+    allow.apply(lockorder.run(ctx), ctx)
+    assert any("stale allowlist entry" in e for e in allow.errors)
+
+
+def test_allowlist_entry_requires_reason():
+    allow = Allowlist([{"pass": "lock-order", "file": "x.py", "line": 1,
+                        "contains": "x"}])  # no reason
+    assert any("rationale" in e for e in allow.errors)
+
+
+def test_mini_toml_parses_allow_entries():
+    data = parse_mini_toml(
+        '# comment\n[[allow]]\npass = "lock-order"\nline = 42\n'
+        'block = true\nreason = "why \\"quoted\\""\n[[allow]]\n'
+        'file = "a.py"  # trailing comment\n')
+    assert len(data["allow"]) == 2
+    assert data["allow"][0]["line"] == 42
+    assert data["allow"][0]["block"] is True
+    assert data["allow"][0]["reason"] == 'why "quoted"'
+    assert data["allow"][1]["file"] == "a.py"
+
+
+def test_repo_allowlist_parses_and_every_entry_has_reason():
+    allow = Allowlist.load()
+    assert allow.entries, "repo allowlist should not be empty"
+    assert allow.errors == []
+    assert all(e.get("reason") for e in allow.entries)
+
+
+# ---------------------------------------------------------------------------
+# the real tree (the CI gate)
+# ---------------------------------------------------------------------------
+
+def test_real_tree_is_green():
+    """`python -m tools.analysis` on this checkout: zero un-allowlisted
+    findings, zero allowlist errors — the blocking-lane condition."""
+    diags, errors = run_all()
+    active = [d for d in diags if not d.allowed]
+    assert active == [], "\n".join(d.format() for d in active)
+    assert errors == [], "\n".join(errors)
+    # and the allowlist is actually exercised (no vacuous green)
+    assert any(d.allowed for d in diags)
+
+
+def test_real_tree_knob_parity_proves_the_fixed_drifts():
+    """The four ISSUE-5 drifts stay fixed: the three code-side knobs are
+    documented, and no doc token lacks a code read."""
+    ctx = AnalysisContext.for_repo()
+    diags = knobs.run(ctx)
+    assert diags == [], "\n".join(d.format() for d in diags)
+    collected = knobs.collect_knobs(ctx)
+    documented = knobs.doc_knob_tokens(ctx)
+    for name in ("SONATA_ESPEAKNG_DATA_DIRECTORY", "SONATA_PLATFORM",
+                 "SONATA_TCONV"):
+        assert name in documented, f"{name} row lost from the docs"
+        assert collected[name].reads, f"{name} no longer read in code"
+    assert "SONATA_PROFILE" not in documented  # re-wired to /debug/profile
+
+
+def test_cli_json_format(capsys):
+    from tools.analysis.__main__ import main
+
+    rc = main(["--format", "json"])
+    out = capsys.readouterr().out
+    report = json.loads(out)
+    assert rc == 0
+    assert report["ok"] is True
+    assert report["findings"] == []
+    assert report["allowlisted"], "allowlist should be exercised"
+    assert {f["pass"] for f in report["allowlisted"]} <= {
+        p.PASS_NAME for p in PASSES}
+
+
+def test_cli_partial_pass_run_is_green(capsys):
+    """--pass <name> must not report other passes' allowlist entries as
+    unused (review-pass fix): a partial run on the green tree exits 0."""
+    from tools.analysis.__main__ import main
+
+    for pass_name in ("knobs", "lock-order"):
+        rc = main(["--pass", pass_name, "--format", "json"])
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 0, report["allowlist_errors"]
+        assert report["allowlist_errors"] == []
+
+
+def test_cli_report_flag_writes_artifact(tmp_path, capsys):
+    """--report writes the JSON artifact from the SAME analysis run that
+    feeds the log (review-pass fix: no second run, no `|| true`)."""
+    from tools.analysis.__main__ import main
+
+    out = tmp_path / "report.json"
+    rc = main(["--report", str(out)])
+    capsys.readouterr()
+    report = json.loads(out.read_text())
+    assert rc == 0
+    assert report["ok"] is True and report["findings"] == []
+
+
+def test_render_report_text_counts():
+    diags, errors = run_all()
+    text = render_report(diags, errors, "text")
+    assert "sonata-lint:" in text.splitlines()[-1]
+    assert "0 finding(s)" in text.splitlines()[-1]

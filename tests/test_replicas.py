@@ -345,6 +345,55 @@ def test_force_open_drains_and_resubmits_queued_work():
         pool.shutdown()
 
 
+def test_probe_rebuild_does_not_hold_pool_lock():
+    """A half-open probe rebuilding a drained replica's scheduler must
+    not hold the pool lock across construction — scheduler construction
+    resolves the model's dispatch policy, which may run a device probe
+    taking seconds, and the lock would stall routing, breaker
+    bookkeeping, and health reads on every OTHER replica meanwhile.
+    Pinned from the sonata-lint lock-order pass (blocking-under-lock in
+    ``_probe_loop``)."""
+    pool = make_pool([FakeModel(), FakeModel()], probe_interval_s=0.05)
+    entered, release = threading.Event(), threading.Event()
+    try:
+        r0 = pool.replicas[0]
+        real_new_scheduler = r0._new_scheduler
+
+        def slow_new_scheduler():
+            entered.set()
+            assert release.wait(timeout=30), "test forgot to release"
+            return real_new_scheduler()
+
+        r0._new_scheduler = slow_new_scheduler
+        pool.force_open(0, "test")
+        assert entered.wait(timeout=30), "prober never began the rebuild"
+        # construction is in progress on the prober thread: the pool
+        # lock must be free — health reads and routing to the healthy
+        # replica complete promptly instead of queueing behind it
+        probe_result: dict = {}
+
+        def read_health():
+            probe_result["healthy"] = pool.healthy_count()
+            probe_result["audio"] = pool.speak("still routable",
+                                               timeout=10)
+
+        t = threading.Thread(target=read_health, daemon=True)
+        t.start()
+        t.join(timeout=5.0)
+        assert not t.is_alive(), \
+            "pool lock held while the probe rebuilt a scheduler"
+        assert probe_result["healthy"] == 1
+        assert len(probe_result["audio"].samples) > 0
+        release.set()
+        deadline = time.monotonic() + 30
+        while r0.state != HALF_OPEN and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert r0.state == HALF_OPEN  # rebuilt scheduler was installed
+    finally:
+        release.set()
+        pool.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # real devices (the acceptance criterion)
 # ---------------------------------------------------------------------------

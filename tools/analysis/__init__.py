@@ -1,0 +1,56 @@
+"""sonata-lint: first-party static analysis for the serving stack.
+
+Four passes over the repo's own invariants, runnable as a blocking CI
+lane (``python -m tools.analysis``) and importable for tests:
+
+1. ``lockorder``  — lock-order cycles + blocking calls under held locks
+2. ``hostsync``   — device syncs / retrace hazards in & around jitted code
+3. ``knobs``      — SONATA_* env knob ↔ operator-doc parity
+4. ``metricsdoc`` — metric-name doc parity + register/unregister symmetry
+
+See docs/ANALYSIS.md for the pass contracts and the allowlist format.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from . import hostsync, knobs, lockorder, metricsdoc
+from .core import (
+    AnalysisContext,
+    Allowlist,
+    Diagnostic,
+    render_report,
+)
+
+PASSES = (lockorder, hostsync, knobs, metricsdoc)
+
+__all__ = [
+    "AnalysisContext",
+    "Allowlist",
+    "Diagnostic",
+    "PASSES",
+    "run_all",
+    "render_report",
+]
+
+
+def run_all(ctx: Optional[AnalysisContext] = None,
+            allowlist: Optional[Allowlist] = None,
+            passes=PASSES) -> Tuple[List[Diagnostic], List[str]]:
+    """Run the passes; returns (diagnostics, allowlist errors).
+
+    Diagnostics covered by the allowlist come back with ``allowed=True``
+    (the run log keeps them visible); stale or unused allowlist entries
+    are errors — suppressions may not rot silently.
+    """
+    if ctx is None:
+        ctx = AnalysisContext.for_repo()
+    if allowlist is None:
+        allowlist = Allowlist.load()
+    diags: List[Diagnostic] = []
+    for p in passes:
+        diags.extend(p.run(ctx))
+    allowlist.apply(diags, ctx,
+                    active_passes={p.PASS_NAME for p in passes})
+    return diags, list(allowlist.errors)

@@ -1,0 +1,51 @@
+"""CLI: ``python -m tools.analysis [--format text|json] [--root DIR]``.
+
+Exit status 0 iff no un-allowlisted findings and no allowlist errors —
+the contract the CI "static analysis" lane enforces.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from . import PASSES, run_all
+from .core import Allowlist, AnalysisContext, render_report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.analysis")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: this checkout)")
+    ap.add_argument("--allowlist", default=None,
+                    help="allowlist TOML (default: tools/analysis/"
+                         "allowlist.toml)")
+    ap.add_argument("--pass", dest="only", action="append", default=[],
+                    choices=[p.PASS_NAME for p in PASSES],
+                    help="run only the named pass(es); allowlist entries "
+                         "for other passes are ignored, not 'unused'")
+    ap.add_argument("--report", default=None, metavar="PATH",
+                    help="also write the JSON report to PATH (one "
+                         "analysis run feeds both the log and the "
+                         "committed artifact)")
+    args = ap.parse_args(argv)
+
+    ctx = AnalysisContext.for_repo(
+        Path(args.root) if args.root else None)
+    allowlist = Allowlist.load(
+        Path(args.allowlist) if args.allowlist else None)
+    passes = [p for p in PASSES
+              if not args.only or p.PASS_NAME in args.only]
+    diags, errors = run_all(ctx, allowlist, passes)
+    if args.report:
+        Path(args.report).write_text(
+            render_report(diags, errors, "json") + "\n", encoding="utf-8")
+    print(render_report(diags, errors, args.format))
+    active = [d for d in diags if not d.allowed]
+    return 1 if (active or errors) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,353 @@
+"""sonata-lint core: parsed-module context, diagnostics, allowlist.
+
+The analysis suite is a small AST-walking framework, not a general
+linter: every pass encodes an invariant *this repo* relies on (lock
+ordering across the serving stack, host-sync discipline inside jitted
+code, knob/metric doc parity).  The framework keeps three concerns out
+of the passes themselves:
+
+- :class:`AnalysisContext` — parse once, share everywhere.  A context
+  holds the parsed modules (``ast`` trees + source lines) for a set of
+  roots plus the doc files the parity passes read.  Tests build contexts
+  over ``tests/analysis_fixtures/`` instead of the real tree.
+- :class:`Diagnostic` — one finding: pass name, stable code, file:line,
+  message.  Passes return lists of these; they never print or exit.
+- :class:`Allowlist` — the line-anchored suppression file
+  (``tools/analysis/allowlist.toml``).  Every entry carries a
+  ``reason`` and a ``contains`` snippet that must still match the
+  anchored source line; an entry whose anchor drifted, or that no
+  finding consumed, is itself reported as an error.  Suppressions
+  therefore cannot rot silently.
+
+TOML note: this environment runs Python 3.10 (no stdlib ``tomllib``)
+and the repo installs nothing, so :func:`parse_mini_toml` implements
+exactly the subset the allowlist uses — ``[[allow]]`` array tables with
+string / int / bool values and ``#`` comments.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+ALLOWLIST_PATH = Path(__file__).resolve().parent / "allowlist.toml"
+
+
+@dataclass
+class Diagnostic:
+    """One finding of one pass, anchored to a source line."""
+
+    pass_name: str      # "lock-order" | "host-sync" | "knobs" | "metrics"
+    code: str           # stable short id, e.g. "blocking-under-lock"
+    file: str           # repo-relative path
+    line: int
+    message: str
+    #: enclosing ``with <lock>`` statement line, when the finding sits
+    #: inside one — lets a single block-scoped allowlist entry cover a
+    #: multi-line intentional hold (e.g. LoadVoice's load lock)
+    block_line: Optional[int] = None
+    allowed: bool = False
+    allow_reason: Optional[str] = None
+
+    def format(self) -> str:
+        mark = " [allowed: %s]" % self.allow_reason if self.allowed else ""
+        return (f"{self.file}:{self.line}: [{self.pass_name}/{self.code}] "
+                f"{self.message}{mark}")
+
+    def as_dict(self) -> dict:
+        return {"pass": self.pass_name, "code": self.code, "file": self.file,
+                "line": self.line, "message": self.message,
+                "allowed": self.allowed, "allow_reason": self.allow_reason}
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed Python module."""
+
+    path: Path
+    relpath: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+class AnalysisContext:
+    """Parsed modules + doc texts for one analysis run."""
+
+    def __init__(self, root: Path, modules: Dict[str, ModuleInfo],
+                 docs: Dict[str, str]):
+        self.root = Path(root)
+        self.modules = modules      # relpath -> ModuleInfo
+        self.docs = docs            # relpath -> text
+
+    @classmethod
+    def build(cls, root: Path, code_roots: Sequence[str],
+              doc_paths: Sequence[str] = ()) -> "AnalysisContext":
+        """Parse every ``*.py`` under ``code_roots`` (files or dirs,
+        relative to ``root``) and read ``doc_paths`` (files or dirs of
+        ``*.md``)."""
+        root = Path(root)
+        modules: Dict[str, ModuleInfo] = {}
+        for entry in code_roots:
+            p = root / entry
+            files = [p] if p.is_file() else sorted(p.rglob("*.py"))
+            for f in files:
+                rel = str(f.relative_to(root))
+                if rel in modules or "__pycache__" in rel:
+                    continue
+                src = f.read_text(encoding="utf-8")
+                try:
+                    tree = ast.parse(src, filename=rel)
+                except SyntaxError as e:  # a broken module is a finding
+                    raise RuntimeError(f"cannot parse {rel}: {e}") from e
+                modules[rel] = ModuleInfo(f, rel, tree, src.splitlines())
+        docs: Dict[str, str] = {}
+        for entry in doc_paths:
+            p = root / entry
+            files = [p] if p.is_file() else sorted(p.rglob("*.md"))
+            for f in files:
+                rel = str(f.relative_to(root))
+                # ANALYSIS.md documents the linter itself (including the
+                # historical drift it found) — it is not operator docs
+                # and must not feed the parity passes
+                if f.exists() and rel != "docs/ANALYSIS.md":
+                    docs[rel] = f.read_text(encoding="utf-8")
+        return cls(root, modules, docs)
+
+    @classmethod
+    def for_repo(cls, root: Optional[Path] = None) -> "AnalysisContext":
+        """The real tree's standard scope (what ``python -m
+        tools.analysis`` checks)."""
+        root = Path(root) if root is not None else REPO_ROOT
+        return cls.build(
+            root,
+            code_roots=["sonata_tpu"],
+            doc_paths=["README.md", "docs"])
+
+
+# ---------------------------------------------------------------------------
+# minimal TOML (allowlist subset)
+# ---------------------------------------------------------------------------
+
+def _parse_toml_value(raw: str, where: str):
+    raw = raw.strip()
+    if raw.startswith('"'):
+        out, i, closed = [], 1, False
+        while i < len(raw):
+            c = raw[i]
+            if c == "\\" and i + 1 < len(raw):
+                out.append({"n": "\n", "t": "\t", '"': '"',
+                            "\\": "\\"}.get(raw[i + 1], raw[i + 1]))
+                i += 2
+                continue
+            if c == '"':
+                closed = True
+                break
+            out.append(c)
+            i += 1
+        rest = raw[i + 1:].strip()
+        if not closed or (rest and not rest.startswith("#")):
+            raise ValueError(f"{where}: unterminated string {raw!r}")
+        return "".join(out)
+    if raw in ("true", "false"):
+        return raw == "true"
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"{where}: unsupported value {raw!r}") from None
+
+
+def parse_mini_toml(text: str) -> Dict[str, list]:
+    """Parse the ``[[section]]`` / ``key = value`` subset the allowlist
+    uses.  Returns ``{section_name: [dict, ...]}``."""
+    sections: Dict[str, list] = {}
+    current: Optional[dict] = None
+    for lineno, line in enumerate(text.splitlines(), 1):
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            continue
+        if stripped.startswith("[[") and stripped.endswith("]]"):
+            name = stripped[2:-2].strip()
+            current = {}
+            sections.setdefault(name, []).append(current)
+            continue
+        if "=" in stripped and current is not None:
+            key, _, raw = stripped.partition("=")
+            # strip a trailing comment outside strings
+            raw = raw.strip()
+            if not raw.startswith('"') and "#" in raw:
+                raw = raw.split("#", 1)[0].strip()
+            current[key.strip()] = _parse_toml_value(raw, f"line {lineno}")
+            continue
+        raise ValueError(f"allowlist line {lineno}: cannot parse {line!r}")
+    return sections
+
+
+class Allowlist:
+    """Line-anchored suppressions, each with a written rationale.
+
+    Entry fields: ``pass`` (pass name), ``file``, ``line``, ``contains``
+    (snippet the anchored line must still contain — edits that move the
+    code invalidate the entry loudly), ``reason`` (required), and
+    optional ``block = true`` (anchor is a ``with``-statement line; the
+    entry covers every finding inside that block).
+    """
+
+    REQUIRED = ("pass", "file", "line", "contains", "reason")
+
+    def __init__(self, entries: List[dict]):
+        self.entries = entries
+        self._used = [False] * len(entries)
+        self.errors: List[str] = []
+        for i, e in enumerate(entries):
+            missing = [k for k in self.REQUIRED if not e.get(k)]
+            if missing:
+                self.errors.append(
+                    f"allowlist entry #{i + 1} missing {missing} "
+                    f"(every suppression needs a rationale)")
+
+    @classmethod
+    def load(cls, path: Optional[Path] = None) -> "Allowlist":
+        path = Path(path) if path is not None else ALLOWLIST_PATH
+        if not path.exists():
+            return cls([])
+        data = parse_mini_toml(path.read_text(encoding="utf-8"))
+        return cls(list(data.get("allow", [])))
+
+    def _anchor_ok(self, entry: dict, ctx: AnalysisContext) -> bool:
+        mod = ctx.modules.get(entry["file"])
+        if mod is not None:
+            return entry["contains"] in mod.line(int(entry["line"]))
+        doc = ctx.docs.get(entry["file"])
+        if doc is not None:
+            lines = doc.splitlines()
+            lineno = int(entry["line"])
+            if 1 <= lineno <= len(lines):
+                return entry["contains"] in lines[lineno - 1]
+        return False
+
+    def apply(self, diags: List[Diagnostic], ctx: AnalysisContext,
+              active_passes: Optional[set] = None) -> List[Diagnostic]:
+        """Mark allowlisted findings; append errors for stale/unused
+        entries to ``self.errors``.  Entries for passes not in
+        ``active_passes`` (a partial ``--pass`` run) are ignored rather
+        than reported unused — only a full run can judge them."""
+        for i, entry in enumerate(self.entries):
+            if not all(entry.get(k) for k in self.REQUIRED):
+                continue
+            if active_passes is not None \
+                    and entry["pass"] not in active_passes:
+                continue
+            if not self._anchor_ok(entry, ctx):
+                self.errors.append(
+                    f"stale allowlist entry: {entry['file']}:{entry['line']}"
+                    f" no longer contains {entry['contains']!r} "
+                    f"(pass {entry['pass']}) — re-anchor or delete it")
+                continue
+            hit = False
+            for d in diags:
+                if d.pass_name != entry["pass"] or d.file != entry["file"]:
+                    continue
+                anchor = int(entry["line"])
+                if entry.get("block"):
+                    match = d.block_line == anchor or d.line == anchor
+                else:
+                    match = d.line == anchor
+                if match:
+                    d.allowed = True
+                    d.allow_reason = entry["reason"]
+                    hit = True
+            if hit:
+                self._used[i] = True
+            else:
+                self.errors.append(
+                    "unused allowlist entry: "
+                    f"{entry['file']}:{entry['line']} (pass "
+                    f"{entry['pass']}) suppresses nothing — delete it")
+        return diags
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    """Rightmost name of the called thing (``x.y.z()`` → ``z``)."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def walk_functions(tree: ast.Module):
+    """Yield ``(classname_or_None, FunctionDef)`` for every function,
+    including methods and nested defs (nested report the enclosing
+    class)."""
+    def visit(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from visit(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield cls, child
+                yield from visit(child, cls)
+            else:
+                yield from visit(child, cls)
+
+    yield from visit(tree, None)
+
+
+def render_report(diags: List[Diagnostic], errors: List[str],
+                  fmt: str = "text") -> str:
+    active = [d for d in diags if not d.allowed]
+    allowed = [d for d in diags if d.allowed]
+    if fmt == "json":
+        return json.dumps({
+            "findings": [d.as_dict() for d in active],
+            "allowlisted": [d.as_dict() for d in allowed],
+            "allowlist_errors": errors,
+            "ok": not active and not errors,
+        }, indent=2, sort_keys=True)
+    out: List[str] = []
+    for d in active:
+        out.append(d.format())
+    for d in allowed:
+        out.append(d.format())
+    for e in errors:
+        out.append(f"allowlist error: {e}")
+    out.append(
+        f"sonata-lint: {len(active)} finding(s), "
+        f"{len(allowed)} allowlisted, {len(errors)} allowlist error(s)")
+    return "\n".join(out)
+
+
+def relpath_of(path: str) -> str:
+    return os.path.relpath(path, REPO_ROOT)

@@ -1,0 +1,170 @@
+"""Pass 3: SONATA_* env-knob registry — code ↔ docs parity.
+
+Three invariants:
+
+- **read → documented**: every ``SONATA_*`` env var the package reads
+  must have a row in the operator docs (``docs/*.md`` or ``README.md``).
+  An undocumented knob is a support incident waiting to happen.
+- **documented → read**: every ``SONATA_*`` token in the docs must be
+  read somewhere in ``sonata_tpu`` — a documented knob nothing reads is
+  worse than undocumented (operators set it and nothing happens).
+- **one default-defining module**: reads that *supply a default* (the
+  two-arg ``os.environ.get(NAME, default)`` / ``_env_int(NAME, default)``
+  forms) must all live in one module per knob.  Two modules each
+  supplying a fallback is exactly how defaults drift apart.
+
+Read detection is AST-based (docstrings and comments mentioning a knob
+are not reads): direct ``os.environ`` access, ``.get`` calls with a
+``SONATA_*`` constant (covers the injectable ``env.get(...)`` pattern),
+``_env_int``-style wrappers, module-level ``X_ENV = "SONATA_..."``
+constants, and ``SONATA_*`` string literals passed as call arguments or
+parameter defaults (the ``configure_logging(env_level_var=...)``
+indirection).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from .core import AnalysisContext, Diagnostic, call_name, const_str, dotted_name
+
+PASS_NAME = "knobs"
+
+KNOB_RE = re.compile(r"\bSONATA_[A-Z0-9_]+\b")
+
+#: wrapper callables whose first argument names an env var
+ENV_WRAPPER_NAMES = {"_env_int", "_env_float", "_env_truthy", "getenv"}
+
+
+@dataclass
+class KnobInfo:
+    name: str
+    #: (file, line) of each detected read
+    reads: List[tuple] = field(default_factory=list)
+    #: modules whose reads supply a default value
+    default_modules: Set[str] = field(default_factory=set)
+    #: (file, line) weaker evidence (constant flowing into a call)
+    references: List[tuple] = field(default_factory=list)
+
+    @property
+    def read_anywhere(self) -> bool:
+        return bool(self.reads or self.references)
+
+
+def _resolve_const(name_node: ast.AST, consts: Dict[str, str]
+                   ) -> Optional[str]:
+    s = const_str(name_node)
+    if s is not None:
+        return s if s.startswith("SONATA_") else None
+    if isinstance(name_node, ast.Name):
+        return consts.get(name_node.id)
+    if isinstance(name_node, ast.Attribute):  # module.CONST
+        return consts.get(name_node.attr)
+    return None
+
+
+def collect_knobs(ctx: AnalysisContext) -> Dict[str, KnobInfo]:
+    knobs: Dict[str, KnobInfo] = {}
+
+    def knob(name: str) -> KnobInfo:
+        return knobs.setdefault(name, KnobInfo(name))
+
+    # module-level NAME = "SONATA_*" constants, repo-wide (cross-module
+    # constant imports resolve by bare name)
+    consts: Dict[str, str] = {}
+    for rel, mod in ctx.modules.items():
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                s = const_str(node.value)
+                if s is not None and s.startswith("SONATA_"):
+                    consts[node.targets[0].id] = s
+                    knob(s).references.append((rel, node.lineno))
+
+    for rel, mod in ctx.modules.items():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                cname = call_name(node) or ""
+                # a `.get`/wrapper call whose first arg resolves to a
+                # SONATA_* constant is an env read (covers os.environ,
+                # the injectable `env.get(...)` pattern, and the
+                # `_env_int(NAME, default)` wrappers)
+                is_env_read = (cname == "get"
+                               and isinstance(node.func, ast.Attribute)
+                               or cname in ENV_WRAPPER_NAMES)
+                if is_env_read and node.args:
+                    name = _resolve_const(node.args[0], consts)
+                    if name is not None:
+                        k = knob(name)
+                        k.reads.append((rel, node.lineno))
+                        if len(node.args) >= 2:  # default supplied here
+                            k.default_modules.add(rel)
+                        continue
+                # SONATA_* constants flowing into any call (indirected
+                # reads like configure_logging(env_level_var=...))
+                for arg in list(node.args) + [kw.value for kw in
+                                              node.keywords]:
+                    s = const_str(arg)
+                    if s is not None and s.startswith("SONATA_"):
+                        knob(s).references.append((rel, node.lineno))
+            elif isinstance(node, ast.Subscript):  # os.environ[NAME]
+                base = dotted_name(node.value) or ""
+                if base.endswith("environ"):
+                    name = _resolve_const(node.slice, consts)
+                    if name is not None:
+                        knob(name).reads.append((rel, node.lineno))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for default in list(node.args.defaults) + [
+                        d for d in node.args.kw_defaults if d is not None]:
+                    s = const_str(default)
+                    if s is not None and s.startswith("SONATA_"):
+                        knob(s).references.append((rel, node.lineno))
+    return knobs
+
+
+def doc_knob_tokens(ctx: AnalysisContext) -> Dict[str, List[tuple]]:
+    """Knob tokens in the docs: name -> [(file, line)]."""
+    out: Dict[str, List[tuple]] = {}
+    for rel, text in ctx.docs.items():
+        for lineno, line in enumerate(text.splitlines(), 1):
+            for m in KNOB_RE.finditer(line):
+                out.setdefault(m.group(0), []).append((rel, lineno))
+    return out
+
+
+def run(ctx: AnalysisContext) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    knobs = collect_knobs(ctx)
+    documented = doc_knob_tokens(ctx)
+
+    for name, info in sorted(knobs.items()):
+        if not info.reads and not info.references:
+            continue
+        if name not in documented and info.reads:
+            rel, line = info.reads[0]
+            diags.append(Diagnostic(
+                PASS_NAME, "undocumented-knob", rel, line,
+                f"{name} is read here but has no row in the operator "
+                "docs (README.md / docs/*.md) — add one or allowlist "
+                "with a reason"))
+        if len(info.default_modules) > 1:
+            rel, line = info.reads[0]
+            diags.append(Diagnostic(
+                PASS_NAME, "split-default", rel, line,
+                f"{name} has default-supplying reads in "
+                f"{len(info.default_modules)} modules "
+                f"({', '.join(sorted(info.default_modules))}) — defaults "
+                "drift apart; centralize in one module"))
+
+    for name, sites in sorted(documented.items()):
+        info = knobs.get(name)
+        if info is None or not info.read_anywhere:
+            rel, line = sites[0]
+            diags.append(Diagnostic(
+                PASS_NAME, "stale-doc-knob", rel, line,
+                f"{name} is documented here but nothing in sonata_tpu "
+                "reads it — remove the doc entry or wire the knob up"))
+    return diags

@@ -1,0 +1,209 @@
+"""Pass 4: metric-registry symmetry and doc parity.
+
+Two invariants:
+
+- **documented → registered**: every ``sonata_*`` series name in the
+  operator docs must correspond to a metric family the code actually
+  registers (literal names, or the ``f"sonata_pool_{key}"`` family
+  patterns).  Histogram sub-series suffixes (``_bucket``/``_sum``/
+  ``_count``) and doc prefixes (``sonata_ttfb`` as shorthand for
+  ``sonata_ttfb_seconds``) resolve against the registered families.
+- **register ↔ unregister symmetry**: per-voice series created by a
+  ``register_*`` function must be recorded for teardown — every scope
+  inside such a function that creates a labeled series (``.labels(...)``
+  / ``.attach(...)``) must also record ownership (``owned.append`` /
+  ``*_series`` bookkeeping), and the module must define the matching
+  ``unregister_*`` that ``.remove()``s what was recorded.  This is the
+  exact-unregister contract PR 2 introduced after the twin-name-list
+  drift; the pass keeps it structural instead of reviewer-enforced.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .core import AnalysisContext, Diagnostic, call_name, walk_functions
+
+PASS_NAME = "metrics"
+
+METRIC_DOC_RE = re.compile(r"\bsonata_[a-z0-9_]+\b")
+REGISTER_CALLS = {"counter", "gauge", "histogram"}
+HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+#: doc tokens that are not metric names (package / service identifiers)
+IGNORED_DOC_TOKENS = {"sonata_tpu", "sonata_grpc", "sonata_lint"}
+
+
+def _joinedstr_pattern(node: ast.JoinedStr) -> Optional[str]:
+    """f-string family name → regex ('sonata_pool_' + var → r'sonata_pool_\\w+')."""
+    parts: List[str] = []
+    for v in node.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            parts.append(re.escape(v.value))
+        elif isinstance(v, ast.FormattedValue):
+            parts.append(r"[a-z0-9_]+")
+        else:
+            return None
+    pattern = "".join(parts)
+    return pattern if pattern.startswith("sonata_") else None
+
+
+def _register_wrappers(ctx: AnalysisContext) -> set:
+    """Names of helper functions whose first parameter flows into a
+    registry ``counter``/``gauge``/``histogram`` call (the
+    ``labeled_gauge(name, ...)`` indirection in ``register_voice``) —
+    calls to them register the literal they are given.  Propagated to a
+    fixpoint so wrappers of wrappers (``voice_gauge``) count too."""
+    wrappers: set = set()
+    funcs = list(walk_functions_all(ctx))
+    changed = True
+    while changed:
+        changed = False
+        for fn in funcs:
+            if fn.name in wrappers or not fn.args.args:
+                continue
+            first = fn.args.args[0].arg
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and node.args \
+                        and isinstance(node.args[0], ast.Name) \
+                        and node.args[0].id == first \
+                        and (call_name(node) or "") in (
+                            REGISTER_CALLS | wrappers):
+                    wrappers.add(fn.name)
+                    changed = True
+                    break
+    return wrappers
+
+
+def walk_functions_all(ctx: AnalysisContext):
+    for _rel, mod in ctx.modules.items():
+        for _cls, fn in walk_functions(mod.tree):
+            yield fn
+
+
+def registered_families(ctx: AnalysisContext
+                        ) -> Tuple[Dict[str, tuple], List[str]]:
+    """(literal name -> (file, line), [regex patterns])."""
+    literals: Dict[str, tuple] = {}
+    patterns: List[str] = []
+    register_calls = REGISTER_CALLS | _register_wrappers(ctx)
+    for rel, mod in ctx.modules.items():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if (call_name(node) or "") not in register_calls:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                if arg.value.startswith("sonata_"):
+                    literals.setdefault(arg.value, (rel, node.lineno))
+            elif isinstance(arg, ast.JoinedStr):
+                p = _joinedstr_pattern(arg)
+                if p is not None:
+                    patterns.append(p)
+    return literals, patterns
+
+
+def _doc_name_known(name: str, literals: Dict[str, tuple],
+                    patterns: List[str]) -> bool:
+    candidates = [name]
+    for suffix in HIST_SUFFIXES:
+        if name.endswith(suffix):
+            candidates.append(name[: -len(suffix)])
+    for cand in candidates:
+        if cand in literals:
+            return True
+        if any(re.fullmatch(p, cand) for p in patterns):
+            return True
+        # doc shorthand: a prefix of a registered family (sonata_ttfb)
+        if any(lit.startswith(cand + "_") for lit in literals):
+            return True
+    return False
+
+
+def _walk_own_scope(fn: ast.FunctionDef):
+    """Walk a function's AST excluding nested function subtrees."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.FunctionDef):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_register_symmetry(ctx: AnalysisContext,
+                             diags: List[Diagnostic]) -> None:
+    for rel, mod in ctx.modules.items():
+        register_fns = [(cls, fn) for cls, fn in walk_functions(mod.tree)
+                        if fn.name.startswith("register")
+                        or fn.name.startswith("_register")]
+        if not register_fns:
+            continue
+        has_unregister = any(fn.name.startswith("unregister")
+                             for _c, fn in walk_functions(mod.tree))
+        creates_series = False
+        for _cls, fn in register_fns:
+            # examine each function scope separately: a nested helper that
+            # creates series must ITSELF record ownership — an unrelated
+            # append inside some other closure must not vouch for the
+            # outer scope (nested subtrees are pruned from own_nodes)
+            scopes = [fn] + [n for n in ast.walk(fn)
+                             if isinstance(n, ast.FunctionDef) and n is not fn]
+            for scope in scopes:
+                own_nodes = list(_walk_own_scope(scope))
+                creation_lines = []
+                records = False
+                for n in own_nodes:
+                    if isinstance(n, ast.Call):
+                        cname = call_name(n) or ""
+                        if cname in ("labels", "attach"):
+                            creation_lines.append(n.lineno)
+                        if cname == "append":
+                            records = True
+                    if isinstance(n, (ast.Assign, ast.AugAssign)):
+                        # direct bookkeeping into a *_series structure
+                        for t in ast.walk(n):
+                            if isinstance(t, ast.Attribute) \
+                                    and t.attr.endswith("_series"):
+                                records = True
+                if creation_lines:
+                    creates_series = True
+                if creation_lines and not records:
+                    diags.append(Diagnostic(
+                        PASS_NAME, "unrecorded-series", rel,
+                        creation_lines[0],
+                        f"{fn.name}/{scope.name}: creates labeled series "
+                        "but records nothing for teardown — unregister "
+                        "cannot remove what was never recorded"))
+        if creates_series and not has_unregister:
+            diags.append(Diagnostic(
+                PASS_NAME, "missing-unregister", rel,
+                register_fns[0][1].lineno,
+                f"{register_fns[0][1].name} registers per-voice series "
+                "but the module defines no matching unregister_* "
+                "teardown"))
+
+
+def run(ctx: AnalysisContext) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    literals, patterns = registered_families(ctx)
+    for rel, text in ctx.docs.items():
+        for lineno, line in enumerate(text.splitlines(), 1):
+            for m in METRIC_DOC_RE.finditer(line):
+                name = m.group(0)
+                if name in IGNORED_DOC_TOKENS:
+                    continue
+                if not _doc_name_known(name, literals, patterns):
+                    diags.append(Diagnostic(
+                        PASS_NAME, "unknown-doc-metric", rel, lineno,
+                        f"{name} appears in the docs but no metric "
+                        "family with that name is registered in code"))
+    _check_register_symmetry(ctx, diags)
+    # de-duplicate repeated doc mentions of the same unknown name
+    unique: Dict[Tuple, Diagnostic] = {}
+    for d in diags:
+        unique.setdefault((d.code, d.file, d.message), d)
+    return sorted(unique.values(), key=lambda d: (d.file, d.line))

@@ -2,10 +2,14 @@
 # Run the CI workflow's exact test steps locally (VERDICT r05 ask #2b).
 #
 # Mirrors .github/workflows/ci.yml step by step:
-#   1. "Run test suite"  — python -m pytest tests/ -q
-#   2. "Compile check (graft entry, CPU)" — dryrun_multichip on the
+#   1. "Static analysis (sonata-lint)" — python -m tools.analysis: the
+#      lock-order / host-sync / knob-registry / metric-registry passes
+#      over the tree, blocking; the machine-readable report lands in
+#      tools/analysis_report.json (committed like the bench artifacts)
+#   2. "Run test suite"  — python -m pytest tests/ -q
+#   3. "Compile check (graft entry, CPU)" — dryrun_multichip on the
 #      virtual 8-device CPU mesh
-#   3. "Serving smoke" — boot the gRPC server with a fake voice, probe
+#   4. "Serving smoke" — boot the gRPC server with a fake voice, probe
 #      /metrics /healthz /readyz, assert exposition format parses and
 #      readiness flips after warmup, assert a traced request's complete
 #      span tree (admission→stream-emit, dispatch attribution) at
@@ -13,7 +17,7 @@
 #      2-replica pool on 2 forced host devices and assert per-replica
 #      gauges + breaker readiness semantics + replica-attributed
 #      dispatch spans (tools/serving_smoke.py)
-#   4. "Multi-device lane" — test_replicas on a forced 4-device CPU
+#   5. "Multi-device lane" — test_replicas on a forced 4-device CPU
 #      host (the replica-pool acceptance shape), plus test_parallel on
 #      its 8-device virtual mesh (make_mesh(8) needs all 8)
 #
@@ -37,12 +41,19 @@ import jax, sys
 print(f"env: python {sys.version.split()[0]}, jax {jax.__version__}")
 EOF
 
-echo "-- step 1/3: python -m pytest tests/ -q $*" | tee -a "$LOG"
+echo "-- step 1/5: static analysis (sonata-lint)" | tee -a "$LOG"
+# one analysis run: findings into the log, the machine-readable report
+# (committed next to the bench artifacts) via --report, one gated rc
+python -m tools.analysis --report tools/analysis_report.json 2>&1 \
+    | tee -a "$LOG"
+rc_lint=${PIPESTATUS[0]}
+
+echo "-- step 2/5: python -m pytest tests/ -q $*" | tee -a "$LOG"
 JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     --continue-on-collection-errors "$@" 2>&1 | tee -a "$LOG"
 rc_tests=${PIPESTATUS[0]}
 
-echo "-- step 2/3: graft-entry compile check (8-device CPU mesh)" | tee -a "$LOG"
+echo "-- step 3/5: graft-entry compile check (8-device CPU mesh)" | tee -a "$LOG"
 python - <<'EOF' 2>&1 | tee -a "$LOG"
 import os
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
@@ -54,11 +65,11 @@ m.dryrun_multichip(8)
 EOF
 rc_graft=${PIPESTATUS[0]}
 
-echo "-- step 3/4: serving smoke (gRPC + /metrics + /healthz + /readyz + replicas)" | tee -a "$LOG"
+echo "-- step 4/5: serving smoke (gRPC + /metrics + /healthz + /readyz + replicas)" | tee -a "$LOG"
 JAX_PLATFORMS=cpu python tools/serving_smoke.py 2>&1 | tee -a "$LOG"
 rc_smoke=${PIPESTATUS[0]}
 
-echo "-- step 4/4: multi-device lane (replica pool on 4 forced devices)" | tee -a "$LOG"
+echo "-- step 5/5: multi-device lane (replica pool on 4 forced devices)" | tee -a "$LOG"
 XLA_FLAGS=--xla_force_host_platform_device_count=4 JAX_PLATFORMS=cpu \
     python -m pytest tests/test_replicas.py -q \
     --continue-on-collection-errors 2>&1 | tee -a "$LOG"
@@ -68,7 +79,9 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     --continue-on-collection-errors 2>&1 | tee -a "$LOG"
 rc_parallel=${PIPESTATUS[0]}
 
-echo "== pytest rc=$rc_tests graft rc=$rc_graft smoke rc=$rc_smoke" \
-     "replicas rc=$rc_replicas parallel rc=$rc_parallel ==" | tee -a "$LOG"
-[ "$rc_tests" -eq 0 ] && [ "$rc_graft" -eq 0 ] && [ "$rc_smoke" -eq 0 ] \
-    && [ "$rc_replicas" -eq 0 ] && [ "$rc_parallel" -eq 0 ]
+echo "== lint rc=$rc_lint pytest rc=$rc_tests graft rc=$rc_graft" \
+     "smoke rc=$rc_smoke replicas rc=$rc_replicas" \
+     "parallel rc=$rc_parallel ==" | tee -a "$LOG"
+[ "$rc_lint" -eq 0 ] && [ "$rc_tests" -eq 0 ] && [ "$rc_graft" -eq 0 ] \
+    && [ "$rc_smoke" -eq 0 ] && [ "$rc_replicas" -eq 0 ] \
+    && [ "$rc_parallel" -eq 0 ]
