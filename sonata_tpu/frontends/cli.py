@@ -277,6 +277,9 @@ def main(argv=None) -> int:
     if args.log_level or args.log_format:
         configure_logging(args.log_level, args.log_format,
                           env_level_var="SONATA_LOG")
+    from ..serving import faults
+
+    faults.warn_if_armed(log)
     try:
         if args.info:
             # metadata comes straight from the JSON config; don't pay the

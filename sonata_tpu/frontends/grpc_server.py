@@ -57,6 +57,7 @@ from ..serving import (
     DeadlineExceeded,
     Overloaded,
     ServingRuntime,
+    faults,
     tracing,
 )
 from ..serving.logs import configure_logging
@@ -415,6 +416,16 @@ class SonataGrpcService:
         deadline = rt.deadline_for(context)
         t0 = time.monotonic()
         first_at: Optional[float] = None
+        # degradation level >= 2: batch/long-form synthesis sheds before
+        # interactive work is touched (the realtime RPC and default lazy
+        # mode keep serving) — recovery re-admits it automatically
+        if request.synthesis_mode in (pb.SynthesisMode.PARALLEL,
+                                      pb.SynthesisMode.BATCHED) \
+                and rt.degradation.reject_heavy():
+            rt.shed.labels(source="degradation").inc()
+            self._abort_sonata(context, "SynthesizeUtterance", Overloaded(
+                f"degraded ({rt.degradation.level_name}): batch "
+                "synthesis rejected; interactive requests only"))
         try:
             if v.scheduler is not None and cfg is None:
                 # continuous batching: submit every sentence up front so a
@@ -427,22 +438,40 @@ class SonataGrpcService:
                 # are dropped before they reach a device dispatch.
                 sc = v.voice.get_fallback_synthesis_config()
                 sid = sc.speaker[1] if sc.speaker else None
-                futures = [v.scheduler.submit(sentence, speaker=sid,
-                                              scales=sc, deadline=deadline)
-                           for sentence in v.synth.phonemize_text(request.text)]
-                with tracing.span("stream-emit") as emit_sp:
-                    for fut in futures:
-                        audio = self._await_future(fut, deadline)
-                        v.rtf.record(audio)
-                        if first_at is None:
-                            first_at = time.monotonic()
-                            rt.ttfb.observe(first_at - t0)
-                            emit_sp.annotate(
-                                ttfb_ms=round((first_at - t0) * 1e3, 3))
-                        yield pb.SynthesisResult(
-                            wav_samples=audio.as_wave_bytes(),
-                            rtf=audio.real_time_factor())
-                    emit_sp.annotate(items=len(futures))
+                futures = []
+                try:
+                    # the submit fan-out sits INSIDE the cancel block: a
+                    # submit that fails partway (queue full on sentence
+                    # k) must cancel sentences 1..k-1 already queued, or
+                    # they synthesize into a request that already aborted
+                    for sentence in v.synth.phonemize_text(request.text):
+                        futures.append(v.scheduler.submit(
+                            sentence, speaker=sid, scales=sc,
+                            deadline=deadline))
+                    with tracing.span("stream-emit") as emit_sp:
+                        for fut in futures:
+                            audio = self._await_future(fut, deadline)
+                            v.rtf.record(audio)
+                            if first_at is None:
+                                first_at = time.monotonic()
+                                rt.ttfb.observe(first_at - t0)
+                                emit_sp.annotate(
+                                    ttfb_ms=round((first_at - t0) * 1e3,
+                                                  3))
+                            yield pb.SynthesisResult(
+                                wav_samples=audio.as_wave_bytes(),
+                                rtf=audio.real_time_factor())
+                        emit_sp.annotate(items=len(futures))
+                finally:
+                    # client went away (or an item failed) with sentences
+                    # still in flight: cancel what hasn't dispatched —
+                    # via the deadline, so the gather loop drops queued
+                    # items — instead of synthesizing into a dead stream
+                    pending = [f for f in futures if not f.done()]
+                    if pending:
+                        deadline.cancel()
+                        for f in pending:
+                            f.cancel()
                 rt.synth_latency.observe(time.monotonic() - t0)
                 self._maybe_log_rtf(v)
                 return
@@ -614,6 +643,7 @@ class SonataGrpcService:
         with self._lock:
             voices = list(self._voices.values())
         try:
+            faults.fire("warmup")
             for v in voices:
                 if v.pool is not None:
                     # every replica must compile its executables before
@@ -799,6 +829,7 @@ def main(argv=None) -> int:
     if args.log_level or args.log_format:
         configure_logging(args.log_level, args.log_format,
                           env_level_var="SONATA_GRPC")
+    faults.warn_if_armed(log)
 
     mesh = None
     if args.mesh_devices:

@@ -17,6 +17,13 @@ from millions of users).  Four orthogonal pieces:
 - :mod:`.tracing` — request-scoped span trees (Dapper-style) with
   coalesced-dispatch attribution, ring-buffered and served from the
   same HTTP plane at ``/debug/traces`` / ``/debug/slowest``.
+- :mod:`.faults` — first-party failpoint injection (named sites, armed
+  via ``SONATA_FAILPOINTS`` or ``/debug/failpoints``), the substrate the
+  chaos smoke drives.
+- :mod:`.degradation` — the graceful-degradation ladder: sustained
+  shedding or watchdog fires move the process through named levels
+  (shrink coalescing → reject batch work → readiness off), recovering
+  by hysteresis.
 
 :class:`ServingRuntime` bundles one of each with the standard instrument
 set and the glue that exports existing observability (``RtfCounter``,
@@ -30,9 +37,12 @@ from __future__ import annotations
 import time
 from typing import Optional
 
-from . import tracing
+from . import degradation as degradation_mod
+from . import faults, tracing
 from .admission import AdmissionController, Overloaded
 from .deadlines import Deadline, DeadlineExceeded, default_timeout_s
+from .degradation import DegradationLadder
+from .faults import InjectedFault
 from .health import HealthState
 from .metrics import (
     MetricsRegistry,
@@ -48,7 +58,11 @@ __all__ = [
     "Overloaded",
     "Deadline",
     "DeadlineExceeded",
+    "DegradationLadder",
+    "InjectedFault",
     "default_timeout_s",
+    "degradation_mod",
+    "faults",
     "HealthState",
     "MetricsRegistry",
     "parse_prometheus_text",
@@ -129,6 +143,31 @@ class ServingRuntime:
         r.gauge("sonata_uptime_seconds", "Seconds since runtime start."
                 ).set_function(
             lambda: time.monotonic() - self._started_at)
+        #: graceful-degradation ladder: admission sheds feed it directly;
+        #: deep layers (scheduler queue-full, pool no-healthy, watchdog)
+        #: feed the process-global install.  The gauge read doubles as
+        #: the lazy hysteresis tick — every scrape decays a quiet ladder.
+        self.degradation = DegradationLadder()
+        degradation_mod.install(self.degradation)
+        self.admission.on_shed = self.degradation.record_shed
+        r.gauge(
+            "sonata_degradation_level",
+            "Graceful-degradation ladder level (0 normal, 1 shrink "
+            "coalescing, 2 reject batch work, 3 readiness off)."
+        ).set_function(lambda: float(self.degradation.current_level()))
+        #: level 3 takes the process out of the serving set; recovery
+        #: (hysteresis) flips /readyz back with no operator action
+        self.health.add_readiness_gate(
+            "degradation", lambda: self.degradation.current_level() < 3)
+        #: chaos observability: series appear once a failpoint registry
+        #: exists (counter semantics via scrape-time callbacks, like the
+        #: replica series)
+        fp = r.counter(
+            "sonata_failpoint_fires_total",
+            "Injected-fault firings since process start, by site.")
+        for site in faults.SITES:
+            fp.labels(site=site).set_function(
+                lambda s=site: faults.fires_total(s))
 
     # -- deadlines -----------------------------------------------------------
     def deadline_for(self, context=None) -> Deadline:
@@ -227,7 +266,9 @@ class ServingRuntime:
                                 "deadlines"),
                     ("cancelled", "Scheduler items dropped on client "
                                   "cancellation"),
-                    ("shed", "Scheduler items rejected on a full queue")):
+                    ("shed", "Scheduler items rejected on a full queue"),
+                    ("stuck", "Scheduler dispatches killed by the "
+                              "hung-dispatch watchdog")):
                 voice_gauge(f"sonata_scheduler_{key}",
                             f"{help}, per voice.", sched_stat(key))
             # time-in-queue histogram (the observability gap the
@@ -318,6 +359,7 @@ class ServingRuntime:
             metric.remove(**labels)
 
     def close(self) -> None:
+        degradation_mod.uninstall(self.degradation)
         if self.http is not None:
             self.http.stop()
             self.http = None

@@ -26,7 +26,7 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
-from typing import Iterator, Optional
+from typing import Callable, Iterator, Optional
 
 from ..core import SonataError
 
@@ -68,6 +68,9 @@ class AdmissionController:
         self._lock = threading.Lock()
         self._in_flight = 0
         self._shed = 0
+        #: optional per-shed callback (the serving runtime points this at
+        #: the degradation ladder); called outside the counter lock
+        self.on_shed: Optional[Callable[[], None]] = None
 
     @property
     def capacity(self) -> int:
@@ -88,9 +91,16 @@ class AdmissionController:
         with self._lock:
             if self._in_flight >= self.capacity:
                 self._shed += 1
-                return False
-            self._in_flight += 1
-            return True
+                shed = True
+            else:
+                self._in_flight += 1
+                shed = False
+        if shed and self.on_shed is not None:
+            try:
+                self.on_shed()
+            except Exception:
+                pass  # pressure accounting must never fail an RPC
+        return not shed
 
     def release(self) -> None:
         with self._lock:

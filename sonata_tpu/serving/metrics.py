@@ -318,8 +318,17 @@ class _Handler(BaseHTTPRequestHandler):
     tracer = None
 
     def do_GET(self):  # noqa: N802 (http.server API)
+        from . import faults
+
         path, _, query = self.path.partition("?")
         if path == "/metrics":
+            try:
+                faults.fire("metrics.scrape")
+            except faults.InjectedFault as e:
+                # an injected scrape fault degrades exactly one scrape —
+                # the handler thread answers 503 and the server lives on
+                self._reply(503, f"{e}\n".encode())
+                return
             body = self.registry.render().encode("utf-8")
             self._reply(200, body, CONTENT_TYPE)
         elif path == "/healthz":
@@ -336,8 +345,47 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply_traces(path, query)
         elif path == "/debug/profile":
             self._reply_profile(query)
+        elif path == "/debug/failpoints":
+            self._reply_failpoints(query)
         else:
             self._reply(404, b"not found\n")
+
+    # -- failpoint arming plane (serving/faults.py) --------------------------
+    def _reply_failpoints(self, query: str) -> None:
+        """``GET /debug/failpoints`` — no params: JSON state snapshot;
+        ``?arm=site:mode[:rate[:latency_ms[:max_hits]]]`` (repeatable)
+        arms; ``?disarm=site`` / ``?disarm=all`` disarms (releasing the
+        threads stuck in the disarmed sites' ``hang``)."""
+        import json
+        from urllib.parse import parse_qs
+
+        from . import faults
+
+        params = parse_qs(query)
+        wants_mutation = bool(params.get("arm") or params.get("disarm"))
+        if wants_mutation and not faults.http_arming_allowed():
+            # same posture as the tracer-gated /debug siblings: a metrics
+            # port reachable cluster-wide must not double as a remote
+            # fault-injection switch without an explicit opt-in
+            self._reply(403, b"failpoint arming not enabled on this "
+                             b"server (set SONATA_FAILPOINTS or call "
+                             b"faults.enable_http_arming())\n")
+            return
+        try:
+            for spec in params.get("arm", []):
+                faults.registry().arm_spec(spec)
+            for site in params.get("disarm", []):
+                if site == "all":
+                    faults.registry().disarm_all()
+                else:
+                    faults.registry().disarm(site)
+        except ValueError as e:
+            self._reply(400, (str(e) + "\n").encode())
+            return
+        body = json.dumps(faults.registry().snapshot(), indent=2,
+                          sort_keys=True)
+        self._reply(200, body.encode("utf-8"),
+                    "application/json; charset=utf-8")
 
     # -- request-trace debug plane (serving/tracing.py) ----------------------
     def _reply_traces(self, path: str, query: str) -> None:

@@ -27,7 +27,12 @@ replica placement, OSDI '23): **replica-pool serving**.
   chip degrades capacity instead of failing requests.  After
   ``SONATA_REPLICA_PROBE_INTERVAL_S`` (default 5 s) the breaker goes
   **half-open**: the router hands the replica one trial request; success
-  closes the breaker, failure re-opens it for another probe interval.
+  closes the breaker, failure re-opens it with the probe interval
+  **doubled** (plus jitter, capped at ``SONATA_REPLICA_PROBE_MAX_S``,
+  default 60 s) — a persistently sick device is probed ever more
+  rarely, not stormed.  Wedge-class faults (a dispatch stuck past the
+  ``SONATA_DISPATCH_TIMEOUT_S`` watchdog, a crashed scheduler worker)
+  trip the breaker immediately and recycle the replica's scheduler.
 - **Health integration**: ``healthy_count()`` backs a readiness gate —
   a pool with zero healthy replicas flips ``/readyz`` (see
   :meth:`~sonata_tpu.serving.health.HealthState.add_readiness_gate`)
@@ -42,6 +47,7 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import threading
 import time
 from concurrent.futures import CancelledError, Future
@@ -49,7 +55,7 @@ from typing import Callable, Optional, Sequence
 
 from ..core import OperationError
 from ..utils.profiling import QUEUE_WAIT_BUCKETS_S, Histogram
-from . import tracing
+from . import degradation, faults, tracing
 from .admission import Overloaded
 from .deadlines import Deadline, DeadlineExceeded
 
@@ -58,8 +64,16 @@ log = logging.getLogger("sonata.serving")
 REPLICAS_ENV = "SONATA_REPLICAS"
 BREAKER_THRESHOLD_ENV = "SONATA_REPLICA_BREAKER_THRESHOLD"
 PROBE_INTERVAL_ENV = "SONATA_REPLICA_PROBE_INTERVAL_S"
+#: cap for the exponential probe backoff: a replica whose trials keep
+#: failing doubles its probe interval (plus jitter) up to this bound,
+#: instead of probe-storming a persistently sick device every interval
+PROBE_MAX_ENV = "SONATA_REPLICA_PROBE_MAX_S"
 DEFAULT_BREAKER_THRESHOLD = 3
 DEFAULT_PROBE_INTERVAL_S = 5.0
+DEFAULT_PROBE_MAX_S = 60.0
+#: fractional jitter on every probe delay, so a fleet of replicas (or
+#: hosts) tripped by one event does not re-probe in lockstep
+PROBE_JITTER = 0.1
 
 # breaker states; exported as the numeric value of the
 # sonata_replica_breaker_state gauge (0 = serving, 1 = probing, 2 = out)
@@ -122,18 +136,49 @@ class _BreakerModel:
     futures.  Everything else delegates to the wrapped model.
     """
 
+    #: tells the scheduler this wrapper fires the dispatch failpoint
+    #: itself, inside the failure accounting — an injected device fault
+    #: must count toward the breaker exactly like a real one
+    owns_dispatch_failpoint = True
+
     def __init__(self, model, replica: "Replica"):
         self._model = model
         self._replica = replica
 
-    def speak_batch(self, *args, **kwargs):
+    def speak_batch(self, sentences, *args, **kwargs):
+        # capture the breaker generation BEFORE the call: a dispatch
+        # thread the watchdog quarantined may complete arbitrarily late,
+        # and its tap must not close a HALF_OPEN breaker (no trial ran)
+        # or re-count a wedge the watchdog already accounted
+        generation = self._replica.generation
         try:
-            out = self._model.speak_batch(*args, **kwargs)
+            action = faults.fire("dispatch.device_call")
+            out = faults.corrupt_result(
+                action, self._model.speak_batch(sentences, *args, **kwargs))
         except Exception:
-            self._replica._record_dispatch(ok=False)
+            self._replica._record_dispatch(ok=False, generation=generation)
             raise
-        self._replica._record_dispatch(ok=True)
+        # a device answering the wrong number of rows is a DEVICE fault:
+        # count it here, where the breaker can see it — the scheduler
+        # fails the batch with the typed shape error downstream, after
+        # this tap has run, and the pool resubmits off the sick replica
+        ok = len(out) == len(sentences)
+        self._replica._record_dispatch(ok=ok, generation=generation)
         return out
+
+    # -- watchdog / crash hooks (called by the replica's scheduler) ----------
+    def report_dispatch_stuck(self) -> None:
+        """The watchdog convicted a dispatch that never returned: its
+        breaker tap inside ``speak_batch`` runs only if the quarantined
+        thread ever completes — and by then carries a stale generation
+        and is ignored — so the scheduler reports the wedge here and the
+        replica recycles now."""
+        self._replica._report_fault("dispatch stuck past the watchdog")
+
+    def report_scheduler_fault(self, exc: Exception) -> None:
+        """The replica's scheduler worker crashed; recycle the replica so
+        queued work resubmits and a probe rebuilds the scheduler."""
+        self._replica._report_fault(f"scheduler worker crashed: {exc}")
 
     def __getattr__(self, name):
         return getattr(self._model, name)
@@ -170,6 +215,15 @@ class Replica:
         #                            retried on another replica
         self.opened_at: Optional[float] = None
         self.next_probe_at: Optional[float] = None
+        #: current probe backoff (seconds, pre-jitter): reset to the pool
+        #: base on a fresh trip, doubled (capped) on every failed trial,
+        #: cleared when the breaker closes
+        self.probe_backoff_s: Optional[float] = None
+        #: breaker generation, bumped on every trip: dispatches started
+        #: before a trip (e.g. a watchdog-quarantined thread finishing
+        #: late) carry a stale generation and their breaker tap is
+        #: ignored — the trip already accounted them
+        self.generation = 0
         self.scheduler = self._new_scheduler()
 
     def _new_scheduler(self):
@@ -181,10 +235,19 @@ class Replica:
     def device_id(self) -> int:
         return getattr(self.device, "id", self.index)
 
-    def _record_dispatch(self, *, ok: bool) -> None:
+    def _record_dispatch(self, *, ok: bool,
+                         generation: Optional[int] = None) -> None:
         pool = self._pool
         if pool is not None:
-            pool._on_dispatch(self, ok)
+            pool._on_dispatch(self, ok, generation=generation)
+
+    def _report_fault(self, reason: str) -> None:
+        """A wedge-class fault (stuck dispatch, crashed worker): recycle
+        immediately — the scheduler/thread state is unusable regardless
+        of how many consecutive failures came before."""
+        pool = self._pool
+        if pool is not None:
+            pool._recycle_replica(self, reason)
 
     def snapshot(self) -> dict:
         return {"index": self.index, "device": str(self.device),
@@ -194,6 +257,7 @@ class Replica:
                 "dispatches": self.dispatches,
                 "dispatch_failures": self.dispatch_failures,
                 "resubmits": self.resubmits,
+                "probe_backoff_s": self.probe_backoff_s,
                 "queue_depth": self.scheduler.queue_depth()}
 
 
@@ -208,6 +272,7 @@ class ReplicaPool:
     def __init__(self, models: Sequence, devices: Optional[Sequence] = None,
                  *, breaker_threshold: Optional[int] = None,
                  probe_interval_s: Optional[float] = None,
+                 probe_max_s: Optional[float] = None,
                  scheduler_kwargs: Optional[dict] = None,
                  on_health_change: Optional[Callable[[int], None]] = None,
                  name: str = "pool"):
@@ -223,6 +288,11 @@ class ReplicaPool:
         self.probe_interval_s = max(0.01, (
             probe_interval_s if probe_interval_s is not None
             else _env_float(PROBE_INTERVAL_ENV, DEFAULT_PROBE_INTERVAL_S)))
+        # never below the base: a pinned-long base interval (the CI
+        # smoke's 600 s) must not be clipped by the default cap
+        self.probe_max_s = max(self.probe_interval_s, (
+            probe_max_s if probe_max_s is not None
+            else _env_float(PROBE_MAX_ENV, DEFAULT_PROBE_MAX_S)))
         self._lock = threading.RLock()
         self._closed = False
         self._on_health_change = on_health_change
@@ -308,12 +378,35 @@ class ReplicaPool:
     def queue_depth(self) -> int:
         return sum(r.scheduler.queue_depth() for r in self.replicas)
 
+    def set_dispatch_timeout(self, seconds: Optional[float]) -> None:
+        """(Re)arm the hung-dispatch watchdog on every replica's
+        scheduler, including ones the probe loop rebuilds later (the
+        kwarg is recorded so ``_new_scheduler`` inherits it).  None
+        means *disable*, so it is recorded as 0.0 — a raw None kwarg
+        would make a rebuilt scheduler fall back to the env value and
+        silently resurrect a watchdog the operator turned off.
+
+        Runs under the pool lock, and replaces the kwargs dict wholesale
+        rather than mutating it: ``_new_scheduler`` unpacks the dict
+        OUTSIDE the lock in the probe loop, so an in-place first-time
+        key insert could resize it mid-unpack.  A rebuild racing this
+        call may still have snapshotted the old kwargs — the probe loop
+        re-applies the recorded value at install time to close that."""
+        resolved = seconds if seconds is not None else 0.0
+        with self._lock:
+            for r in self.replicas:
+                r._scheduler_kwargs = dict(r._scheduler_kwargs,
+                                           dispatch_timeout_s=resolved)
+                # a plain attribute store on the scheduler: safe (and
+                # race-free with the rebuild install) under the lock
+                r.scheduler.set_dispatch_timeout(resolved)
+
     def stats_view(self) -> dict:
         """Aggregate scheduler stats across replicas plus the pool's own
         routing/breaker counters — same keys a lone ``BatchScheduler``
         exposes, so log lines and benches read either transparently."""
         agg = {"requests": 0, "dispatches": 0, "shed": 0, "expired": 0,
-               "cancelled": 0}
+               "cancelled": 0, "stuck": 0}
         for r in self.replicas:
             for k, v in r.scheduler.stats_view().items():
                 if k in agg:
@@ -394,10 +487,18 @@ class ReplicaPool:
                *, resubmits_left: int, exclude: tuple,
                tctx=None, t_first: Optional[float] = None) -> None:
         tried = list(exclude)
+        try:
+            faults.fire("pool.route")
+        except OperationError as e:
+            # an injected routing fault fails the request like any other
+            # pool-level refusal (never crashes a resubmit callback)
+            self._fail(outer, e)
+            return
         while True:
             try:
                 replica = self._pick(tuple(tried))
             except Overloaded as e:
+                degradation.note_shed()  # capacity shed: no healthy replica
                 self._fail(outer, e)
                 return
             try:
@@ -489,6 +590,28 @@ class ReplicaPool:
             pass
 
     # -- breaker --------------------------------------------------------------
+    def _open_locked(self, replica: Replica, *, failed_trial: bool) -> None:
+        """Flip a replica OPEN and schedule its next probe (pool lock
+        held).  Backoff: a fresh trip probes after the base interval; a
+        failed half-open trial doubles the interval up to
+        ``probe_max_s`` — plus jitter — so a persistently sick device is
+        probed ever more rarely instead of stormed."""
+        replica.state = OPEN
+        replica.opened_at = time.monotonic()
+        replica.generation += 1  # in-flight dispatches are now stale
+        if failed_trial and replica.probe_backoff_s is not None:
+            replica.probe_backoff_s = min(replica.probe_backoff_s * 2,
+                                          self.probe_max_s)
+        else:
+            replica.probe_backoff_s = self.probe_interval_s
+        replica.next_probe_at = (replica.opened_at
+                                 + self._jittered(replica.probe_backoff_s))
+        self.stats["breaker_opens"] += 1
+
+    @staticmethod
+    def _jittered(seconds: float) -> float:
+        return seconds * (1.0 + PROBE_JITTER * random.random())
+
     def _drain_off_thread(self, scheduler, index: int) -> None:
         """Shut a scheduler down on a helper thread: ``shutdown()`` joins
         the scheduler's worker — which may be the very thread running the
@@ -497,16 +620,29 @@ class ReplicaPool:
                          name=f"sonata_replica_drain_{index}",
                          daemon=True).start()
 
-    def _on_dispatch(self, replica: Replica, ok: bool) -> None:
+    def _on_dispatch(self, replica: Replica, ok: bool,
+                     generation: Optional[int] = None) -> None:
         """Dispatch-granular breaker bookkeeping (called by the
         replica's :class:`_BreakerModel` around every ``speak_batch``)."""
         to_drain = None
         with self._lock:
+            if (generation is not None
+                    and generation != replica.generation):
+                # a dispatch from before a breaker trip finishing late —
+                # a watchdog-quarantined thread, typically.  The trip
+                # already accounted it: a late success must not close a
+                # HALF_OPEN breaker (no trial ran), a late failure must
+                # not double-count the wedge.
+                log.info("pool %s: replica %d ignoring stale dispatch "
+                         "result (generation %d != %d)", self.name,
+                         replica.index, generation, replica.generation)
+                return
             if ok:
                 replica.dispatches += 1
                 replica.consecutive_failures = 0
                 if replica.state == HALF_OPEN:
                     replica.state = CLOSED
+                    replica.probe_backoff_s = None  # backoff resets
                     self.stats["recovered"] += 1
                     log.info("pool %s: replica %d trial dispatch "
                              "succeeded; breaker closed", self.name,
@@ -517,24 +653,21 @@ class ReplicaPool:
             else:
                 replica.dispatch_failures += 1
                 replica.consecutive_failures += 1
-                trip = (replica.state == HALF_OPEN
+                failed_trial = replica.state == HALF_OPEN
+                trip = (failed_trial
                         or (replica.state == CLOSED
                             and replica.consecutive_failures
                             >= self.breaker_threshold))
                 notify = trip
                 if trip:
-                    replica.state = OPEN
-                    replica.opened_at = time.monotonic()
-                    replica.next_probe_at = (replica.opened_at
-                                             + self.probe_interval_s)
-                    self.stats["breaker_opens"] += 1
+                    self._open_locked(replica, failed_trial=failed_trial)
                     to_drain = replica.scheduler
                     log.error(
                         "pool %s: replica %d circuit-broken after %d "
                         "consecutive dispatch failures; draining "
                         "(next probe in %.1fs)", self.name, replica.index,
                         replica.consecutive_failures,
-                        self.probe_interval_s)
+                        replica.probe_backoff_s)
         if to_drain is not None:
             # drain off-thread: shutdown() joins the scheduler worker —
             # the very thread this callback may be running on
@@ -550,14 +683,36 @@ class ReplicaPool:
             replica = self.replicas[index]
             if replica.state == OPEN:
                 return
-            replica.state = OPEN
-            replica.opened_at = time.monotonic()
-            replica.next_probe_at = replica.opened_at + self.probe_interval_s
-            self.stats["breaker_opens"] += 1
+            self._open_locked(replica, failed_trial=False)
             sched = replica.scheduler
         log.warning("pool %s: replica %d force-opened (%s)", self.name,
                     index, reason)
         self._drain_off_thread(sched, index)
+        self._probe_wake.set()
+        self._notify_health()
+
+    def _recycle_replica(self, replica: Replica, reason: str) -> None:
+        """Immediate trip for wedge-class faults (stuck dispatch, crashed
+        scheduler worker): the replica's scheduler state is unusable, so
+        it drains now — queued work fails out and resubmits — and the
+        probe loop rebuilds a fresh scheduler for the half-open trial.
+        Runs on the replica's own scheduler worker thread, so the drain
+        must (and does) happen off-thread."""
+        with self._lock:
+            if replica.state == OPEN:
+                # the trip that opened the breaker already accounted the
+                # wedge — a second conviction racing the drain must not
+                # re-count it (mirrors _on_dispatch's generation guard)
+                return
+            replica.dispatch_failures += 1
+            replica.consecutive_failures += 1
+            self._open_locked(replica,
+                              failed_trial=replica.state == HALF_OPEN)
+            sched = replica.scheduler
+        log.error("pool %s: replica %d recycling (%s); draining and "
+                  "rebuilding (next probe in %.1fs)", self.name,
+                  replica.index, reason, replica.probe_backoff_s)
+        self._drain_off_thread(sched, replica.index)
         self._probe_wake.set()
         self._notify_health()
 
@@ -586,10 +741,12 @@ class ReplicaPool:
                 for r in self.replicas:
                     if (r.state == OPEN and r.next_probe_at is not None
                             and now >= r.next_probe_at):
-                        # Push the next probe out now, so a trial that
-                        # fails before its own _on_dispatch runs cannot
-                        # re-probe in a tight loop.
-                        r.next_probe_at = now + self.probe_interval_s
+                        # Push the next probe out now (at the replica's
+                        # current backoff), so a trial that fails before
+                        # its own _on_dispatch runs cannot re-probe in a
+                        # tight loop.
+                        r.next_probe_at = now + self._jittered(
+                            r.probe_backoff_s or self.probe_interval_s)
                         ripe.append(r)
             # Fresh schedulers are built OUTSIDE the pool lock: scheduler
             # construction resolves the model's dispatch policy, which may
@@ -598,7 +755,28 @@ class ReplicaPool:
             # OTHER healthy replica for the duration (sonata-lint
             # lock-order pass; pinned by
             # test_replicas.test_probe_rebuild_does_not_hold_pool_lock).
-            fresh = [(r, r._new_scheduler()) for r in ripe]
+            # Construction against a still-sick device can itself raise
+            # (that same dispatch-policy probe): a failed build must not
+            # kill this thread — it is the pool's ONLY path back from
+            # OPEN — so the replica stays OPEN and retries at its next
+            # (already backed-off) probe.
+            fresh = []
+            for r in ripe:
+                try:
+                    fresh.append((r, r._new_scheduler()))
+                except Exception:
+                    log.exception(
+                        "pool %s: replica %d scheduler rebuild failed; "
+                        "retrying at next probe", self.name, r.index)
+                    with self._lock:
+                        if r.state == OPEN:
+                            r.probe_backoff_s = min(
+                                (r.probe_backoff_s or
+                                 self.probe_interval_s) * 2,
+                                self.probe_max_s)
+                            r.next_probe_at = (time.monotonic()
+                                               + self._jittered(
+                                                   r.probe_backoff_s))
             changed = False
             with self._lock:
                 for r, sched in fresh:
@@ -609,6 +787,12 @@ class ReplicaPool:
                         continue
                     # the old scheduler was drained at trip time
                     r.consecutive_failures = 0
+                    # re-apply the recorded watchdog bound: this build's
+                    # kwargs snapshot may predate a set_dispatch_timeout
+                    # that ran while construction was off-lock
+                    timeout = r._scheduler_kwargs.get("dispatch_timeout_s")
+                    if timeout is not None:
+                        sched.set_dispatch_timeout(timeout)
                     r.scheduler = sched
                     r.state = HALF_OPEN
                     changed = True

@@ -1,7 +1,7 @@
 """Synthesis orchestration (analogue of ``crates/sonata/synth``)."""
 
 from .output import AudioOutputConfig, percent_to_param, process_prosody
-from .scheduler import BatchScheduler
+from .scheduler import BatchScheduler, DispatchStuck, SchedulerCrashed
 from .synthesizer import (
     RealtimeSpeechStream,
     SpeechStreamBatched,
@@ -15,6 +15,8 @@ __all__ = [
     "percent_to_param",
     "process_prosody",
     "BatchScheduler",
+    "DispatchStuck",
+    "SchedulerCrashed",
     "RealtimeSpeechStream",
     "SpeechStreamBatched",
     "SpeechStreamLazy",

@@ -33,6 +33,8 @@ forwards both per row, so coalescing never flattens per-request settings.
 
 from __future__ import annotations
 
+import contextvars
+import logging
 import os
 import queue
 import threading
@@ -42,13 +44,31 @@ from typing import Optional
 
 from ..audio import Audio
 from ..core import Model, OperationError
-from ..serving import tracing
+from ..serving import degradation, faults, tracing
 from ..serving.admission import Overloaded
 from ..serving.deadlines import Deadline, DeadlineExceeded
 from ..utils.profiling import QUEUE_WAIT_BUCKETS_S, Histogram
 
+log = logging.getLogger("sonata.serving")
+
 MAX_QUEUE_ENV = "SONATA_SCHED_MAX_QUEUE"
 DEFAULT_MAX_QUEUE = 1024
+#: hung-dispatch watchdog: wall-clock bound per device dispatch; <= 0 or
+#: unset disables (the default — a cold XLA compile happens *inside* a
+#: dispatch, so operators must size this past their worst cold compile
+#: or pair it with --prewarm + the persistent compile cache)
+DISPATCH_TIMEOUT_ENV = "SONATA_DISPATCH_TIMEOUT_S"
+
+
+class DispatchStuck(OperationError):
+    """A device dispatch exceeded the watchdog; its worker thread was
+    quarantined and the batch's futures failed (a wedged chip raises
+    nothing — only wall clock can convict it)."""
+
+
+class SchedulerCrashed(OperationError):
+    """The scheduler worker loop died on an unexpected exception; every
+    pending/queued item fails with this instead of hanging forever."""
 
 
 class _Item:
@@ -73,7 +93,8 @@ class BatchScheduler:
                  max_wait_ms: Optional[float] = None,
                  max_queue: Optional[int] = None,
                  queue_wait_hist: Optional[Histogram] = None,
-                 trace_attrs: Optional[dict] = None):
+                 trace_attrs: Optional[dict] = None,
+                 dispatch_timeout_s: Optional[float] = None):
         self._model = model
         # knobs default from the model's backend-adaptive dispatch policy
         # (utils/dispatch_policy): on a CPU backend that degrades to
@@ -98,14 +119,32 @@ class BatchScheduler:
         self._max_batch = max_batch
         self._max_wait = max_wait_ms / 1000.0
         self._max_queue = max_queue
+        if dispatch_timeout_s is None:
+            try:
+                dispatch_timeout_s = float(
+                    os.environ.get(DISPATCH_TIMEOUT_ENV, 0.0))
+            except ValueError:
+                dispatch_timeout_s = 0.0
+        #: hung-dispatch watchdog bound (seconds); <= 0 disables, and the
+        #: disabled path is exactly the pre-watchdog direct call
+        self._dispatch_timeout_s = dispatch_timeout_s
+        #: lazily-built helper thread for supervised dispatches; replaced
+        #: only when the watchdog quarantines it (see _DispatchHelper)
+        self._dispatch_helper: Optional["_DispatchHelper"] = None
+        #: a ReplicaPool's _BreakerModel owns the dispatch failpoint so
+        #: injected errors count toward the breaker; bare models get the
+        #: hook here
+        self._fire_dispatch_failpoint = not getattr(
+            model, "owns_dispatch_failpoint", False)
         #: per-dispatch observability, same shape as the stream
         #: coalescers': coalescing ratio = requests / dispatches; plus the
         #: serving-runtime drop counters (shed = queue full at submit,
-        #: expired/cancelled = dropped by the gather loop pre-dispatch).
+        #: expired/cancelled = dropped by the gather loop pre-dispatch)
+        #: and stuck = dispatches killed by the watchdog.
         #: submit() counters race with the worker's, so increments go
         #: through _bump (dict += is not atomic under concurrency)
         self.stats = {"requests": 0, "dispatches": 0, "shed": 0,
-                      "expired": 0, "cancelled": 0}
+                      "expired": 0, "cancelled": 0, "stuck": 0}
         self._stats_lock = threading.Lock()
         #: time-in-queue (submit → gather) per item, including items the
         #: gather loop dropped — the queue-wait half of the coalescing
@@ -137,6 +176,12 @@ class BatchScheduler:
     def queue_depth(self) -> int:
         """Items currently waiting (approximate; for metrics)."""
         return self._queue.qsize()
+
+    def set_dispatch_timeout(self, seconds: Optional[float]) -> None:
+        """(Re)arm the hung-dispatch watchdog at runtime (<= 0 or None
+        disables).  Lets operators and the chaos smoke warm up without a
+        bound — cold compiles happen inside a dispatch — then clamp."""
+        self._dispatch_timeout_s = seconds if seconds is not None else 0.0
 
     def stats_view(self) -> dict:
         """Stats snapshot plus the derived coalescing ratio (requests per
@@ -194,6 +239,7 @@ class BatchScheduler:
             self._queue.put_nowait(item)
         except queue.Full:
             self._bump("shed")
+            degradation.note_shed()
             raise Overloaded(
                 f"scheduler queue full ({self._max_queue} items); "
                 "shedding") from None
@@ -220,6 +266,10 @@ class BatchScheduler:
         except queue.Full:
             pass  # worker will observe _closed on its next loop anyway
         self._worker.join(timeout=5.0)
+        helper, self._dispatch_helper = self._dispatch_helper, None
+        if helper is not None:
+            helper.retire()
+            helper.thread.join(timeout=1.0)
         # fail anything still enqueued so no caller blocks forever
         while True:
             try:
@@ -233,29 +283,79 @@ class BatchScheduler:
     # -- worker --------------------------------------------------------------
     def _run(self) -> None:
         while not self._closed.is_set():
+            batch: list = []
             try:
-                item = self._queue.get(timeout=0.5)
-            except queue.Empty:
-                continue  # re-check _closed: a full queue can eat the
-                # shutdown sentinel, so the worker must not block forever
-            if item is None:
-                continue
-            batch = [item]
-            deadline = time.monotonic() + self._max_wait
-            while len(batch) < self._max_batch:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
                 try:
-                    nxt = self._queue.get(timeout=remaining)
+                    item = self._queue.get(timeout=0.5)
                 except queue.Empty:
-                    break
-                if nxt is None:
-                    break
-                batch.append(nxt)
-            batch = self._drop_dead(batch)
-            if batch:
-                self._dispatch(batch)
+                    continue  # re-check _closed: a full queue can eat the
+                    # shutdown sentinel, so the worker must not block
+                    # forever
+                if item is None:
+                    continue
+                batch = [item]
+                # a degraded process (level >= 1) collapses the gather
+                # window to zero: no *waiting* for coalescing — but items
+                # already sitting in the queue still ride along for free
+                # (get_nowait below), otherwise a zero window would force
+                # batch-1 dispatches exactly when the queue is deepest
+                # and throughput matters most
+                wait = self._max_wait * degradation.gather_scale()
+                deadline = time.monotonic() + wait
+                while len(batch) < self._max_batch:
+                    remaining = deadline - time.monotonic()
+                    try:
+                        nxt = (self._queue.get(timeout=remaining)
+                               if remaining > 0
+                               else self._queue.get_nowait())
+                    except queue.Empty:
+                        break
+                    if nxt is None:
+                        break
+                    batch.append(nxt)
+                faults.fire("scheduler.gather")
+                batch = self._drop_dead(batch)
+                if batch:
+                    self._dispatch(batch)
+            except Exception as e:
+                # an unexpected exception escaping the loop used to
+                # strand every queued future forever (the worker died,
+                # nothing resolved them); contain it: fail the gathered
+                # batch and everything still queued with a typed error,
+                # mark the scheduler closed, and tell the owner (a
+                # replica recycles itself)
+                self._worker_crashed(e, batch)
+                return
+
+    def _worker_crashed(self, exc: Exception, batch: list) -> None:
+        log.exception("scheduler worker crashed; failing %d gathered and "
+                      "all queued items", len(batch))
+        self._closed.set()
+        err = SchedulerCrashed(
+            f"scheduler worker crashed: {type(exc).__name__}: {exc}")
+        now = time.monotonic()
+        items = list(batch)
+        while True:
+            try:
+                queued = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if queued is not None:
+                items.append(queued)
+        for item in items:
+            if item.tctx is not None:
+                trace, parent = item.tctx
+                trace.new_span("scheduler-crash", parent=parent,
+                               start=now, end=now,
+                               attrs={"error": str(err)})
+            _try_set_exception(item.future, err)
+        # a pool replica rebuilds itself (breaker trip + drain + probe)
+        report = getattr(self._model, "report_scheduler_fault", None)
+        if report is not None:
+            try:
+                report(err)
+            except Exception:
+                log.exception("scheduler-crash report hook failed")
 
     def _drop_dead(self, batch: list) -> list:
         """Filter expired/cancelled items out of a gathered batch *before*
@@ -316,14 +416,27 @@ class BatchScheduler:
                      "request_ids": [i.tctx[0].request_id for i in traced],
                      **self._trace_attrs}
         err: Optional[Exception] = None
+        audios = None
+        stuck = False
+        timeout = self._dispatch_timeout_s
         try:
             with tracing.dispatch_scope(attrs):
-                # speakers/scales are part of the Model protocol
-                audios = self._model.speak_batch(sentences,
-                                                 speakers=speakers,
-                                                 scales=scales)
+                if timeout and timeout > 0:
+                    audios = self._supervised_call(sentences, speakers,
+                                                   scales, timeout)
+                else:
+                    audios = self._call_model(sentences, speakers, scales)
+        except DispatchStuck as e:
+            err = e
+            stuck = True
         except Exception as e:
             err = e
+        if err is None and len(audios) != len(batch):
+            # a corrupted device result (wrong row count) must fail the
+            # batch loudly, never zip-truncate into wrong-audio answers
+            err = OperationError(
+                f"device dispatch returned {len(audios)} results for "
+                f"{len(batch)} requests (shape corrupted)")
         # record spans BEFORE resolving the futures: the waiting request
         # thread may finish (and export) its trace the instant its future
         # resolves, and the dispatch attribution must already be there
@@ -336,12 +449,117 @@ class BatchScheduler:
                            start=item.t_submit, end=t0)
             trace.new_span("dispatch", parent=parent, start=t0, end=t1,
                            attrs=attrs)
+            if stuck:
+                # the watchdog interval, visible in every affected trace
+                trace.new_span("watchdog", parent=parent, start=t0,
+                               end=t1, attrs={"timeout_s": timeout,
+                                              "error": str(err)})
         if err is not None:
             for fut in futures:
                 _try_set_exception(fut, err)
         else:
             for fut, audio in zip(futures, audios):
                 _try_set_result(fut, audio)
+
+    def _call_model(self, sentences, speakers, scales):
+        """One device call, with the dispatch failpoint for bare models
+        (pool replicas fire it inside the breaker wrapper instead, so
+        injected faults count toward the breaker like real ones)."""
+        action = (faults.fire("dispatch.device_call")
+                  if self._fire_dispatch_failpoint else None)
+        # speakers/scales are part of the Model protocol
+        audios = self._model.speak_batch(sentences, speakers=speakers,
+                                         scales=scales)
+        return faults.corrupt_result(action, audios)
+
+    def _supervised_call(self, sentences, speakers, scales,
+                         timeout: float):
+        """Run the device call under the hung-dispatch watchdog.
+
+        The call runs on the scheduler's long-lived helper thread (with
+        the worker's context copied per call, so dispatch attribution
+        and failpoints behave identically); the worker waits out the
+        wall-clock bound.  On timeout the helper is quarantined — left
+        running, renamed, its eventual result discarded, a replacement
+        built on the next dispatch — and :class:`DispatchStuck` raises
+        so the batch's futures fail typed instead of hanging, the
+        breaker counts the fault, and the pool resubmits.  One helper
+        serves every supervised dispatch: spawning a thread per dispatch
+        would tax the whole hot path (create/start plus allocator churn
+        per coalesced batch) to guard against the rare wedge.
+        """
+        helper = self._dispatch_helper
+        if helper is None or not helper.thread.is_alive():
+            helper = self._dispatch_helper = _DispatchHelper()
+        ctx = contextvars.copy_context()
+        box, done = helper.submit(
+            ctx, lambda: self._call_model(sentences, speakers, scales))
+        if not done.wait(timeout):
+            helper.thread.name = "sonata_dispatch_quarantined"
+            self._dispatch_helper = None
+            helper.retire()  # exits after the wedged call (if ever) ends
+            self._bump("stuck")
+            degradation.note_watchdog()
+            log.error("device dispatch stuck past the %gs watchdog; "
+                      "thread %s quarantined, failing %d request(s)",
+                      timeout, helper.thread.ident, len(sentences))
+            report = getattr(self._model, "report_dispatch_stuck", None)
+            if report is not None:
+                try:
+                    report()
+                except Exception:
+                    log.exception("dispatch-stuck report hook failed")
+            raise DispatchStuck(
+                f"device dispatch exceeded the {timeout:g}s watchdog "
+                f"({DISPATCH_TIMEOUT_ENV}); worker thread quarantined")
+        if "err" in box:
+            raise box["err"]
+        return box["audios"]
+
+
+class _DispatchHelper:
+    """The watchdog path's long-lived device-call thread.
+
+    Each job carries its own context copy, result box, and done event,
+    so a quarantined call's late result lands in a box nobody reads —
+    discarded naturally, exactly like the old thread-per-dispatch
+    design, without paying a thread spawn on every supervised dispatch.
+    Only the scheduler worker submits, one job at a time.
+    """
+
+    __slots__ = ("_jobs", "thread")
+
+    def __init__(self):
+        self._jobs: "queue.SimpleQueue" = queue.SimpleQueue()
+        self.thread = threading.Thread(target=self._loop,
+                                       name="sonata_dispatch",
+                                       daemon=True)
+        self.thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                return
+            ctx, fn, box, done = job
+            try:
+                box["audios"] = ctx.run(fn)
+            except Exception as e:
+                box["err"] = e
+            finally:
+                done.set()
+
+    def submit(self, ctx, fn):
+        box: dict = {}
+        done = threading.Event()
+        self._jobs.put((ctx, fn, box, done))
+        return box, done
+
+    def retire(self) -> None:
+        """Stop the loop once the in-flight job (if any) returns: a
+        quarantined thread that finally unwedges drains this sentinel
+        and exits instead of blocking forever on an abandoned queue."""
+        self._jobs.put(None)
 
 
 def _try_set_result(fut: Future, value) -> None:

@@ -33,7 +33,7 @@ from typing import Iterator, Optional, Union
 
 from ..audio import Audio, AudioSamples, write_wave_samples_to_file
 from ..core import Model, OperationError, Phonemes
-from ..serving import tracing
+from ..serving import faults, tracing
 from .output import AudioOutputConfig
 
 _POOL: Optional[ThreadPoolExecutor] = None
@@ -77,8 +77,10 @@ class SpeechSynthesizer:
     def phonemize_text(self, text: str) -> Phonemes:
         # the one G2P entry point every stream mode and frontend funnels
         # through — a span here covers the whole pipeline's CPU-side text
-        # stage (no-op without an active request trace)
+        # stage (no-op without an active request trace), and the same
+        # choke point carries the text-stage failpoint
         with tracing.span("phonemize") as sp:
+            faults.fire("phonemize")
             phonemes = self.model.phonemize_text(text)
             sp.annotate(sentences=len(getattr(phonemes, "sentences",
                                               phonemes)))

@@ -1,0 +1,34 @@
+"""Seeded violations: failpoint-registry parity (pass 5).
+
+Defines a module-level ``SITES`` tuple (how the pass locates a
+registry), one clean armed site, and two seeded typos; the fixture docs
+add a seeded doc-example typo plus a grammar template that must be
+SKIPPED.  With no ``tests/``/``tools/`` dirs under the fixture root,
+every registered site is also an ``unexercised-site`` finding.
+"""
+
+SITES = ("fx.good", "fx.undocumented")
+
+
+def fire(site):
+    return None
+
+
+def arm(site, mode):
+    return None
+
+
+def arm_spec(spec):
+    return None
+
+
+def hit_known():
+    fire("fx.good")  # clean: registered
+
+
+def hit_typo():
+    fire("fx.typo")  # seeded: not in SITES
+
+
+def arm_spec_typo():
+    arm_spec("fx.spec_typo:error:1")  # seeded: spec site not in SITES

@@ -27,7 +27,13 @@ if str(REPO) not in sys.path:  # `pytest` invoked without `python -m`
     sys.path.insert(0, str(REPO))
 
 from tools.analysis import PASSES, run_all  # noqa: E402
-from tools.analysis import hostsync, knobs, lockorder, metricsdoc  # noqa: E402
+from tools.analysis import (  # noqa: E402
+    failpoints,
+    hostsync,
+    knobs,
+    lockorder,
+    metricsdoc,
+)
 from tools.analysis.core import (  # noqa: E402
     Allowlist,
     AnalysisContext,
@@ -145,6 +151,68 @@ def test_metric_asymmetry_and_doc_drift_detected():
     assert any("sonata_fx_ghost_metric" in d.message for d in ghost)
     # the registered family itself is known → not reported
     assert not any("sonata_fx_leaky" in d.message for d in ghost)
+
+
+# ---------------------------------------------------------------------------
+# pass 5: failpoints
+# ---------------------------------------------------------------------------
+
+def test_failpoint_registry_parity_detected():
+    ctx = fixture_ctx("fx_failpoints.py", docs=["fx_docs.md"])
+    diags = failpoints.run(ctx)
+    unknown = [d for d in diags if d.code == "unknown-site"]
+    # typo'd fire(), typo'd arm_spec() site prefix, typo'd doc example
+    assert any("fx.typo" in d.message
+               and d.file == "fx_failpoints.py" for d in unknown)
+    assert any("fx.spec_typo" in d.message for d in unknown)
+    assert any("fx.doc_typo" in d.message
+               and d.file == "fx_docs.md" for d in unknown)
+    # the registered site and the grammar template are NOT findings
+    assert not any("'fx.good'" in d.message for d in unknown)
+    assert not any("'site'" in d.message for d in unknown), \
+        "grammar template SONATA_FAILPOINTS=site:mode[...] must be skipped"
+    # no tests/tools under the fixture root → every site unexercised
+    unex = [d for d in diags if d.code == "unexercised-site"]
+    assert {s for d in unex for s in ("fx.good", "fx.undocumented")
+            if s in d.message} == {"fx.good", "fx.undocumented"}
+    # fx.undocumented appears nowhere in the fixture docs
+    undoc = [d for d in diags if d.code == "undocumented-site"]
+    assert any("fx.undocumented" in d.message for d in undoc)
+    assert not any("'fx.good'" in d.message for d in undoc)
+
+
+def test_failpoint_pass_ignores_registryless_tree():
+    assert failpoints.run(fixture_ctx("fx_lock_cycle.py")) == []
+
+
+def test_failpoint_exercised_requires_arming_not_substring(tmp_path):
+    # the invariant must not be vacuous for common site names: an
+    # unrelated identifier containing the site ("warmup_and_mark_ready")
+    # or a bare string constant must NOT vouch; a fire/arm/arm_spec
+    # literal or a spec-shaped string (HTTP ?arm=, env value) must
+    (tmp_path / "reg.py").write_text(
+        'SITES = ("warmup", "pool.route", "metrics.scrape", "phonemize")\n',
+        encoding="utf-8")
+    tdir = tmp_path / "tests"
+    tdir.mkdir()
+    (tdir / "test_x.py").write_text(
+        "def warmup_and_mark_ready():\n"
+        "    return 'warmup'\n"
+        "def test_route(arm):\n"
+        "    arm('pool.route', 'error')\n"
+        "def test_scrape(http_get):\n"
+        "    http_get('/debug/failpoints?arm=metrics.scrape:error:1')\n"
+        "def test_env(monkeypatch):\n"
+        "    monkeypatch.setenv('SONATA_FAILPOINTS', 'phonemize:hang')\n",
+        encoding="utf-8")
+    ctx = AnalysisContext.build(tmp_path, code_roots=["reg.py"],
+                                doc_paths=[])
+    unex = {d.message.split("'")[1] for d in failpoints.run(ctx)
+            if d.code == "unexercised-site"}
+    assert "warmup" in unex, "substring/bare-constant hits must not vouch"
+    assert "pool.route" not in unex      # arm() literal
+    assert "metrics.scrape" not in unex  # HTTP ?arm= spec string
+    assert "phonemize" not in unex       # SONATA_FAILPOINTS env value
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,820 @@
+"""Failpoint injection, hung-dispatch watchdog, degradation ladder.
+
+Pins the ISSUE 6 contract:
+
+- the failpoint registry: grammar, deterministic seeding, max_hits,
+  every mode's behavior, single-branch no-op when disarmed;
+- the watchdog: a dispatch that *hangs* (raises nothing) fails its
+  batch's futures with a typed :class:`DispatchStuck` inside the
+  wall-clock bound, quarantines the stuck thread, records a ``watchdog``
+  span, and — through a pool — trips the breaker so the request
+  completes via exactly-once resubmission on a healthy replica;
+- the worker-crash fix: an unexpected exception escaping the scheduler
+  worker loop fails pending/queued futures with
+  :class:`SchedulerCrashed` instead of stranding them forever;
+- probe backoff: a persistently failing replica's probe interval doubles
+  (capped at ``SONATA_REPLICA_PROBE_MAX_S``) instead of storming;
+- the degradation ladder: pressure steps levels up (shrink coalescing →
+  reject batch → readiness off), hysteresis steps them back down.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from sonata_tpu.core import OperationError
+from sonata_tpu.serving import (
+    Deadline,
+    InjectedFault,
+    Overloaded,
+    ServingRuntime,
+    degradation_mod as degradation,
+    faults,
+    parse_prometheus_text,
+    tracing,
+)
+from sonata_tpu.serving.degradation import DegradationLadder
+from sonata_tpu.serving.replicas import HALF_OPEN, OPEN, ReplicaPool
+from sonata_tpu.synth import BatchScheduler, DispatchStuck, SchedulerCrashed
+from sonata_tpu.testing import FakeModel
+
+SCHED = {"max_batch": 1, "max_wait_ms": 0.0}
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    """Every test starts and ends with nothing armed (and any thread a
+    hang-mode test left blocked gets released)."""
+    faults.registry().disarm_all()
+    yield
+    faults.registry().disarm_all()
+
+
+class BlockingModel(FakeModel):
+    """speak_batch blocks until released — the wedged-chip stand-in."""
+
+    def __init__(self):
+        super().__init__()
+        self.gate = threading.Event()
+
+    def speak_batch(self, *args, **kwargs):
+        assert self.gate.wait(timeout=30), "test forgot to release gate"
+        return super().speak_batch(*args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_arm_spec_grammar_roundtrip():
+    reg = faults.registry()
+    reg.arm_spec("phonemize:error:0.5:250:3")
+    snap = reg.snapshot()["armed"]["phonemize"]
+    assert snap == {"mode": "error", "rate": 0.5, "latency_ms": 250.0,
+                    "max_hits": 3, "hits": 0, "fires": 0, "spent": False}
+    reg.arm_spec("warmup:slow")  # rate/latency/hits all optional
+    assert reg.snapshot()["armed"]["warmup"]["rate"] == 1.0
+
+
+@pytest.mark.parametrize("spec", [
+    "nonsense",                      # no mode
+    "not.a.site:error",              # unknown site
+    "phonemize:explode",             # unknown mode
+    "phonemize:error:lots",          # non-numeric rate
+    "phonemize:error:1:0:2:extra",   # too many fields
+])
+def test_arm_spec_rejects_bad_input(spec):
+    with pytest.raises(ValueError):
+        faults.registry().arm_spec(spec)
+
+
+def test_env_arming(monkeypatch):
+    monkeypatch.setenv(faults.FAILPOINTS_ENV,
+                       "phonemize:error:1, warmup:slow:0.5:10")
+    reg = faults.FailpointRegistry()
+    assert reg.arm_from_env() == 2
+
+
+def test_disarmed_fire_is_noop_and_cheap():
+    assert faults.fire("phonemize") is None
+    # the acceptance bar: disarmed, fire() is one module-bool branch —
+    # a generous ceiling that still catches an accidental lock or dict
+    # walk on the hot path
+    n = 50_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        faults.fire("dispatch.device_call")
+    per_call_us = (time.perf_counter() - t0) / n * 1e6
+    assert per_call_us < 10.0, f"{per_call_us:.2f}us per disarmed fire"
+
+
+def test_deterministic_seeding_replays_exactly():
+    a = faults.FailpointRegistry(seed=7)
+    b = faults.FailpointRegistry(seed=7)
+    c = faults.FailpointRegistry(seed=8)
+    for reg in (a, b, c):
+        reg.arm("phonemize", "corrupt-shape", rate=0.5)
+    pattern = [[reg.fire("phonemize") is not None for _ in range(64)]
+               for reg in (a, b, c)]
+    assert pattern[0] == pattern[1]          # same seed → same schedule
+    assert pattern[0] != pattern[2]          # seed changes the schedule
+    assert 5 < sum(pattern[0]) < 59          # rate is actually partial
+
+
+def test_max_hits_spends_the_arm():
+    reg = faults.registry()
+    reg.arm("phonemize", "error", max_hits=2)
+    for _ in range(2):
+        with pytest.raises(InjectedFault):
+            faults.fire("phonemize")
+    assert faults.fire("phonemize") is None  # spent
+    assert reg.snapshot()["armed"]["phonemize"]["spent"] is True
+    assert reg.fires_total("phonemize") >= 2
+
+
+def test_slow_mode_delays():
+    faults.registry().arm("phonemize", "slow", latency_ms=60)
+    t0 = time.monotonic()
+    assert faults.fire("phonemize") is None
+    assert time.monotonic() - t0 >= 0.05
+
+
+def test_hang_mode_blocks_until_disarm():
+    faults.registry().arm("phonemize", "hang", max_hits=1)
+    released = threading.Event()
+
+    def hit():
+        faults.fire("phonemize")   # blocks until disarm_all
+        released.set()
+
+    t = threading.Thread(target=hit, daemon=True)
+    t.start()
+    assert not released.wait(0.15), "hang mode returned immediately"
+    faults.registry().disarm_all()
+    assert released.wait(5.0), "disarm_all did not release the hang"
+
+
+def test_single_site_disarm_releases_only_that_sites_hang():
+    """Review-pass pin: ``disarm(site)`` must free threads hung at that
+    site (not strand them until the cap) while hangs armed at OTHER
+    sites keep blocking."""
+    reg = faults.registry()
+    reg.arm("phonemize", "hang", max_hits=1)
+    reg.arm("warmup", "hang", max_hits=1)
+    released = {"phonemize": threading.Event(),
+                "warmup": threading.Event()}
+
+    def hit(site):
+        faults.fire(site)
+        released[site].set()
+
+    threads = [threading.Thread(target=hit, args=(s,), daemon=True)
+               for s in released]
+    for t in threads:
+        t.start()
+    assert not released["phonemize"].wait(0.15), "hang returned early"
+    reg.disarm("phonemize")
+    assert released["phonemize"].wait(5.0), \
+        "disarm(site) did not release that site's hang"
+    assert not released["warmup"].wait(0.15), \
+        "disarm(site) released a hang armed at a DIFFERENT site"
+    reg.disarm_all()
+    assert released["warmup"].wait(5.0)
+
+
+def test_rearm_releases_replaced_arms_hang():
+    reg = faults.registry()
+    reg.arm("phonemize", "hang", max_hits=1)
+    released = threading.Event()
+
+    def hit():
+        faults.fire("phonemize")
+        released.set()
+
+    t = threading.Thread(target=hit, daemon=True)
+    t.start()
+    assert not released.wait(0.15)
+    # replacing the arm (here: downgrading hang -> slow) must not strand
+    # threads hung on the OLD arm until its cap
+    reg.arm("phonemize", "slow", latency_ms=1)
+    assert released.wait(5.0), "re-arm did not release the old hang"
+
+
+def test_hang_cap_raises_instead_of_leaking():
+    faults.registry().arm("phonemize", "hang", latency_ms=40)
+    with pytest.raises(InjectedFault, match="cap"):
+        faults.fire("phonemize")
+
+
+def test_hang_cap_zero_is_immediate_not_default():
+    """Review-pass pin: an explicit latency_ms=0 means an
+    immediately-expiring hang, not the 600 s default cap (truthiness
+    bug — `slow` and `hang` must read the field the same way)."""
+    faults.registry().arm("phonemize", "hang", latency_ms=0)
+    t0 = time.monotonic()
+    with pytest.raises(InjectedFault, match="cap"):
+        faults.fire("phonemize")
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_fire_records_failpoint_span_in_active_trace():
+    tracer = tracing.Tracer(enabled=True)
+    faults.registry().arm("phonemize", "error", max_hits=1)
+    with pytest.raises(InjectedFault):
+        with tracer.trace_request("req") as trace:
+            faults.fire("phonemize")
+    spans = {s.name: s for s in trace.spans_snapshot()}
+    assert "failpoint" in spans
+    assert spans["failpoint"].attrs["site"] == "phonemize"
+    assert spans["failpoint"].attrs["mode"] == "error"
+    assert "InjectedFault" in spans["failpoint"].attrs["error"]
+
+
+# ---------------------------------------------------------------------------
+# hung-dispatch watchdog (standalone scheduler)
+# ---------------------------------------------------------------------------
+
+def test_watchdog_fails_stuck_dispatch_typed():
+    model = BlockingModel()
+    sched = BatchScheduler(model, dispatch_timeout_s=0.2, **SCHED)
+    try:
+        t0 = time.monotonic()
+        fut = sched.submit("stuck sentence")
+        with pytest.raises(DispatchStuck):
+            fut.result(timeout=10.0)
+        # the future failed at the watchdog bound, not at some queue or
+        # result timeout far beyond it
+        assert time.monotonic() - t0 < 5.0
+        assert sched.stats["stuck"] == 1
+    finally:
+        model.gate.set()
+        sched.shutdown()
+
+
+def test_watchdog_records_span_and_discards_late_result():
+    model = BlockingModel()
+    sched = BatchScheduler(model, dispatch_timeout_s=0.15, **SCHED)
+    tracer = tracing.Tracer(enabled=True)
+    try:
+        with tracer.trace_request("req") as trace:
+            fut = sched.submit("will hang")
+            with pytest.raises(DispatchStuck):
+                fut.result(timeout=10.0)
+        names = trace.span_names()
+        assert "watchdog" in names and "dispatch" in names
+        watchdog = next(s for s in trace.spans_snapshot()
+                        if s.name == "watchdog")
+        assert watchdog.attrs["timeout_s"] == 0.15
+        # release the quarantined thread: its late result must be
+        # discarded silently (the future already holds DispatchStuck)
+        model.gate.set()
+        time.sleep(0.1)
+        with pytest.raises(DispatchStuck):
+            fut.result(timeout=1.0)
+    finally:
+        model.gate.set()
+        sched.shutdown()
+
+
+def test_watchdog_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("SONATA_DISPATCH_TIMEOUT_S", raising=False)
+    sched = BatchScheduler(FakeModel(), **SCHED)
+    try:
+        assert sched._dispatch_timeout_s == 0.0
+        # and a normal dispatch still works with the watchdog armed
+        sched.set_dispatch_timeout(5.0)
+        assert len(sched.speak("hello there", timeout=10.0).samples) > 0
+    finally:
+        sched.shutdown()
+
+
+def test_watchdog_env_knob(monkeypatch):
+    monkeypatch.setenv("SONATA_DISPATCH_TIMEOUT_S", "2.5")
+    sched = BatchScheduler(FakeModel(), **SCHED)
+    try:
+        assert sched._dispatch_timeout_s == 2.5
+    finally:
+        sched.shutdown()
+
+
+def test_corrupt_shape_fails_batch_loudly():
+    faults.registry().arm("dispatch.device_call", "corrupt-shape",
+                          max_hits=1)
+    sched = BatchScheduler(FakeModel(), **SCHED)
+    try:
+        fut = sched.submit("corrupt me")
+        with pytest.raises(OperationError, match="shape corrupted"):
+            fut.result(timeout=10.0)
+        # the spent arm lets the next request through unharmed
+        assert len(sched.speak("clean now", timeout=10.0).samples) > 0
+    finally:
+        sched.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# worker-crash containment (satellite regression pin)
+# ---------------------------------------------------------------------------
+
+def test_worker_crash_fails_queued_futures_typed():
+    """Regression pin: an unexpected exception escaping the worker loop
+    used to strand every queued future forever."""
+    faults.registry().arm("scheduler.gather", "error", max_hits=1)
+    model = BlockingModel()
+    model.gate.set()
+    sched = BatchScheduler(model, **SCHED)
+    try:
+        fut = sched.submit("doomed by the crash")
+        with pytest.raises(SchedulerCrashed):
+            fut.result(timeout=10.0)
+        # the scheduler marked itself closed: nothing can hang on it now
+        with pytest.raises(OperationError, match="shut down"):
+            sched.submit("after the crash")
+    finally:
+        sched.shutdown()
+
+
+def test_worker_crash_drains_whole_queue():
+    faults.registry().arm("scheduler.gather", "error", max_hits=1)
+    model = BlockingModel()  # gate closed: first dispatch never starts
+    sched = BatchScheduler(model, max_batch=1, max_wait_ms=0.0,
+                           max_queue=16)
+    try:
+        futures = [sched.submit(f"q{i}") for i in range(4)]
+        model.gate.set()
+        for fut in futures:
+            with pytest.raises((SchedulerCrashed, DispatchStuck,
+                                OperationError)):
+                fut.result(timeout=10.0)
+        assert all(f.done() for f in futures)
+    finally:
+        model.gate.set()
+        sched.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# pool integration: stuck dispatch → breaker trip → exactly-once resubmit
+# ---------------------------------------------------------------------------
+
+def test_stuck_dispatch_trips_breaker_and_resubmits_exactly_once():
+    """The acceptance scenario: a hang-mode dispatch on one replica
+    opens its breaker and the request completes via resubmission on a
+    healthy replica — the client never sees the wedge."""
+    blocked, healthy = BlockingModel(), FakeModel()
+    pool = ReplicaPool(
+        [blocked, healthy], probe_interval_s=60,
+        scheduler_kwargs={**SCHED, "dispatch_timeout_s": 0.2})
+    try:
+        fut = pool.submit("ride the wedged chip")
+        audio = fut.result(timeout=15.0)
+        assert len(audio.samples) > 0            # served despite the hang
+        assert pool.replicas[0].state == OPEN    # wedged replica recycled
+        assert pool.stats["resubmitted"] == 1    # exactly once
+        assert pool.stats["failed"] == 0
+        assert pool.replicas[0].resubmits == 1
+        assert pool.stats_view()["stuck"] >= 1
+        assert pool.healthy_count() == 1
+    finally:
+        blocked.gate.set()
+        pool.shutdown()
+
+
+def test_late_quarantined_result_cannot_close_half_open_breaker():
+    """Review-pass pin: a watchdog-quarantined dispatch thread that
+    completes late carries a stale breaker generation — its success must
+    not close a HALF_OPEN breaker (no trial ran), and its failure must
+    not re-count the already-accounted wedge."""
+    blocked, healthy = BlockingModel(), FakeModel()
+    pool = ReplicaPool(
+        [blocked, healthy], probe_interval_s=0.05,
+        scheduler_kwargs={**SCHED, "dispatch_timeout_s": 0.2})
+    try:
+        audio = pool.submit("wedge then linger").result(timeout=15.0)
+        assert len(audio.samples) > 0          # resubmitted and served
+        deadline = time.monotonic() + 10.0
+        while (pool.replicas[0].state != HALF_OPEN
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert pool.replicas[0].state == HALF_OPEN
+        recovered = pool.stats["recovered"]
+        opens = pool.stats["breaker_opens"]
+        blocked.gate.set()                     # quarantined thread returns
+        time.sleep(0.4)
+        assert pool.replicas[0].state == HALF_OPEN, \
+            "late quarantined success closed the breaker without a trial"
+        assert pool.stats["recovered"] == recovered
+        assert pool.stats["breaker_opens"] == opens
+        # a REAL trial still closes it (the generation guard only drops
+        # stale taps, never live ones)
+        assert len(pool.speak("real trial", timeout=10.0).samples) > 0
+        deadline = time.monotonic() + 5.0
+        while (pool.stats["recovered"] == recovered
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert pool.stats["recovered"] == recovered + 1
+    finally:
+        blocked.gate.set()
+        pool.shutdown()
+
+
+def test_recycle_on_already_open_replica_does_not_recount():
+    """Review-pass pin: a second wedge conviction landing on an
+    already-OPEN replica (a second in-flight dispatch convicted while
+    the drain is in flight) must not re-bump the failure counters — the
+    trip that opened the breaker accounted the wedge, exactly like
+    _on_dispatch's generation guard drops the late tap."""
+    pool = ReplicaPool([FakeModel(), FakeModel()], probe_interval_s=60,
+                       scheduler_kwargs=SCHED)
+    try:
+        replica = pool.replicas[0]
+        pool._recycle_replica(replica, "first conviction")
+        assert replica.state == OPEN
+        assert replica.dispatch_failures == 1
+        assert replica.consecutive_failures == 1
+        opens = pool.stats["breaker_opens"]
+        pool._recycle_replica(replica, "second conviction, mid-drain")
+        assert replica.dispatch_failures == 1    # not re-counted
+        assert replica.consecutive_failures == 1
+        assert pool.stats["breaker_opens"] == opens
+    finally:
+        pool.shutdown()
+
+
+def test_route_failpoint_fails_request_without_crashing_pool():
+    faults.registry().arm("pool.route", "error", max_hits=1)
+    pool = ReplicaPool([FakeModel()], scheduler_kwargs=SCHED)
+    try:
+        with pytest.raises(InjectedFault):
+            pool.speak("routed into the fault", timeout=10.0)
+        assert pool.stats["failed"] == 1
+        # the spent arm lets the pool serve normally again
+        assert len(pool.speak("routed fine", timeout=10.0).samples) > 0
+    finally:
+        pool.shutdown()
+
+
+def test_scheduler_crash_recycles_replica():
+    faults.registry().arm("scheduler.gather", "error", max_hits=1)
+    pool = ReplicaPool([FakeModel(), FakeModel()], probe_interval_s=60,
+                       scheduler_kwargs=SCHED)
+    try:
+        audio = pool.speak("crash one worker", timeout=15.0)
+        assert len(audio.samples) > 0            # resubmitted and served
+        assert sum(1 for r in pool.replicas if r.state == OPEN) == 1
+        assert pool.stats["resubmitted"] == 1
+    finally:
+        pool.shutdown()
+
+
+def test_probe_rebuild_failure_keeps_probe_loop_alive():
+    """Review-pass pin: a scheduler rebuild that raises against a
+    still-sick device (the dispatch-policy probe runs inside
+    construction) must not kill the probe loop — it is the pool's only
+    path back from OPEN.  The replica stays OPEN with escalated backoff
+    and recovers once construction succeeds."""
+    faults.registry().arm("scheduler.gather", "error", max_hits=1)
+    # interval 1s: long enough that the monkeypatch below lands before
+    # the first natural probe, short enough that probe_max (>= 60s
+    # default) leaves the escalation headroom the test asserts on
+    pool = ReplicaPool([FakeModel(), FakeModel()], probe_interval_s=1.0,
+                       scheduler_kwargs=SCHED)
+    try:
+        pool.speak("crash one worker", timeout=15.0)
+        tripped = next(r for r in pool.replicas if r.state == OPEN)
+        real_new = tripped._new_scheduler
+        fails = [1]
+
+        def flaky_new():
+            if fails[0]:
+                fails[0] -= 1
+                raise RuntimeError("rebuild against a wedged device")
+            return real_new()
+
+        tripped._new_scheduler = flaky_new
+        backoff_before = tripped.probe_backoff_s
+
+        def force_probe():
+            with pool._lock:
+                tripped.next_probe_at = time.monotonic() - 0.01
+            pool._probe_wake.set()
+
+        force_probe()
+        deadline = time.monotonic() + 5.0
+        while fails[0] and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fails[0] == 0, "probe loop never attempted the rebuild"
+        time.sleep(0.2)
+        assert tripped.state == OPEN, \
+            "failed rebuild must leave the replica OPEN (retry later)"
+        assert pool._prober.is_alive(), \
+            "failed rebuild killed the probe loop"
+        assert tripped.probe_backoff_s > backoff_before, \
+            "failed rebuild must escalate the probe backoff"
+        force_probe()
+        deadline = time.monotonic() + 5.0
+        while tripped.state == OPEN and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert tripped.state == HALF_OPEN, \
+            "replica never recovered after the rebuild started working"
+    finally:
+        pool.shutdown()
+
+
+def test_set_dispatch_timeout_reaches_every_replica():
+    pool = ReplicaPool([FakeModel(), FakeModel()], scheduler_kwargs=SCHED)
+    try:
+        pool.set_dispatch_timeout(1.5)
+        assert all(r.scheduler._dispatch_timeout_s == 1.5
+                   for r in pool.replicas)
+        # rebuilt schedulers (probe recycling) inherit the new bound
+        assert all(r._scheduler_kwargs["dispatch_timeout_s"] == 1.5
+                   for r in pool.replicas)
+    finally:
+        pool.shutdown()
+
+
+def test_set_dispatch_timeout_none_survives_rebuild(monkeypatch):
+    # disabling via None must persist across a probe rebuild: a raw None
+    # kwarg would send BatchScheduler.__init__ back to the env knob and
+    # silently re-arm the watchdog the operator turned off
+    monkeypatch.setenv("SONATA_DISPATCH_TIMEOUT_S", "2.0")
+    pool = ReplicaPool([FakeModel()], scheduler_kwargs=SCHED)
+    try:
+        assert pool.replicas[0].scheduler._dispatch_timeout_s == 2.0
+        pool.set_dispatch_timeout(None)
+        rebuilt = pool.replicas[0]._new_scheduler()
+        try:
+            assert rebuilt._dispatch_timeout_s == 0.0
+        finally:
+            rebuilt.shutdown()
+    finally:
+        pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# probe backoff (satellite)
+# ---------------------------------------------------------------------------
+
+class FlakyModel(FakeModel):
+    def __init__(self):
+        super().__init__()
+        self.fail = False
+
+    def speak_batch(self, *args, **kwargs):
+        if self.fail:
+            raise RuntimeError("injected dispatch failure")
+        return super().speak_batch(*args, **kwargs)
+
+
+def test_probe_backoff_doubles_and_caps():
+    models = [FlakyModel(), FlakyModel()]
+    pool = ReplicaPool(models, breaker_threshold=1, probe_interval_s=0.05,
+                       probe_max_s=0.2, scheduler_kwargs=SCHED)
+    try:
+        models[0].fail = True
+        with pytest.raises(RuntimeError):
+            pool.replicas[0].scheduler.speak("trip it", timeout=10.0)
+        r0 = pool.replicas[0]
+        assert r0.state == OPEN
+        assert r0.probe_backoff_s == 0.05      # fresh trip: base interval
+        seen = []
+        deadline = time.monotonic() + 20.0
+        # each failed half-open trial doubles the backoff until the cap
+        while len(seen) < 4 and time.monotonic() < deadline:
+            if r0.state == HALF_OPEN:
+                try:
+                    pool.speak("trial", timeout=10.0)
+                except Exception:
+                    pass
+                with pool._lock:
+                    if r0.state == OPEN:
+                        seen.append(r0.probe_backoff_s)
+            time.sleep(0.01)
+        assert seen[:3] == [0.1, 0.2, 0.2], seen  # x2, then capped
+        # recovery resets the backoff for the next incident
+        models[0].fail = False
+        deadline = time.monotonic() + 20.0
+        while r0.state != HALF_OPEN and time.monotonic() < deadline:
+            time.sleep(0.01)
+        pool.speak("healing trial", timeout=10.0)
+        assert r0.state not in (OPEN,)
+        assert r0.probe_backoff_s is None
+    finally:
+        pool.shutdown()
+
+
+def test_probe_max_never_clips_a_longer_base(monkeypatch):
+    """The CI smoke pins SONATA_REPLICA_PROBE_INTERVAL_S=600; the default
+    backoff cap (60) must not shorten it."""
+    monkeypatch.delenv("SONATA_REPLICA_PROBE_MAX_S", raising=False)
+    pool = ReplicaPool([FakeModel()], probe_interval_s=600,
+                       scheduler_kwargs=SCHED)
+    try:
+        assert pool.probe_max_s == 600
+    finally:
+        pool.shutdown()
+
+
+def test_probe_max_env(monkeypatch):
+    monkeypatch.setenv("SONATA_REPLICA_PROBE_MAX_S", "17.5")
+    pool = ReplicaPool([FakeModel()], probe_interval_s=1.0,
+                       scheduler_kwargs=SCHED)
+    try:
+        assert pool.probe_max_s == 17.5
+    finally:
+        pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder
+# ---------------------------------------------------------------------------
+
+def _ladder(**kw):
+    kw.setdefault("window_s", 5.0)
+    kw.setdefault("shed_threshold", 3)
+    kw.setdefault("watchdog_threshold", 2)
+    kw.setdefault("recover_s", 0.15)
+    return DegradationLadder(**kw)
+
+
+def test_ladder_steps_up_on_sustained_shedding():
+    ladder = _ladder(recover_s=60)
+    for _ in range(2):
+        ladder.record_shed()
+    assert ladder.current_level() == 0      # below threshold
+    ladder.record_shed()
+    assert ladder.current_level() == 1      # window filled → one step
+    # the window restarts per step: one more shed is not enough for 2
+    ladder.record_shed()
+    assert ladder.current_level() == 1
+    for _ in range(2):
+        ladder.record_shed()
+    assert ladder.current_level() == 2
+    for _ in range(3):
+        ladder.record_shed()
+    assert ladder.current_level() == 3
+    for _ in range(3):
+        ladder.record_shed()
+    assert ladder.current_level() == 3      # capped at readiness-off
+
+
+def test_ladder_watchdog_trigger_and_snapshot():
+    ladder = _ladder(recover_s=60)
+    ladder.record_watchdog()
+    assert ladder.current_level() == 0
+    ladder.record_watchdog()
+    assert ladder.current_level() == 1
+    snap = ladder.snapshot()
+    assert snap["name"] == "shrink-coalesce"
+    assert snap["peak_level"] == 1 and snap["transitions"] == 1
+
+
+def test_ladder_recovers_one_level_per_quiet_period():
+    ladder = _ladder()
+    for _ in range(6):
+        ladder.record_shed()
+    assert ladder.current_level() == 2
+    deadline = time.monotonic() + 10.0
+    while ladder.current_level() > 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert ladder.current_level() == 0
+    # hysteresis: it took at least one quiet period per level
+    assert ladder.snapshot()["transitions"] == 4
+
+
+def test_gather_scale_consults_installed_ladder():
+    ladder = _ladder(recover_s=60)
+    degradation.install(ladder)
+    try:
+        assert degradation.gather_scale() == 1.0
+        for _ in range(3):
+            ladder.record_shed()
+        assert ladder.current_level() == 1
+        assert degradation.gather_scale() == 0.0
+        assert ladder.reject_heavy() is False   # level 2 is the batch bar
+        for _ in range(3):
+            ladder.record_shed()
+        assert ladder.reject_heavy() is True
+    finally:
+        degradation.uninstall(ladder)
+    assert degradation.gather_scale() == 1.0    # uninstalled → neutral
+
+
+def test_runtime_wires_ladder_gauge_gate_and_admission(monkeypatch):
+    monkeypatch.setenv("SONATA_DEGRADE_SHED_THRESHOLD", "2")
+    monkeypatch.setenv("SONATA_DEGRADE_WINDOW_S", "30")
+    monkeypatch.setenv("SONATA_DEGRADE_RECOVER_S", "600")
+    rt = ServingRuntime(max_in_flight=1, max_queue_depth=0)
+    try:
+        rt.health.set_ready("warmed")
+        assert rt.health.ready
+        # six admission sheds: 2 per step with the window restarting →
+        # the ladder climbs to readiness-off through the real shed path
+        with rt.admission.admit():
+            for _ in range(6):
+                assert not rt.admission.try_acquire()
+        assert rt.degradation.current_level() == 3
+        assert not rt.health.ready              # gate flipped /readyz
+        assert "degradation" in rt.health.reason
+        parsed = parse_prometheus_text(rt.registry.render())
+        assert parsed["sonata_degradation_level"][0][1] == 3.0
+    finally:
+        rt.close()
+
+
+def test_grpc_rejects_batch_work_when_degraded(tmp_path):
+    pytest.importorskip("grpc")
+    import grpc
+
+    from sonata_tpu.frontends import grpc_messages as pb
+    from sonata_tpu.frontends import grpc_server as srv
+
+    from voices import write_tiny_voice
+
+    class _AbortCalled(Exception):
+        def __init__(self, code, msg):
+            self.code, self.msg = code, msg
+            super().__init__(f"{code}: {msg}")
+
+    class _Ctx:
+        def time_remaining(self):
+            return None
+
+        def add_callback(self, cb):
+            pass
+
+        def abort(self, code, msg):
+            raise _AbortCalled(code, msg)
+
+    cfg = str(write_tiny_voice(tmp_path))
+    rt = ServingRuntime(request_timeout_s=60.0)
+    service = srv.SonataGrpcService(runtime=rt)
+    try:
+        info = service.LoadVoice(pb.VoicePath(config_path=cfg), _Ctx())
+        # force level 2 through the ladder's real event path
+        for _ in range(rt.degradation.shed_threshold * 2):
+            rt.degradation.record_shed()
+        assert rt.degradation.current_level() >= 2
+        with pytest.raises(_AbortCalled) as exc:
+            list(service.SynthesizeUtterance(
+                pb.Utterance(voice_id=info.voice_id, text="Batch work.",
+                             synthesis_mode=pb.SynthesisMode.BATCHED),
+                _Ctx()))
+        assert exc.value.code == grpc.StatusCode.RESOURCE_EXHAUSTED
+        # interactive (lazy-mode) synthesis still serves at level 2
+        results = list(service.SynthesizeUtterance(
+            pb.Utterance(voice_id=info.voice_id, text="Interactive."),
+            _Ctx()))
+        assert results and len(results[0].wav_samples) > 0
+    finally:
+        service.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# /debug/failpoints + metrics.scrape over the HTTP plane
+# ---------------------------------------------------------------------------
+
+def test_debug_failpoints_endpoint_and_scrape_fault(monkeypatch):
+    import json
+    import urllib.error
+    import urllib.request
+
+    rt = ServingRuntime()
+    port = rt.start_http(0)
+    base = f"http://127.0.0.1:{port}"
+
+    def get(url):
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                return resp.getcode(), resp.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    try:
+        code, body = get(base + "/debug/failpoints")
+        assert code == 200
+        assert set(json.loads(body)["sites"]) == set(faults.SITES)
+        # arming is opt-in: without SONATA_FAILPOINTS in the env (or the
+        # programmatic switch) a metrics port must refuse to inject
+        monkeypatch.delenv(faults.FAILPOINTS_ENV, raising=False)
+        monkeypatch.setattr(faults, "_HTTP_ARMING", False)
+        code, body = get(base + "/debug/failpoints"
+                                "?arm=metrics.scrape:error:1::2")
+        assert code == 403 and "not enabled" in body
+        monkeypatch.setattr(faults, "_HTTP_ARMING", True)
+        code, body = get(base + "/debug/failpoints"
+                                "?arm=metrics.scrape:error:1::2")
+        assert code == 200
+        assert json.loads(body)["armed"]["metrics.scrape"]["max_hits"] == 2
+        code, body = get(base + "/metrics")
+        assert code == 503 and "injected fault" in body
+        code, _ = get(base + "/debug/failpoints?disarm=all")
+        assert code == 200
+        code, _ = get(base + "/metrics")
+        assert code == 200
+        code, body = get(base + "/debug/failpoints?arm=bogus:error")
+        assert code == 400 and "unknown failpoint site" in body
+    finally:
+        rt.close()

@@ -520,3 +520,120 @@ def test_unload_voice(server_and_voice, tmp_path):
     info2 = _unary(channel, "LoadVoice", pb.VoicePath(config_path=cfg),
                    pb.VoiceInfo)
     assert info2.voice_id == info.voice_id
+
+
+# ---------------------------------------------------------------------------
+# client disconnect mid-stream (ISSUE 6 satellite): on BOTH synthesis
+# RPCs a hung-up client must stop the producer, cancel queued futures,
+# and leak no threads (the conftest thread-hygiene fixture asserts the
+# last part on every test here)
+# ---------------------------------------------------------------------------
+
+def test_disconnect_mid_stream_cancels_scheduler_futures(
+        tmp_path_factory):
+    """SynthesizeUtterance (continuous-batching path): closing the
+    response generator with sentences still queued cancels them — the
+    later sentences never reach a device dispatch."""
+    import threading
+    import time as _time
+
+    from sonata_tpu.frontends import grpc_server as srv
+
+    cfg = str(write_tiny_voice(tmp_path_factory.mktemp("disc_batch")))
+    service = srv.SonataGrpcService(continuous_batching=True)
+
+    class Ctx:
+        def abort(self, code, msg):
+            raise RuntimeError(f"{code.name}: {msg}")
+
+    info = service.LoadVoice(pb.VoicePath(config_path=cfg), Ctx())
+    v = service._voices[info.voice_id]
+    real = v.voice.speak_batch
+    release = threading.Event()
+    calls = []
+
+    def gated(sentences, speakers=None, scales=None):
+        calls.append(list(sentences))
+        if len(calls) > 1:  # first dispatch fast, the rest block
+            release.wait(10.0)
+        return real(sentences, speakers=speakers, scales=scales)
+
+    v.voice.speak_batch = gated
+    try:
+        gen = service.SynthesizeUtterance(
+            pb.Utterance(voice_id=info.voice_id,
+                         text="One here. Two here. Three here."), Ctx())
+        first = next(gen)          # sentence 1 served
+        assert len(first.wav_samples) > 0
+        # client hangs up: grpc closes the response generator
+        gen.close()
+        release.set()
+        # the worker finishes the in-flight dispatch, then must DROP the
+        # remaining queued sentence instead of synthesizing it
+        deadline = _time.monotonic() + 10.0
+        while (v.scheduler.stats["cancelled"] < 1
+               and _time.monotonic() < deadline):
+            _time.sleep(0.01)
+        assert v.scheduler.stats["cancelled"] >= 1
+        assert len(calls) <= 2     # sentence 3 never dispatched
+        assert all("Three" not in " ".join(c) for c in calls[2:])
+        # the admission slot was released by the generator teardown
+        assert service.runtime.admission.in_flight == 0
+    finally:
+        release.set()
+        v.voice.speak_batch = real
+        service.shutdown()
+
+
+def test_disconnect_mid_stream_stops_realtime_producer(tmp_path_factory):
+    """SynthesizeUtteranceRealtime: closing the response generator
+    cancels the producer thread — chunk production stops instead of
+    filling a queue nobody drains."""
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from sonata_tpu.audio import Audio, AudioSamples
+    from sonata_tpu.frontends import grpc_server as srv
+
+    cfg = str(write_tiny_voice(tmp_path_factory.mktemp("disc_rt")))
+    service = srv.SonataGrpcService()
+
+    class Ctx:
+        def abort(self, code, msg):
+            raise RuntimeError(f"{code.name}: {msg}")
+
+    info = service.LoadVoice(pb.VoicePath(config_path=cfg), Ctx())
+    v = service._voices[info.voice_id]
+    produced = []
+    info_audio = v.voice.audio_output_info()
+
+    def endless_stream(phonemes, chunk_size, chunk_padding):
+        # a pathological voice that would stream forever: only the
+        # producer's cancel flag can stop it
+        while True:
+            produced.append(_time.monotonic())
+            yield Audio(AudioSamples(np.zeros(64, dtype=np.float32)),
+                        info_audio, inference_ms=0.1)
+            _time.sleep(0.005)
+
+    v.voice.stream_synthesis = endless_stream
+    try:
+        gen = service.SynthesizeUtteranceRealtime(
+            pb.Utterance(voice_id=info.voice_id, text="Stream on."),
+            Ctx())
+        for _ in range(3):
+            next(gen)              # a few chunks flow
+        gen.close()                # client disconnects
+        # producer must stop: after a settle, the chunk count no longer
+        # advances (the queue it fills is unbounded — only the cancel
+        # flag stops it)
+        _time.sleep(0.1)
+        count_after_close = len(produced)
+        _time.sleep(0.25)
+        assert len(produced) <= count_after_close + 1, \
+            "producer kept streaming after client disconnect"
+        assert service.runtime.admission.in_flight == 0
+    finally:
+        service.shutdown()

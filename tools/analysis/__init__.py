@@ -1,12 +1,14 @@
 """sonata-lint: first-party static analysis for the serving stack.
 
-Four passes over the repo's own invariants, runnable as a blocking CI
+Five passes over the repo's own invariants, runnable as a blocking CI
 lane (``python -m tools.analysis``) and importable for tests:
 
 1. ``lockorder``  — lock-order cycles + blocking calls under held locks
 2. ``hostsync``   — device syncs / retrace hazards in & around jitted code
 3. ``knobs``      — SONATA_* env knob ↔ operator-doc parity
 4. ``metricsdoc`` — metric-name doc parity + register/unregister symmetry
+5. ``failpoints`` — failpoint-registry parity: armed names exist, every
+   registered site is exercised by a test and documented
 
 See docs/ANALYSIS.md for the pass contracts and the allowlist format.
 """
@@ -15,7 +17,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
-from . import hostsync, knobs, lockorder, metricsdoc
+from . import failpoints, hostsync, knobs, lockorder, metricsdoc
 from .core import (
     AnalysisContext,
     Allowlist,
@@ -23,7 +25,7 @@ from .core import (
     render_report,
 )
 
-PASSES = (lockorder, hostsync, knobs, metricsdoc)
+PASSES = (lockorder, hostsync, knobs, metricsdoc, failpoints)
 
 __all__ = [
     "AnalysisContext",

@@ -1,0 +1,190 @@
+"""Pass 5: failpoint-registry parity — armed names exist, sites are tested.
+
+The failpoint subsystem (``sonata_tpu/serving/faults.py``) is only as
+trustworthy as its registry: a ``fire("dispatch.device_cal")`` typo is a
+chaos hook that silently never fires, and a registered site no test ever
+arms is a fault path the chaos lane silently stopped covering.  Three
+invariants:
+
+- **armed → registered**: every failpoint name armed or fired anywhere —
+  ``fire("...")`` / ``arm("...")`` calls in ``sonata_tpu`` *and* in
+  ``tests/`` + ``tools/`` (scanned here even though the other passes
+  don't look at them), ``arm_spec("site:mode...")`` strings, and concrete
+  ``SONATA_FAILPOINTS=...`` example values in the operator docs — must
+  exist in the registry's ``SITES`` tuple.  (Doc *grammar* templates with
+  ``[`` placeholders are not concrete specs and are skipped.)
+- **registered → exercised**: every ``SITES`` entry must be *armed* in
+  at least one test (``tests/``) or tool (``tools/``) — a
+  ``fire``/``arm``/``arm_spec`` literal or a spec-shaped string constant
+  (an HTTP ``?arm=site:mode`` call, a ``SONATA_FAILPOINTS`` value).  An
+  unexercised site is dead chaos surface.  Raw substring matches do NOT
+  vouch: ``warmup_and_mark_ready`` in an unrelated test must not satisfy
+  the ``warmup`` site, or the invariant is vacuous for common names.
+- **registered → documented**: every ``SITES`` entry must appear
+  backtick-wrapped in the operator docs (the site table renders them as
+  code spans), so the arming grammar's site list cannot drift — prose
+  that merely mentions "warmup" does not count.
+
+The registry module is located by its ``SITES`` tuple (any parsed module
+defining a module-level ``SITES = (str, ...)``), so the pass runs
+unchanged over the test fixtures.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from .core import AnalysisContext, Diagnostic, call_name, const_str
+
+PASS_NAME = "failpoints"
+
+#: calls whose first string argument names a failpoint site
+ARM_CALLS = {"fire", "arm"}
+SPEC_CALLS = {"arm_spec"}
+
+#: concrete SONATA_FAILPOINTS example values in docs: specs only — at
+#: least site:mode.  Group 2 grabs a trailing bracket/angle if the text
+#: continues into grammar-placeholder syntax (``site:mode[:rate...]``);
+#: such matches are templates, not concrete specs, and are skipped by
+#: the caller (a lookahead alone can't do it — backtracking defeats it)
+DOC_SPEC_RE = re.compile(r"SONATA_FAILPOINTS=([a-z0-9_.]+:[a-z-]+"
+                         r"[a-z0-9_.:,-]*)([\[<]?)")
+
+#: spec-shaped site reference inside any string constant: ``site:mode``
+#: at string start or after ``?``/``&``/``=`` (HTTP arm calls, env
+#: values).  The mode must be a real one so ``time:now`` can't vouch.
+SPEC_IN_STR_RE = re.compile(
+    r"(?:^|[?&=])([a-z0-9_.]+):(?:error|hang|slow|corrupt-shape)\b")
+
+
+def _find_registry(ctx: AnalysisContext
+                   ) -> Optional[Tuple[str, int, List[str]]]:
+    """(module relpath, SITES lineno, site names) or None."""
+    for rel, mod in ctx.modules.items():
+        for node in mod.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "SITES"
+                    and isinstance(node.value, (ast.Tuple, ast.List))):
+                continue
+            sites = [const_str(e) for e in node.value.elts]
+            if sites and all(s is not None for s in sites):
+                return rel, node.lineno, sites
+    return None
+
+
+def _armed_in_tree(tree: ast.Module) -> List[Tuple[str, int]]:
+    """(site, lineno) for every fire/arm/arm_spec literal in a module."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        cname = call_name(node) or ""
+        lit = const_str(node.args[0])
+        if lit is None:
+            continue
+        if cname in ARM_CALLS:
+            out.append((lit, node.lineno))
+        elif cname in SPEC_CALLS and ":" in lit:
+            out.append((lit.split(":", 1)[0], node.lineno))
+    return out
+
+
+def _extra_sources(ctx: AnalysisContext) -> Dict[str, str]:
+    """tests/ and tools/ sources (text), which the shared context does
+    not parse — the exercised check and the armed check both need them.
+    Fixture contexts simply lack the dirs and contribute nothing."""
+    out: Dict[str, str] = {}
+    for sub in ("tests", "tools"):
+        root = Path(ctx.root) / sub
+        if not root.is_dir():
+            continue
+        for f in sorted(root.rglob("*.py")):
+            if "__pycache__" in str(f) or "analysis_fixtures" in str(f):
+                continue
+            rel = str(f.relative_to(ctx.root))
+            try:
+                out[rel] = f.read_text(encoding="utf-8")
+            except OSError:
+                continue
+    return out
+
+
+def run(ctx: AnalysisContext) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    registry = _find_registry(ctx)
+    if registry is None:
+        return diags  # no failpoint subsystem in this tree
+    reg_rel, reg_line, sites = registry
+    known = set(sites)
+    extra = _extra_sources(ctx)
+
+    # armed → registered, over package modules ...
+    armed: List[Tuple[str, str, int]] = []  # (site, file, line)
+    for rel, mod in ctx.modules.items():
+        for site, lineno in _armed_in_tree(mod.tree):
+            armed.append((site, rel, lineno))
+    # ... over tests/tools (trees kept for the exercised check) ...
+    exercised: set = set()
+    for rel, src in extra.items():
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError:
+            continue
+        for site, lineno in _armed_in_tree(tree):
+            armed.append((site, rel, lineno))
+            exercised.add(site)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                             str):
+                exercised.update(SPEC_IN_STR_RE.findall(node.value))
+    # ... and over concrete doc examples
+    for rel, text in ctx.docs.items():
+        for lineno, line in enumerate(text.splitlines(), 1):
+            for m in DOC_SPEC_RE.finditer(line):
+                if m.group(2):
+                    continue  # grammar template, not a concrete spec
+                for spec in m.group(1).split(","):
+                    if ":" in spec:
+                        armed.append((spec.split(":", 1)[0].strip(),
+                                      rel, lineno))
+    for site, rel, lineno in armed:
+        if site not in known:
+            diags.append(Diagnostic(
+                PASS_NAME, "unknown-site", rel, lineno,
+                f"failpoint {site!r} is armed/fired here but is not in "
+                f"the registry ({reg_rel} SITES) — a typo'd site never "
+                "fires; fix the name or register the site"))
+
+    # registered → exercised: armed (fire/arm/arm_spec literal or a
+    # spec-shaped string) in at least one test / tool — substring hits
+    # like ``warmup_and_mark_ready`` deliberately do not count
+    for site in sites:
+        if site not in exercised:
+            diags.append(Diagnostic(
+                PASS_NAME, "unexercised-site", reg_rel, reg_line,
+                f"registry site {site!r} is armed by no test under "
+                "tests/ and no tool under tools/ — dead chaos surface; "
+                "arm it in a test or the chaos smoke"))
+
+    # registered → documented (the arming grammar's site list in the
+    # operator docs must not drift from the registry); the site table
+    # renders sites as code spans, so require the backticked token
+    for site in sites:
+        if not any(f"`{site}`" in text for text in ctx.docs.values()):
+            diags.append(Diagnostic(
+                PASS_NAME, "undocumented-site", reg_rel, reg_line,
+                f"registry site {site!r} appears nowhere in the operator "
+                "docs (README.md / docs/*.md) — add it to the failpoint "
+                "site table"))
+
+    # de-duplicate repeated identical findings (same site armed twice on
+    # one line, repeated doc mentions)
+    unique: Dict[Tuple, Diagnostic] = {}
+    for d in diags:
+        unique.setdefault((d.code, d.file, d.line, d.message), d)
+    return sorted(unique.values(), key=lambda d: (d.file, d.line, d.code))

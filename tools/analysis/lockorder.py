@@ -344,14 +344,17 @@ def _build_summaries(index: _Index) -> None:
     properties = {fi.name: fi for fi in index.funcs if fi.is_property}
 
     def resolve_called(fi: FuncInfo) -> List[FuncInfo]:
+        # sorted: set iteration order is hash-randomized, and the first
+        # blocking callee found becomes the diagnostic's witness chain —
+        # the committed report must not churn between runs
         out: List[FuncInfo] = []
-        for name in fi.calls:
+        for name in sorted(fi.calls):
             init = index.class_init.get(name)
             if init is not None:
                 out.append(init)
                 continue
             out.extend(index.by_name.get(name, ()))
-        for name in fi.prop_loads:
+        for name in sorted(fi.prop_loads):
             p = properties.get(name)
             if p is not None:
                 out.append(p)

@@ -20,6 +20,10 @@
 #   5. "Multi-device lane" — test_replicas on a forced 4-device CPU
 #      host (the replica-pool acceptance shape), plus test_parallel on
 #      its 8-device virtual mesh (make_mesh(8) needs all 8)
+#   6. "Chaos smoke" — seeded fault injection against a live 2-replica
+#      server on the two pinned seeds (tools/chaos_smoke.py): failpoint
+#      sites, hung-dispatch watchdog + exactly-once resubmission,
+#      degradation ladder, readiness/trace/metric invariants
 #
 # The workflow's dependency-install step is intentionally skipped: this
 # environment (and any dev box that can run the suite at all) already has
@@ -41,19 +45,19 @@ import jax, sys
 print(f"env: python {sys.version.split()[0]}, jax {jax.__version__}")
 EOF
 
-echo "-- step 1/5: static analysis (sonata-lint)" | tee -a "$LOG"
+echo "-- step 1/6: static analysis (sonata-lint)" | tee -a "$LOG"
 # one analysis run: findings into the log, the machine-readable report
 # (committed next to the bench artifacts) via --report, one gated rc
 python -m tools.analysis --report tools/analysis_report.json 2>&1 \
     | tee -a "$LOG"
 rc_lint=${PIPESTATUS[0]}
 
-echo "-- step 2/5: python -m pytest tests/ -q $*" | tee -a "$LOG"
+echo "-- step 2/6: python -m pytest tests/ -q $*" | tee -a "$LOG"
 JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     --continue-on-collection-errors "$@" 2>&1 | tee -a "$LOG"
 rc_tests=${PIPESTATUS[0]}
 
-echo "-- step 3/5: graft-entry compile check (8-device CPU mesh)" | tee -a "$LOG"
+echo "-- step 3/6: graft-entry compile check (8-device CPU mesh)" | tee -a "$LOG"
 python - <<'EOF' 2>&1 | tee -a "$LOG"
 import os
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
@@ -65,11 +69,11 @@ m.dryrun_multichip(8)
 EOF
 rc_graft=${PIPESTATUS[0]}
 
-echo "-- step 4/5: serving smoke (gRPC + /metrics + /healthz + /readyz + replicas)" | tee -a "$LOG"
+echo "-- step 4/6: serving smoke (gRPC + /metrics + /healthz + /readyz + replicas)" | tee -a "$LOG"
 JAX_PLATFORMS=cpu python tools/serving_smoke.py 2>&1 | tee -a "$LOG"
 rc_smoke=${PIPESTATUS[0]}
 
-echo "-- step 5/5: multi-device lane (replica pool on 4 forced devices)" | tee -a "$LOG"
+echo "-- step 5/6: multi-device lane (replica pool on 4 forced devices)" | tee -a "$LOG"
 XLA_FLAGS=--xla_force_host_platform_device_count=4 JAX_PLATFORMS=cpu \
     python -m pytest tests/test_replicas.py -q \
     --continue-on-collection-errors 2>&1 | tee -a "$LOG"
@@ -79,9 +83,16 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     --continue-on-collection-errors 2>&1 | tee -a "$LOG"
 rc_parallel=${PIPESTATUS[0]}
 
+echo "-- step 6/6: chaos smoke (failpoints/watchdog/degradation, seeds 1+2)" | tee -a "$LOG"
+JAX_PLATFORMS=cpu python tools/chaos_smoke.py --seed 1 2>&1 | tee -a "$LOG"
+rc_chaos1=${PIPESTATUS[0]}
+JAX_PLATFORMS=cpu python tools/chaos_smoke.py --seed 2 2>&1 | tee -a "$LOG"
+rc_chaos2=${PIPESTATUS[0]}
+
 echo "== lint rc=$rc_lint pytest rc=$rc_tests graft rc=$rc_graft" \
      "smoke rc=$rc_smoke replicas rc=$rc_replicas" \
-     "parallel rc=$rc_parallel ==" | tee -a "$LOG"
+     "parallel rc=$rc_parallel chaos rc=$rc_chaos1/$rc_chaos2 ==" | tee -a "$LOG"
 [ "$rc_lint" -eq 0 ] && [ "$rc_tests" -eq 0 ] && [ "$rc_graft" -eq 0 ] \
     && [ "$rc_smoke" -eq 0 ] && [ "$rc_replicas" -eq 0 ] \
-    && [ "$rc_parallel" -eq 0 ]
+    && [ "$rc_parallel" -eq 0 ] && [ "$rc_chaos1" -eq 0 ] \
+    && [ "$rc_chaos2" -eq 0 ]
