@@ -32,7 +32,8 @@ def main() -> None:
                 ("stream_decode_coalescing_ratio", "requests_per_dispatch"),
                 ("stream_stage_coalescing_ratio", "requests_per_dispatch"),
                 ("dispatch_policy_coalesce", "bool"),
-                ("trace_overhead", "ratio_traced_over_untraced")):
+                ("trace_overhead", "ratio_traced_over_untraced"),
+                ("scope_overhead", "ratio_scoped_over_unscoped")):
             print(json.dumps({
                 "metric": metric, "value": None, "unit": unit,
                 "vs_baseline": None,
@@ -120,6 +121,39 @@ def main() -> None:
         "spans_per_trace": (len(sample[0].spans_snapshot())
                             if sample else 0),
         "runs_per_arm": len(traced_ts),
+    }))
+
+    # scope overhead (the ISSUE-7 aggregation plane, same ≤2% bar as
+    # tracing): identical traced single-stream TTFB runs with the scope
+    # installed (trace-finish feed + sketches + 1 Hz recorder live) vs
+    # uninstalled, interleaved so clock drift hits both arms equally.
+    from sonata_tpu.serving import scope as _scope_mod
+
+    _scope = _scope_mod.Scope()
+    scoped_ts, unscoped_ts = [], []
+    for i in range(18):  # alternate arms
+        enabled = i % 2 == 0
+        if enabled:
+            _scope_mod.install(_scope)
+            _scope.start()
+        try:
+            dt = _one_ttfb(traced=True)
+        finally:
+            if enabled:
+                _scope_mod.uninstall(_scope)
+                _scope.close()
+        (scoped_ts if enabled else unscoped_ts).append(dt)
+    p50_scoped = statistics.median(scoped_ts)
+    p50_unscoped = statistics.median(unscoped_ts)
+    print(json.dumps({
+        "metric": "scope_overhead",
+        "value": round(p50_scoped / max(p50_unscoped, 1e-9), 4),
+        "unit": "ratio_scoped_over_unscoped",
+        "vs_baseline": None,
+        "ttfb_p50_scoped_ms": round(p50_scoped * 1e3, 2),
+        "ttfb_p50_unscoped_ms": round(p50_unscoped * 1e3, 2),
+        "stage_observations": _scope._stages["e2e"]["1h"].merged().count,
+        "runs_per_arm": len(scoped_ts),
     }))
 
     # concurrent streaming load: N clients, aggregate audio throughput

@@ -101,7 +101,10 @@ class _Voice:
         elif continuous_batching:
             from ..synth.scheduler import BatchScheduler
 
-            self.scheduler = BatchScheduler(voice)
+            # the voice id rides the dispatch attribution so traces and
+            # the scope's padding-waste accounting name the voice
+            self.scheduler = BatchScheduler(
+                voice, trace_attrs={"voice": voice_id})
         self.synth = SpeechSynthesizer(voice, replica_pool=self.pool)
 
 

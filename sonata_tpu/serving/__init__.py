@@ -39,6 +39,7 @@ from typing import Optional
 
 from . import degradation as degradation_mod
 from . import faults, tracing
+from . import scope as scope_mod
 from .admission import AdmissionController, Overloaded
 from .deadlines import Deadline, DeadlineExceeded, default_timeout_s
 from .degradation import DegradationLadder
@@ -51,6 +52,7 @@ from .metrics import (
     start_http_server,
 )
 from .replicas import ReplicaPool, resolve_replica_count
+from .scope import Scope
 from .tracing import Trace, Tracer
 
 __all__ = [
@@ -70,6 +72,8 @@ __all__ = [
     "start_http_server",
     "ReplicaPool",
     "resolve_replica_count",
+    "Scope",
+    "scope_mod",
     "ServingRuntime",
     "Trace",
     "Tracer",
@@ -85,7 +89,8 @@ class ServingRuntime:
                  max_queue_depth: Optional[int] = None,
                  request_timeout_s: Optional[float] = None,
                  registry: Optional[MetricsRegistry] = None,
-                 tracer: Optional[Tracer] = None):
+                 tracer: Optional[Tracer] = None,
+                 scope: Optional[Scope] = None):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.health = HealthState(registry=self.registry)
         self.admission = AdmissionController(max_in_flight, max_queue_depth)
@@ -168,6 +173,24 @@ class ServingRuntime:
         for site in faults.SITES:
             fp.labels(site=site).set_function(
                 lambda s=site: faults.fires_total(s))
+        #: sonata-scope aggregation plane (ISSUE 7): rolling per-stage
+        #: quantiles, SLO burn rates, dispatch padding-waste accounting,
+        #: and the 1 Hz flight recorder.  SONATA_SCOPE=0 disables; the
+        #: hooks then cost one module-global read.  Installed globally
+        #: (like the ladder) so the scheduler and tracer feed it.
+        self.scope: Optional[Scope] = None
+        if scope is not None or scope_mod.scope_enabled():
+            self.scope = scope if scope is not None else Scope()
+            scope_mod.install(self.scope)
+            self.scope.bind_metrics(r)
+            self.scope.add_probe(
+                "in_flight", lambda: float(self.admission.in_flight))
+            self.scope.add_probe(
+                "shed_total", lambda: float(self.admission.shed_total))
+            self.scope.start()
+        #: per-voice flight-recorder probes added by register_voice, so
+        #: unregister removes exactly what was added
+        self._voice_probes: dict = {}
 
     # -- deadlines -----------------------------------------------------------
     def deadline_for(self, context=None) -> Deadline:
@@ -189,7 +212,7 @@ class ServingRuntime:
             return None
         self.http = start_http_server(self.registry, health=self.health,
                                       port=resolved, host=host,
-                                      tracer=self.tracer)
+                                      tracer=self.tracer, scope=self.scope)
         return self.http.port
 
     @property
@@ -248,10 +271,31 @@ class ServingRuntime:
                     voice_gauge(f"sonata_{stage}_{key}",
                                 f"Stream coalescer {key}, per voice.",
                                 stage_stat(stage, key))
+        if self.scope is not None:
+            # dispatch padding-waste accumulator (scope plane): counter
+            # semantics via a scrape-time callback, like the replica
+            # series; the scope keys on the voice label the scheduler
+            # stamps into its dispatch attribution
+            waste = r.counter(
+                "sonata_dispatch_padding_waste_seconds_total",
+                "Device-dispatch seconds spent on padding rows "
+                "(dispatch duration x padding_ratio, accumulated), "
+                "per voice.")
+            waste.labels(**lbl).set_function(
+                lambda v=voice_id: self.scope.padding_waste_seconds(v))
+            owned.append((waste, lbl))
         if scheduler is not None:
             voice_gauge("sonata_scheduler_queue_depth",
                         "Items waiting in the batch scheduler, per voice.",
                         lambda: float(scheduler.queue_depth()))
+            if self.scope is not None:
+                # flight-recorder probes ride the same registration so
+                # the timeline names the voice's queue
+                probes = self._voice_probes.setdefault(voice_id, [])
+                name = f"queue_depth:{voice_id}"
+                self.scope.add_probe(
+                    name, lambda: float(scheduler.queue_depth()))
+                probes.append(name)
 
             # stats_view() instead of raw .stats: a ReplicaPool passed as
             # the voice's scheduler aggregates its per-replica scheduler
@@ -349,6 +393,12 @@ class ServingRuntime:
         voice_gauge("sonata_pool_replicas",
                     "Total replicas in the pool, per voice.",
                     lambda: float(len(pool.replicas)))
+        if self.scope is not None:
+            probes = self._voice_probes.setdefault(voice_id, [])
+            name = f"healthy_replicas:{voice_id}"
+            self.scope.add_probe(name,
+                                 lambda: float(pool.healthy_count()))
+            probes.append(name)
 
     def unregister_voice(self, voice_id: str) -> None:
         """Drop a voice's labeled series after UnloadVoice — exactly the
@@ -357,9 +407,15 @@ class ServingRuntime:
         closures that would otherwise pin the unloaded voice's objects."""
         for metric, labels in self._voice_series.pop(voice_id, []):
             metric.remove(**labels)
+        for probe in self._voice_probes.pop(voice_id, []):
+            if self.scope is not None:
+                self.scope.remove_probe(probe)
 
     def close(self) -> None:
         degradation_mod.uninstall(self.degradation)
+        if self.scope is not None:
+            scope_mod.uninstall(self.scope)
+            self.scope.close()
         if self.http is not None:
             self.http.stop()
             self.http = None

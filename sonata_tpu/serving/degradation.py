@@ -56,11 +56,16 @@ log = logging.getLogger("sonata.serving")
 WINDOW_ENV = "SONATA_DEGRADE_WINDOW_S"
 SHED_THRESHOLD_ENV = "SONATA_DEGRADE_SHED_THRESHOLD"
 WATCHDOG_THRESHOLD_ENV = "SONATA_DEGRADE_WATCHDOG_THRESHOLD"
+BURN_THRESHOLD_ENV = "SONATA_DEGRADE_BURN_THRESHOLD"
 RECOVER_ENV = "SONATA_DEGRADE_RECOVER_S"
 
 DEFAULT_WINDOW_S = 30.0
 DEFAULT_SHED_THRESHOLD = 20
 DEFAULT_WATCHDOG_THRESHOLD = 2
+#: SLO-burn pressure events (the scope's 1 Hz tick emits one per second
+#: of sustained over-threshold fast-window burn, when
+#: SONATA_DEGRADE_ON_BURN enables the coupling) per window per step
+DEFAULT_BURN_THRESHOLD = 10
 DEFAULT_RECOVER_S = 15.0
 
 #: level names, index == level (also the gauge's documented scale)
@@ -89,6 +94,7 @@ class DegradationLadder:
     def __init__(self, *, window_s: Optional[float] = None,
                  shed_threshold: Optional[int] = None,
                  watchdog_threshold: Optional[int] = None,
+                 burn_threshold: Optional[int] = None,
                  recover_s: Optional[float] = None,
                  on_change: Optional[Callable[[int, str], None]] = None):
         self.window_s = max(0.1, window_s if window_s is not None
@@ -101,6 +107,9 @@ class DegradationLadder:
             watchdog_threshold if watchdog_threshold is not None
             else _env_int(WATCHDOG_THRESHOLD_ENV,
                           DEFAULT_WATCHDOG_THRESHOLD)))
+        self.burn_threshold = max(0, (
+            burn_threshold if burn_threshold is not None
+            else _env_int(BURN_THRESHOLD_ENV, DEFAULT_BURN_THRESHOLD)))
         self.recover_s = max(0.05, (
             recover_s if recover_s is not None
             else _env_float(RECOVER_ENV, DEFAULT_RECOVER_S)))
@@ -108,6 +117,7 @@ class DegradationLadder:
         self._lock = threading.Lock()
         self._sheds: "deque[float]" = deque()
         self._watchdogs: "deque[float]" = deque()
+        self._burns: "deque[float]" = deque()
         self._level = 0
         self._peak_level = 0
         self._transitions = 0
@@ -124,6 +134,13 @@ class DegradationLadder:
         """One dispatch killed by the hung-dispatch watchdog."""
         self._event(self._watchdogs)
 
+    def record_burn(self) -> None:
+        """One second of sustained SLO fast-window burn over the page
+        threshold (fed by the scope's recorder tick when
+        ``SONATA_DEGRADE_ON_BURN`` couples the two) — the ladder reacts
+        to user-visible latency, not just sheds."""
+        self._event(self._burns)
+
     def _event(self, dq: "deque[float]") -> None:
         now = time.monotonic()
         stepped_to = None
@@ -139,13 +156,14 @@ class DegradationLadder:
                 # a full fresh window of pressure is needed per step
                 self._sheds.clear()
                 self._watchdogs.clear()
+                self._burns.clear()
                 stepped_to = self._level
         if stepped_to is not None:
             self._announce(stepped_to, "pressure")
 
     def _prune_locked(self, now: float) -> None:
         horizon = now - self.window_s
-        for dq in (self._sheds, self._watchdogs):
+        for dq in (self._sheds, self._watchdogs, self._burns):
             while dq and dq[0] < horizon:
                 dq.popleft()
 
@@ -153,7 +171,9 @@ class DegradationLadder:
         return ((self.shed_threshold > 0
                  and len(self._sheds) >= self.shed_threshold)
                 or (self.watchdog_threshold > 0
-                    and len(self._watchdogs) >= self.watchdog_threshold))
+                    and len(self._watchdogs) >= self.watchdog_threshold)
+                or (self.burn_threshold > 0
+                    and len(self._burns) >= self.burn_threshold))
 
     # -- level ----------------------------------------------------------------
     def current_level(self) -> int:
@@ -203,7 +223,8 @@ class DegradationLadder:
                     "peak_level": self._peak_level,
                     "transitions": self._transitions,
                     "window_sheds": len(self._sheds),
-                    "window_watchdogs": len(self._watchdogs)}
+                    "window_watchdogs": len(self._watchdogs),
+                    "window_burns": len(self._burns)}
 
 
 # ---------------------------------------------------------------------------
@@ -241,6 +262,12 @@ def note_watchdog() -> None:
     ladder = _installed
     if ladder is not None:
         ladder.record_watchdog()
+
+
+def note_burn() -> None:
+    ladder = _installed
+    if ladder is not None:
+        ladder.record_burn()
 
 
 def gather_scale() -> float:

@@ -13,12 +13,18 @@ do:
 - **Machine-readable lines**: ``--log-format json`` (or
   ``SONATA_LOG_FORMAT=json``) switches to one JSON object per line —
   ``{"ts", "level", "logger", "message", "request_id"?, "voice"?,
-  "replica"?}`` — which is what a log pipeline joins against the trace
-  export from ``SONATA_TRACE_LOG``.
+  "replica"?, "degradation"?, "slo_breach"?}`` — which is what a log
+  pipeline joins against the trace export from ``SONATA_TRACE_LOG``
+  and the flight-recorder timeline (``/debug/timeline``): every line
+  carries the degradation-ladder level at emit time, and ``slo_breach``
+  appears whenever an SLO's fast-window burn rate exceeded 1.0 at the
+  scope's last tick.
 
 The text format stays the familiar ``asctime name level message``, with
-`` rid=<request_id>`` appended whenever one is known, so grepping a
-request across the server log works in either mode.
+`` rid=<request_id>`` appended whenever one is known — plus
+`` lvl=<level>`` / `` slo_breach`` only while the process is degraded
+or breaching — so grepping a request (or an incident) across the
+server log works in either mode.
 """
 
 from __future__ import annotations
@@ -30,19 +36,26 @@ import sys
 import time
 from typing import Optional
 
-from . import tracing
+from . import degradation, scope, tracing
 
 LOG_FORMAT_ENV = "SONATA_LOG_FORMAT"
 
 #: fields TraceContextFilter injects / JsonLineFormatter surfaces
-_CONTEXT_FIELDS = ("request_id", "voice", "replica")
+_CONTEXT_FIELDS = ("request_id", "voice", "replica", "degradation",
+                   "slo_breach")
 
 
 class TraceContextFilter(logging.Filter):
-    """Attach the active trace's request_id/voice to every record.
+    """Attach the active trace's request_id/voice — plus the process
+    health context (degradation level, SLO-breach flag) — to every
+    record.
 
     Explicit ``extra=`` values win; records logged outside any request
-    context get ``None`` (rendered as absent)."""
+    context get ``None`` (rendered as absent).  ``degradation`` is the
+    ladder level at emit time (present whenever a ladder is installed,
+    0 included, so log lines join against the flight-recorder
+    timeline); ``slo_breach`` appears — as ``True`` — only while some
+    SLO's fast-window burn exceeds 1.0."""
 
     def filter(self, record: logging.LogRecord) -> bool:
         trace = tracing.current_trace()
@@ -52,6 +65,16 @@ class TraceContextFilter(logging.Filter):
             record.voice = trace.attrs.get("voice") if trace else None
         if getattr(record, "replica", None) is None:
             record.replica = None
+        if getattr(record, "degradation", None) is None:
+            ladder = degradation.installed()
+            record.degradation = (ladder.current_level()
+                                  if ladder is not None else None)
+        if getattr(record, "slo_breach", None) is None:
+            sc = scope.installed()
+            # cached at the scope's 1 Hz tick: an attribute read here,
+            # never burn-rate math per log record
+            record.slo_breach = True if (sc is not None
+                                         and sc.slo_breach) else None
         return True
 
 
@@ -78,7 +101,8 @@ class JsonLineFormatter(logging.Formatter):
 
 class TextFormatter(logging.Formatter):
     """The classic line format plus `` rid=<id>`` when a request is
-    known."""
+    known — and, only while the process is degraded or breaching an
+    SLO, `` lvl=<n>`` / `` slo_breach`` (healthy lines stay clean)."""
 
     def __init__(self):
         super().__init__("%(asctime)s %(name)s %(levelname)s %(message)s")
@@ -88,6 +112,11 @@ class TextFormatter(logging.Formatter):
         rid = getattr(record, "request_id", None)
         if rid:
             line += f" rid={rid}"
+        level = getattr(record, "degradation", None)
+        if level:
+            line += f" lvl={level}"
+        if getattr(record, "slo_breach", None):
+            line += " slo_breach"
         return line
 
 

@@ -316,6 +316,7 @@ class _Handler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = None
     health = None
     tracer = None
+    scope = None
 
     def do_GET(self):  # noqa: N802 (http.server API)
         from . import faults
@@ -347,8 +348,43 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply_profile(query)
         elif path == "/debug/failpoints":
             self._reply_failpoints(query)
+        elif path in ("/debug/quantiles", "/debug/buckets",
+                      "/debug/timeline"):
+            self._reply_scope(path, query)
         else:
             self._reply(404, b"not found\n")
+
+    # -- aggregation plane (serving/scope.py) --------------------------------
+    def _reply_scope(self, path: str, query: str) -> None:
+        """``/debug/quantiles`` (rolling per-stage quantiles + SLO
+        state), ``/debug/buckets`` (dispatch padding-waste tables),
+        ``/debug/timeline`` (flight-recorder ring; ``?format=chrome``
+        for counter tracks)."""
+        import json
+        from urllib.parse import parse_qs
+
+        if self.scope is None:
+            # same posture as the tracer-gated /debug siblings: no
+            # aggregation plane configured, no debug surface
+            self._reply(404, b"scope not enabled on this server\n")
+            return
+        if path == "/debug/quantiles":
+            body = json.dumps({**self.scope.quantiles_snapshot(),
+                               **self.scope.slo_snapshot()})
+        elif path == "/debug/buckets":
+            body = json.dumps(self.scope.buckets_snapshot())
+        else:
+            params = parse_qs(query)
+            if params.get("format", [""])[0] == "chrome":
+                body = json.dumps(self.scope.timeline_chrome())
+            else:
+                snaps = self.scope.timeline_snapshot()
+                body = json.dumps({
+                    "count": len(snaps),
+                    "interval_s": self.scope.tick_interval_s,
+                    "snapshots": snaps})
+        self._reply(200, body.encode("utf-8"),
+                    "application/json; charset=utf-8")
 
     # -- failpoint arming plane (serving/faults.py) --------------------------
     def _reply_failpoints(self, query: str) -> None:
@@ -496,15 +532,17 @@ def resolve_metrics_port(port: Optional[int] = None) -> Optional[int]:
 def start_http_server(registry: MetricsRegistry, health=None,
                       port: Optional[int] = None,
                       host: Optional[str] = None,
-                      tracer=None) -> MetricsHTTPServer:
+                      tracer=None, scope=None) -> MetricsHTTPServer:
     """Serve ``/metrics``, ``/healthz``, ``/readyz`` — plus, when a
     :class:`~sonata_tpu.serving.tracing.Tracer` is given,
-    ``/debug/traces``, ``/debug/slowest``, and ``/debug/profile`` — in a
-    daemon thread."""
+    ``/debug/traces``, ``/debug/slowest``, and ``/debug/profile``, and,
+    when a :class:`~sonata_tpu.serving.scope.Scope` is given,
+    ``/debug/quantiles``, ``/debug/buckets``, and ``/debug/timeline`` —
+    in a daemon thread."""
     host = host or os.environ.get(METRICS_HOST_ENV, "127.0.0.1")
     handler = type("BoundHandler", (_Handler,),
                    {"registry": registry, "health": health,
-                    "tracer": tracer})
+                    "tracer": tracer, "scope": scope})
     httpd = ThreadingHTTPServer((host, port or 0), handler)
     httpd.daemon_threads = True
     return MetricsHTTPServer(httpd)

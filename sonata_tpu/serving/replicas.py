@@ -201,6 +201,11 @@ class Replica:
             self._scheduler_kwargs.setdefault("queue_wait_hist",
                                               pool.queue_wait)
         attrs = {"replica": index}
+        if pool is not None:
+            # the pool is named after its voice (for_voice passes the
+            # voice id): dispatch spans and the scope's padding-waste
+            # accounting both key on it
+            attrs["voice"] = pool.name
         if device is not None:
             attrs["device"] = str(device)
         self._scheduler_kwargs.setdefault("trace_attrs", attrs)

@@ -1,0 +1,257 @@
+"""Streaming quantile sketches with rolling time windows.
+
+The serving plane's histograms (:mod:`~sonata_tpu.utils.profiling`) are
+cumulative-forever: they answer "what was TTFB p99 *since boot*", which
+goes stale the moment traffic changes.  The aggregation layer
+(:mod:`.scope`) needs "p99 over the last five minutes" — a windowed
+quantile — without keeping raw samples.  This module provides the two
+primitives:
+
+- :class:`QuantileSketch` — a DDSketch-style log-bucketed sketch
+  (Masson et al., VLDB '19): values map to geometric buckets
+  ``gamma**i``, so any reported quantile is within a configurable
+  *relative* error (default 1%) of the true value, memory is bounded
+  (lowest buckets collapse past ``max_bins``), and two sketches
+  **merge** by adding bucket counts — the property that makes rolling
+  windows cheap.
+- :class:`RollingSketch` — a ring of per-slot sketches covering one
+  time window (e.g. 12 × 5 s slots = 1 minute).  ``add`` writes the
+  current slot; ``merged`` combines the live slots, so expiry is
+  O(slots) bookkeeping, never a rescan of observations.
+- :class:`RollingCounter` — the same ring for plain good/bad counts
+  (what the SLO burn-rate math consumes).
+
+Everything takes an injectable ``clock`` so the window-expiry tests run
+on a fake clock instead of sleeping.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Dict, Optional
+
+#: smallest value (seconds) the sketch distinguishes from zero; serving
+#: latencies below a microsecond are all "instant" for SLO purposes
+MIN_TRACKED = 1e-6
+
+DEFAULT_RELATIVE_ACCURACY = 0.01
+DEFAULT_MAX_BINS = 512
+
+
+class QuantileSketch:
+    """Fixed-memory mergeable quantile sketch (relative-error bound).
+
+    Not thread-safe by itself: callers (:class:`RollingSketch`, tests)
+    hold their own lock.  ``quantile(q)`` returns a value within
+    ``relative_accuracy`` of the true q-quantile of everything added.
+    """
+
+    __slots__ = ("relative_accuracy", "_gamma", "_log_gamma", "_max_bins",
+                 "_bins", "_zero_count", "count", "sum", "min", "max")
+
+    def __init__(self, relative_accuracy: float = DEFAULT_RELATIVE_ACCURACY,
+                 max_bins: int = DEFAULT_MAX_BINS):
+        if not 0.0 < relative_accuracy < 1.0:
+            raise ValueError("relative_accuracy must be in (0, 1)")
+        self.relative_accuracy = relative_accuracy
+        self._gamma = (1.0 + relative_accuracy) / (1.0 - relative_accuracy)
+        self._log_gamma = math.log(self._gamma)
+        self._max_bins = max(8, int(max_bins))
+        self._bins: Dict[int, int] = {}
+        self._zero_count = 0
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    # -- recording -----------------------------------------------------------
+    def _key(self, value: float) -> int:
+        return math.ceil(math.log(value) / self._log_gamma)
+
+    def add(self, value: float, count: int = 1) -> None:
+        if count <= 0:
+            return
+        value = float(value)
+        self.count += count
+        self.sum += value * count
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+        if value < MIN_TRACKED:
+            self._zero_count += count
+            return
+        key = self._key(value)
+        self._bins[key] = self._bins.get(key, 0) + count
+        if len(self._bins) > self._max_bins:
+            self._collapse()
+
+    def _collapse(self) -> None:
+        """Fold the lowest buckets together until within ``max_bins``.
+
+        Collapsing the *low* end sacrifices resolution where SLO math
+        never looks (the fast tail), keeping the p9x buckets exact."""
+        keys = sorted(self._bins)
+        while len(keys) > self._max_bins:
+            lowest = keys.pop(0)
+            self._bins[keys[0]] = (self._bins.get(keys[0], 0)
+                                   + self._bins.pop(lowest))
+
+    def merge(self, other: "QuantileSketch") -> None:
+        """Fold ``other`` into self (bucket-wise addition)."""
+        if other.count == 0:
+            return
+        self.count += other.count
+        self.sum += other.sum
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+        self._zero_count += other._zero_count
+        for key, c in other._bins.items():
+            self._bins[key] = self._bins.get(key, 0) + c
+        if len(self._bins) > self._max_bins:
+            self._collapse()
+
+    # -- queries -------------------------------------------------------------
+    def quantile(self, q: float) -> Optional[float]:
+        """The q-quantile (0 <= q <= 1), or None while empty."""
+        if self.count == 0:
+            return None
+        q = min(max(q, 0.0), 1.0)
+        rank = q * (self.count - 1)
+        if rank < self._zero_count:
+            return 0.0
+        running = self._zero_count
+        for key in sorted(self._bins):
+            running += self._bins[key]
+            if running > rank:
+                # geometric bucket midpoint: within relative_accuracy of
+                # anything that mapped into bucket ``key``
+                return (2.0 * self._gamma ** key) / (self._gamma + 1.0)
+        return self.max if self.max > -math.inf else None
+
+    def count_above(self, threshold: float) -> int:
+        """How many observations exceeded ``threshold`` (bucket-granular:
+        accurate to the sketch's relative error)."""
+        if threshold < MIN_TRACKED:
+            return self.count - self._zero_count
+        cut = self._key(threshold)
+        return sum(c for key, c in self._bins.items() if key > cut)
+
+    def to_dict(self) -> dict:
+        return {"count": self.count,
+                "sum": round(self.sum, 6),
+                "min": None if self.count == 0 else round(self.min, 6),
+                "max": None if self.count == 0 else round(self.max, 6),
+                "p50": _round(self.quantile(0.5)),
+                "p90": _round(self.quantile(0.9)),
+                "p99": _round(self.quantile(0.99))}
+
+
+def _round(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v, 6)
+
+
+class _SlotRing:
+    """Shared slot bookkeeping for the rolling containers.
+
+    The ring holds ``slots + 1`` entries: the write slot plus a full
+    window of read slots, so a query never includes observations older
+    than ``window_s`` by more than one slot duration."""
+
+    def __init__(self, window_s: float, slots: int, clock=None):
+        if window_s <= 0 or slots <= 0:
+            raise ValueError("window_s and slots must be positive")
+        self.window_s = float(window_s)
+        self.slots = int(slots)
+        self.slot_s = self.window_s / self.slots
+        self._clock = clock if clock is not None else time.monotonic
+        self._lock = threading.Lock()
+        #: slot index -> (epoch, payload); epoch = int(now / slot_s)
+        self._ring: Dict[int, tuple] = {}
+
+    def _epoch(self) -> int:
+        return int(self._clock() / self.slot_s)
+
+    def _current(self, factory):
+        """The (epoch, payload) pair for the write slot, creating or
+        recycling it as the clock advances.  Caller holds the lock."""
+        epoch = self._epoch()
+        idx = epoch % (self.slots + 1)
+        entry = self._ring.get(idx)
+        if entry is None or entry[0] != epoch:
+            entry = (epoch, factory())
+            self._ring[idx] = entry
+        return entry
+
+    def _live(self):
+        """Payloads of every non-expired slot.  Caller holds the lock."""
+        now_epoch = self._epoch()
+        return [payload for epoch, payload in self._ring.values()
+                if now_epoch - epoch <= self.slots]
+
+
+class RollingSketch(_SlotRing):
+    """A :class:`QuantileSketch` over a rolling time window."""
+
+    def __init__(self, window_s: float, slots: int = 12, *,
+                 relative_accuracy: float = DEFAULT_RELATIVE_ACCURACY,
+                 clock=None):
+        super().__init__(window_s, slots, clock=clock)
+        self._accuracy = relative_accuracy
+        #: bumped on every add — lets consumers (the scope's per-scrape
+        #: merge memo) invalidate on new data instead of guessing a TTL
+        self.generation = 0
+
+    def _factory(self) -> QuantileSketch:
+        return QuantileSketch(self._accuracy)
+
+    def add(self, value: float) -> None:
+        with self._lock:
+            self.generation += 1
+            self._current(self._factory)[1].add(value)
+
+    def merged(self) -> QuantileSketch:
+        """One sketch combining every live slot (cheap: bucket adds).
+
+        The whole merge runs under the ring lock: a live slot's bin dict
+        is still being written by concurrent ``add`` calls, and merging
+        it unlocked races dict iteration against insertion."""
+        out = QuantileSketch(self._accuracy)
+        with self._lock:
+            for sketch in self._live():
+                out.merge(sketch)
+        return out
+
+
+class RollingCounter(_SlotRing):
+    """Good/bad event counts over a rolling time window (SLO feed)."""
+
+    def __init__(self, window_s: float, slots: int = 12, *, clock=None):
+        super().__init__(window_s, slots, clock=clock)
+
+    @staticmethod
+    def _factory() -> list:
+        return [0, 0]  # [good, bad]
+
+    def record(self, *, bad: bool, count: int = 1) -> None:
+        with self._lock:
+            self._current(self._factory)[1][1 if bad else 0] += count
+
+    def totals(self) -> tuple:
+        """(good, bad) over the live window (summed under the lock so
+        the pair can't tear against a concurrent ``record``)."""
+        with self._lock:
+            live = self._live()
+            good = sum(slot[0] for slot in live)
+            bad = sum(slot[1] for slot in live)
+        return good, bad
+
+    def bad_fraction(self) -> Optional[float]:
+        """bad / (good + bad), or None with no observations."""
+        good, bad = self.totals()
+        total = good + bad
+        if total == 0:
+            return None
+        return bad / total

@@ -397,6 +397,25 @@ def annotate_dispatch_group(**attrs) -> None:
 
 
 # ---------------------------------------------------------------------------
+# finished-trace observer (the scope aggregation plane's feed)
+# ---------------------------------------------------------------------------
+
+#: one process-wide hook called with every finished Trace (whatever
+#: tracer finished it, so injected test tracers feed the same plane).
+#: None (the default) keeps trace finish exactly as cheap as before —
+#: a single module-global read.
+_TRACE_OBSERVER: Optional[callable] = None
+
+
+def set_trace_observer(fn) -> None:
+    """Install (or clear, with None) the finished-trace hook.  What
+    :mod:`.scope` uses to feed per-stage quantile sketches without the
+    tracer knowing the aggregation plane exists."""
+    global _TRACE_OBSERVER
+    _TRACE_OBSERVER = fn
+
+
+# ---------------------------------------------------------------------------
 # tracer: ring buffers + exports
 # ---------------------------------------------------------------------------
 
@@ -472,6 +491,13 @@ class Tracer:
                 heapq.heapreplace(self._slow, entry)
         if self._log_lines or self._log_path:
             self._export_log_line(trace)
+        observer = _TRACE_OBSERVER
+        if observer is not None:
+            try:
+                observer(trace)
+            except Exception:
+                # the aggregation plane must never break trace retention
+                log.exception("trace observer failed")
 
     def _export_log_line(self, trace: Trace) -> None:
         try:

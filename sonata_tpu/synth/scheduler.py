@@ -44,7 +44,7 @@ from typing import Optional
 
 from ..audio import Audio
 from ..core import Model, OperationError
-from ..serving import degradation, faults, tracing
+from ..serving import degradation, faults, scope, tracing
 from ..serving.admission import Overloaded
 from ..serving.deadlines import Deadline, DeadlineExceeded
 from ..utils.profiling import QUEUE_WAIT_BUCKETS_S, Histogram
@@ -153,13 +153,14 @@ class BatchScheduler:
         #: schedulers so the per-voice view aggregates.
         self.queue_wait = (queue_wait_hist if queue_wait_hist is not None
                            else Histogram(QUEUE_WAIT_BUCKETS_S))
-        #: merged into every dispatch span (replica index, device, ...).
-        #: Default: the model's pinned device when it has one.
-        if trace_attrs is None:
+        #: merged into every dispatch span (voice, replica index,
+        #: device, ...).  The model's pinned device rides along unless
+        #: the caller already named one.
+        self._trace_attrs = dict(trace_attrs or {})
+        if "device" not in self._trace_attrs:
             device = getattr(model, "device", None)
-            trace_attrs = {"device": str(device)} if device is not None \
-                else {}
-        self._trace_attrs = dict(trace_attrs)
+            if device is not None:
+                self._trace_attrs["device"] = str(device)
         # maxsize counts the sentinel too, but one slot of slack on a
         # 1024-deep bound is noise; <= 0 means unbounded (tests only)
         self._queue: "queue.Queue" = queue.Queue(maxsize=max(max_queue, 0))
@@ -441,6 +442,12 @@ class BatchScheduler:
         # thread may finish (and export) its trace the instant its future
         # resolves, and the dispatch attribution must already be there
         t1 = time.monotonic()
+        if err is None:
+            # dispatch-efficiency accounting (scope plane): one device
+            # dispatch counts ONCE, with the same bucket/padding attrs
+            # the trace attribution carries — traced or not, the model
+            # filled them through the dispatch_scope channel above
+            scope.note_dispatch(t1 - t0, {**self._trace_attrs, **attrs})
         if err is not None and traced:
             attrs["error"] = f"{type(err).__name__}: {err}"
         for item in traced:
@@ -500,6 +507,9 @@ class BatchScheduler:
             helper.retire()  # exits after the wedged call (if ever) ends
             self._bump("stuck")
             degradation.note_watchdog()
+            # a convicted wedge is an incident: ship the flight
+            # recorder's preceding minutes with it
+            scope.note_watchdog()
             log.error("device dispatch stuck past the %gs watchdog; "
                       "thread %s quarantined, failing %d request(s)",
                       timeout, helper.thread.ident, len(sentences))

@@ -1,0 +1,21 @@
+"""Loop-registered metric families (the serving/scope.py idiom): the
+family names are literals in a module-level table and reach the
+registry call through a loop variable.  The metricsdoc pass must
+resolve these — a documented ``sonata_fx_loop_*`` token is NOT a ghost
+— without an allowlist entry."""
+
+FX_FAMILIES = (
+    ("sonata_fx_loop_alpha", "Alpha family (loop-registered)."),
+    ("sonata_fx_loop_beta", "Beta family (loop-registered)."),
+)
+
+
+def bind_fixture_metrics(registry, compute):
+    families = {}
+    for name, help in FX_FAMILIES:
+        families[name] = registry.gauge(name, help)
+    # direct-iterable form: whole elements are the names
+    for whole in ("sonata_fx_loop_gamma",):
+        families[whole] = registry.counter(whole, "Gamma (direct tuple).")
+    families["sonata_fx_loop_alpha"].labels(kind="x").set_function(compute)
+    return families

@@ -153,6 +153,22 @@ def test_metric_asymmetry_and_doc_drift_detected():
     assert not any("sonata_fx_leaky" in d.message for d in ghost)
 
 
+def test_metric_loop_registered_families_resolve():
+    """Family names flowing through a loop variable from a literal
+    table (the scope.py registration idiom) must be resolvable — no
+    allowlisting — while true ghosts keep being reported."""
+    ctx = fixture_ctx("fx_metrics_loop.py", docs=["fx_docs.md"])
+    literals, _patterns = metricsdoc.registered_families(ctx)
+    assert {"sonata_fx_loop_alpha", "sonata_fx_loop_beta",
+            "sonata_fx_loop_gamma"} <= set(literals)
+    diags = metricsdoc.run(ctx)
+    ghost = [d for d in diags if d.code == "unknown-doc-metric"]
+    assert not any("sonata_fx_loop" in d.message for d in ghost), \
+        "loop-registered families must not read as doc ghosts"
+    # the seeded ghost in the shared doc fixture is still a finding
+    assert any("sonata_fx_ghost_metric" in d.message for d in ghost)
+
+
 # ---------------------------------------------------------------------------
 # pass 5: failpoints
 # ---------------------------------------------------------------------------

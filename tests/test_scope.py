@@ -1,0 +1,723 @@
+"""sonata-scope (ISSUE 7): sketches, SLO burn rates, padding-waste
+accounting, and the flight recorder.
+
+Four families, per the ISSUE's test checklist:
+
+1. sketch accuracy / merge / window expiry (fake clock, no sleeps);
+2. a pinned test that the scope's ``padding_waste_seconds`` exactly
+   matches the per-dispatch trace attribution on a known coalesced
+   batch — the two surfaces must never disagree;
+3. burn-rate window math against hand-computed fixtures;
+4. ``/debug/timeline`` + ``/debug/buckets`` (+ ``/debug/quantiles``)
+   over HTTP, including the no-scope 404 gate the other debug
+   endpoints use.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from sonata_tpu.serving import degradation, scope as scope_mod, tracing
+from sonata_tpu.serving.logs import (
+    JsonLineFormatter,
+    TextFormatter,
+    TraceContextFilter,
+)
+from sonata_tpu.serving.metrics import (
+    MetricsRegistry,
+    parse_prometheus_text,
+    start_http_server,
+)
+from sonata_tpu.serving.scope import (
+    FAST_WINDOW,
+    Scope,
+    SloSpec,
+    parse_duration_s,
+    parse_slos,
+)
+from sonata_tpu.serving.sketches import (
+    QuantileSketch,
+    RollingCounter,
+    RollingSketch,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# 1. sketches: accuracy, merge, window expiry
+# ---------------------------------------------------------------------------
+
+def test_sketch_quantiles_within_relative_error():
+    sk = QuantileSketch(relative_accuracy=0.01)
+    values = [i / 1000.0 for i in range(1, 10001)]  # 1ms .. 10s uniform
+    for v in values:
+        sk.add(v)
+    for q in (0.5, 0.9, 0.99):
+        true = values[int(q * (len(values) - 1))]
+        got = sk.quantile(q)
+        assert abs(got - true) / true <= 0.02, (q, got, true)
+    assert sk.count == len(values)
+    assert sk.min == values[0] and sk.max == values[-1]
+
+
+def test_sketch_zero_and_empty():
+    sk = QuantileSketch()
+    assert sk.quantile(0.5) is None
+    sk.add(0.0)
+    sk.add(0.0)
+    assert sk.quantile(0.5) == 0.0
+    assert sk.count_above(0.1) == 0
+
+
+def test_sketch_count_above():
+    sk = QuantileSketch(relative_accuracy=0.01)
+    for v in (0.1, 0.2, 0.3, 1.0, 2.0, 3.0):
+        sk.add(v)
+    assert sk.count_above(0.5) == 3
+    assert sk.count_above(10.0) == 0
+
+
+def test_sketch_merge_equals_union():
+    a, b, union = QuantileSketch(), QuantileSketch(), QuantileSketch()
+    for i in range(1, 501):
+        a.add(i / 100.0)
+        union.add(i / 100.0)
+    for i in range(500, 1001):
+        b.add(i / 100.0)
+        union.add(i / 100.0)
+    a.merge(b)
+    assert a.count == union.count
+    for q in (0.1, 0.5, 0.95):
+        assert a.quantile(q) == pytest.approx(union.quantile(q), rel=0.02)
+
+
+def test_sketch_memory_is_bounded():
+    sk = QuantileSketch(relative_accuracy=0.01, max_bins=64)
+    for i in range(1, 20001):
+        sk.add(i * 0.37)
+    assert len(sk._bins) <= 64
+    # the collapse folds the LOW end; the tail quantile stays accurate
+    assert sk.quantile(0.99) == pytest.approx(0.99 * 20000 * 0.37, rel=0.05)
+
+
+def test_rolling_sketch_window_expiry():
+    clock = FakeClock()
+    rs = RollingSketch(60.0, slots=12, clock=clock)
+    rs.add(1.0)
+    clock.advance(30.0)
+    rs.add(2.0)
+    assert rs.merged().count == 2
+    clock.advance(45.0)  # first value (75s old) out, second (45s) alive
+    assert rs.merged().count == 1
+    assert rs.merged().quantile(0.5) == pytest.approx(2.0, rel=0.02)
+    clock.advance(60.0)  # everything expired
+    assert rs.merged().count == 0
+    assert rs.merged().quantile(0.5) is None
+
+
+def test_rolling_counter_window_expiry_and_fraction():
+    clock = FakeClock()
+    rc = RollingCounter(300.0, slots=15, clock=clock)
+    assert rc.bad_fraction() is None
+    for _ in range(9):
+        rc.record(bad=False)
+    rc.record(bad=True)
+    assert rc.totals() == (9, 1)
+    assert rc.bad_fraction() == pytest.approx(0.1)
+    clock.advance(400.0)
+    assert rc.totals() == (0, 0) and rc.bad_fraction() is None
+
+
+# ---------------------------------------------------------------------------
+# SLO grammar
+# ---------------------------------------------------------------------------
+
+def test_parse_duration_forms():
+    assert parse_duration_s("2s") == 2.0
+    assert parse_duration_s("500ms") == 0.5
+    assert parse_duration_s("1.5") == 1.5
+    assert parse_duration_s("2m") == 120.0
+    with pytest.raises(ValueError):
+        parse_duration_s("fast")
+
+
+def test_parse_slos_default_and_explicit():
+    default = {s.name for s in parse_slos("")}
+    assert {"ttfb_p95", "e2e_p99", "error_rate"} <= default
+    specs = parse_slos("ttfb:p95:2s,error_rate:0.01")
+    ttfb = next(s for s in specs if s.name == "ttfb_p95")
+    assert ttfb.kind == "latency" and ttfb.stage == "ttfb"
+    assert ttfb.threshold_s == 2.0
+    assert ttfb.budget == pytest.approx(0.05)
+    err = next(s for s in specs if s.name == "error_rate")
+    assert err.kind == "error_rate" and err.budget == pytest.approx(0.01)
+
+
+@pytest.mark.parametrize("bad", [
+    "ttfb:2s",                # missing quantile
+    "nostage:p95:2s",         # unknown stage
+    "ttfb:95:2s",             # quantile missing the p
+    "ttfb:p95:soon",          # unparseable threshold
+    "error_rate:0.01:extra",  # wrong arity
+    "error_rate:1.5",         # budget out of range
+])
+def test_parse_slos_rejects_typos(bad):
+    with pytest.raises(ValueError):
+        parse_slos(bad)
+
+
+def test_parse_slos_rejects_duplicate_objectives():
+    # duplicates would share one counter set and double-count every
+    # observation into the burn rate (review-pass fix, pinned)
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_slos("ttfb:p95:2s,ttfb:p95:1s")
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_slos("error_rate:0.01,error_rate:0.05")
+
+
+# ---------------------------------------------------------------------------
+# 3. burn-rate window math (hand-computed fixtures)
+# ---------------------------------------------------------------------------
+
+def _scope(clock, slo="ttfb:p95:2s,error_rate:0.01", **kw):
+    return Scope(slos=slo, clock=clock, **kw)
+
+
+def test_latency_burn_rate_hand_computed():
+    clock = FakeClock()
+    sc = _scope(clock)
+    # 18 under the 2 s threshold, 2 over → bad fraction 0.1; budget is
+    # 0.05 (p95) → burn 2.0 on both windows; budget remaining (slow
+    # window) = 1 - 2.0 = -1.0
+    for _ in range(18):
+        sc.observe("ttfb", 0.5)
+    for _ in range(2):
+        sc.observe("ttfb", 3.0)
+    assert sc.burn_rate("ttfb_p95", "5m") == pytest.approx(2.0)
+    assert sc.burn_rate("ttfb_p95", "1h") == pytest.approx(2.0)
+    assert sc.budget_remaining("ttfb_p95") == pytest.approx(-1.0)
+    # exactly on budget: 19 good, 1 bad → fraction 0.05 → burn 1.0
+    clock.advance(4000.0)  # fresh windows
+    for _ in range(19):
+        sc.observe("ttfb", 1.0)
+    sc.observe("ttfb", 2.5)
+    assert sc.burn_rate("ttfb_p95", "5m") == pytest.approx(1.0)
+    assert sc.budget_remaining("ttfb_p95") == pytest.approx(0.0)
+
+
+def test_fast_and_slow_windows_diverge():
+    clock = FakeClock()
+    sc = _scope(clock)
+    # an old burst of badness: visible in the 1h window only once the
+    # 5m window has rolled past it
+    for _ in range(10):
+        sc.observe("ttfb", 5.0)
+    clock.advance(600.0)  # 10 min: out of 5m, inside 1h
+    for _ in range(90):
+        sc.observe("ttfb", 0.1)
+    assert sc.burn_rate("ttfb_p95", "5m") == pytest.approx(0.0)
+    # slow window: 10 bad of 100 → 0.1 / 0.05 = 2.0
+    assert sc.burn_rate("ttfb_p95", "1h") == pytest.approx(2.0)
+
+
+def test_error_rate_slo_fed_by_trace_status():
+    clock = FakeClock()
+    sc = _scope(clock)
+    tracer = tracing.Tracer(enabled=True, recent=8, slowest=4,
+                            log_sink="0")
+    scope_mod.install(sc)
+    try:
+        for i in range(10):
+            trace = tracer.start_trace("req")
+            trace.finish("ok" if i < 9 else "error: Boom")
+    finally:
+        scope_mod.uninstall(sc)
+    # 1 error in 10 against a 0.01 budget → burn 10.0
+    assert sc.burn_rate("error_rate", "5m") == pytest.approx(10.0)
+    assert sc.budget_remaining("error_rate") == pytest.approx(-9.0)
+
+
+def test_trace_feed_populates_stage_quantiles():
+    sc = _scope(FakeClock())
+    tracer = tracing.Tracer(enabled=True, recent=8, slowest=4,
+                            log_sink="0")
+    scope_mod.install(sc)
+    try:
+        with tracer.trace_request("req"):
+            with tracing.span("phonemize"):
+                pass
+            with tracing.span("stream-emit") as sp:
+                sp.annotate(ttfb_ms=120.0)
+    finally:
+        scope_mod.uninstall(sc)
+    assert sc.quantile("e2e", 0.5, "1m") is not None
+    assert sc.quantile("phonemize", 0.5, "1m") is not None
+    assert sc.quantile("ttfb", 0.5, "1m") == pytest.approx(0.12, rel=0.02)
+    # uninstalled: further traces feed nothing
+    count = sc._stages["e2e"]["1m"].merged().count
+    with tracer.trace_request("req2"):
+        pass
+    assert sc._stages["e2e"]["1m"].merged().count == count
+
+
+def test_burn_pressure_feeds_ladder_when_enabled(monkeypatch):
+    monkeypatch.setenv("SONATA_DEGRADE_ON_BURN", "1")
+    clock = FakeClock()
+    sc = _scope(clock)
+    ladder = degradation.DegradationLadder(
+        shed_threshold=0, watchdog_threshold=0, burn_threshold=3,
+        window_s=30.0, recover_s=60.0)
+    degradation.install(ladder)
+    try:
+        for _ in range(20):
+            sc.observe("ttfb", 30.0)  # every request blows the SLO
+        assert sc.burn_rate("ttfb_p95", "5m") == pytest.approx(20.0)
+        for _ in range(3):  # 3 burning ticks == the burn threshold
+            sc.tick()
+        assert ladder.current_level() == 1
+        assert ladder.snapshot()["window_burns"] == 0  # consumed by step
+    finally:
+        degradation.uninstall(ladder)
+
+
+def test_burn_pressure_off_by_default():
+    clock = FakeClock()
+    sc = _scope(clock)
+    ladder = degradation.DegradationLadder(
+        shed_threshold=0, watchdog_threshold=0, burn_threshold=1,
+        window_s=30.0, recover_s=60.0)
+    degradation.install(ladder)
+    try:
+        for _ in range(20):
+            sc.observe("ttfb", 30.0)
+        for _ in range(5):
+            sc.tick()
+        assert ladder.current_level() == 0
+    finally:
+        degradation.uninstall(ladder)
+
+
+# ---------------------------------------------------------------------------
+# 2. padding-waste accounting pinned to the trace attribution
+# ---------------------------------------------------------------------------
+
+class _PaddingModel:
+    """Model stub that pads every batch to 4 rows and says so through
+    the same annotation channel PiperVoice uses."""
+
+    BUCKET = 4
+
+    def speak_batch(self, sentences, speakers=None, scales=None):
+        from sonata_tpu.audio import Audio, AudioSamples
+        from sonata_tpu.core import AudioInfo
+
+        import numpy as np
+
+        n = len(sentences)
+        tracing.annotate_dispatch_group(
+            batch_bucket=self.BUCKET, text_bucket=16, frame_bucket=64,
+            rows=n, padding_rows=self.BUCKET - n,
+            padding_ratio=round((self.BUCKET - n) / self.BUCKET, 3),
+            compile="cached")
+        time.sleep(0.02)  # a measurable dispatch duration
+        info = AudioInfo(sample_rate=16000)
+        return [Audio(AudioSamples(np.zeros(160, dtype=np.float32)),
+                      info, inference_ms=1.0) for _ in sentences]
+
+
+def test_padding_waste_matches_trace_attribution_exactly():
+    """The pinned equivalence: scope waste == dispatch-span duration x
+    the span's own padding_ratio, on a known coalesced batch."""
+    from sonata_tpu.synth.scheduler import BatchScheduler
+
+    sc = Scope(slos="error_rate:0.01", clock=FakeClock())
+    scope_mod.install(sc)
+    tracer = tracing.Tracer(enabled=True, recent=8, slowest=4,
+                            log_sink="0")
+    sched = BatchScheduler(_PaddingModel(), max_batch=4, max_wait_ms=200.0,
+                           trace_attrs={"voice": "pinned"})
+    try:
+        trace = tracer.start_trace("req", request_id="pin-1")
+        with tracing.use_trace(trace):
+            futs = [sched.submit(f"sentence {i}") for i in range(3)]
+        for f in futs:
+            f.result(timeout=10.0)
+        trace.finish("ok")
+        # the shared span is recorded into every participating request's
+        # trace; all three items share THIS trace, so three copies with
+        # ONE dispatch_id prove the batch coalesced into one dispatch
+        dispatch_spans = [s for s in trace.spans_snapshot()
+                          if s.name == "dispatch"]
+        assert len(dispatch_spans) == 3
+        assert len({s.attrs["dispatch_id"] for s in dispatch_spans}) == 1
+        span = dispatch_spans[0]
+        attrs = span.attrs
+        assert attrs["batch_size"] == 3
+        assert attrs["batch_bucket"] == 4
+        assert attrs["padding_rows"] == 1
+        assert attrs["padding_ratio"] == 0.25
+        assert attrs["voice"] == "pinned"
+        expected = (span.end - span.start) * attrs["padding_ratio"]
+        assert sc.padding_waste_seconds("pinned") == expected
+        assert sc.padding_waste_seconds_total == expected
+        buckets = sc.buckets_snapshot()
+        (row,) = buckets["buckets"]
+        assert (row["batch_bucket"], row["text_bucket"],
+                row["frame_bucket"]) == (4, 16, 64)
+        assert row["dispatches"] == 1
+        assert row["rows"] == 3 and row["padding_rows"] == 1
+        assert row["waste_seconds"] == round(expected, 6)
+        assert buckets["per_voice_waste_seconds"]["pinned"] == round(
+            expected, 6)
+    finally:
+        sched.shutdown()
+        scope_mod.uninstall(sc)
+
+
+def test_untraced_dispatches_still_account():
+    from sonata_tpu.synth.scheduler import BatchScheduler
+
+    sc = Scope(slos="error_rate:0.01", clock=FakeClock())
+    scope_mod.install(sc)
+    sched = BatchScheduler(_PaddingModel(), max_batch=4, max_wait_ms=0.0,
+                           trace_attrs={"voice": "untraced"})
+    try:
+        sched.speak("no trace active", timeout=10.0)
+        assert sc.dispatches_total == 1
+        assert sc.padding_waste_seconds("untraced") > 0.0
+        assert sc.quantile("dispatch", 0.5, "1m") is not None
+    finally:
+        sched.shutdown()
+        scope_mod.uninstall(sc)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_recorder_snapshots_probes_and_cap():
+    sc = Scope(slos="error_rate:0.01", timeline_cap=3, clock=FakeClock())
+    depth = {"v": 2.0}
+    sc.add_probe("queue_depth:v1", lambda: depth["v"])
+    sc.add_probe("broken", lambda: 1 / 0)
+    for i in range(5):
+        depth["v"] = float(i)
+        sc.tick()
+    snaps = sc.timeline_snapshot()
+    assert len(snaps) == 3  # bounded ring
+    assert [s["queue_depth:v1"] for s in snaps] == [2.0, 3.0, 4.0]
+    assert all("broken" not in s for s in snaps)
+    assert all("dispatches_total" in s and "degradation_level" in s
+               for s in snaps)
+    sc.remove_probe("queue_depth:v1")
+    sc.tick()
+    assert "queue_depth:v1" not in sc.timeline_snapshot()[-1]
+
+
+def test_recorder_auto_dumps_on_degradation_level_2(tmp_path):
+    sc = Scope(slos="error_rate:0.01", dump_dir=str(tmp_path),
+               clock=FakeClock())
+    ladder = degradation.DegradationLadder(
+        shed_threshold=0, watchdog_threshold=1, burn_threshold=0,
+        window_s=30.0, recover_s=600.0)
+    degradation.install(ladder)
+    try:
+        sc.tick()  # level 0: no dump
+        assert sc.dumps == []
+        ladder.record_watchdog()  # -> level 1
+        sc.tick()
+        assert sc.dumps == []  # level 1 is not an incident yet
+        ladder.record_watchdog()  # -> level 2
+        sc.tick()
+        assert len(sc.dumps) == 1
+        dump = json.loads((tmp_path / sc.dumps[0].split("/")[-1])
+                          .read_text())
+        assert dump["reason"] == "degradation-level-2"
+        # the last snapshot shows the pressure that triggered the dump
+        assert dump["snapshots"][-1]["degradation_level"] == 2
+        # a repeat escalation within the rate limit does not re-dump
+        sc.tick()
+        assert len(sc.dumps) == 1
+    finally:
+        degradation.uninstall(ladder)
+
+
+def test_watchdog_incident_dumps_and_rate_limits(tmp_path):
+    clock = FakeClock()
+    sc = Scope(slos="error_rate:0.01", dump_dir=str(tmp_path),
+               clock=clock)
+    sc.tick()
+    scope_mod.install(sc)
+    try:
+        scope_mod.note_watchdog()
+        assert len(sc.dumps) == 1 and "watchdog" in sc.dumps[0]
+        scope_mod.note_watchdog()  # inside the 30 s rate limit
+        assert len(sc.dumps) == 1
+        clock.advance(31.0)
+        scope_mod.note_watchdog()
+        assert len(sc.dumps) == 2
+    finally:
+        scope_mod.uninstall(sc)
+
+
+def test_recorder_thread_ticks():
+    sc = Scope(slos="error_rate:0.01", tick_interval_s=0.05)
+    sc.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while not sc.timeline_snapshot() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sc.timeline_snapshot(), "ticker never produced a snapshot"
+    finally:
+        sc.close()
+    assert sc._ticker is None
+
+
+# ---------------------------------------------------------------------------
+# 4. the debug HTTP plane (404 gate + payloads) and /metrics families
+# ---------------------------------------------------------------------------
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+            return resp.getcode(), resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_debug_endpoints_404_without_scope():
+    server = start_http_server(MetricsRegistry(), port=0)
+    try:
+        for path in ("/debug/quantiles", "/debug/buckets",
+                     "/debug/timeline"):
+            code, body = _get(server.port, path)
+            assert code == 404, (path, code)
+            assert "scope not enabled" in body
+    finally:
+        server.stop()
+
+
+def test_debug_endpoints_serve_scope_state():
+    sc = Scope(slos="ttfb:p95:2s,error_rate:0.01", clock=FakeClock())
+    sc.observe("ttfb", 0.1)
+    sc.observe("ttfb", 3.0)
+    sc.note_dispatch(0.1, {"batch_bucket": 8, "text_bucket": 32,
+                           "frame_bucket": 128, "rows": 6,
+                           "padding_rows": 2, "padding_ratio": 0.25,
+                           "compile": "cold", "voice": "v1"})
+    sc.tick()
+    server = start_http_server(MetricsRegistry(), port=0, scope=sc)
+    try:
+        code, body = _get(server.port, "/debug/quantiles")
+        assert code == 200
+        q = json.loads(body)
+        assert q["windows"] == ["1m", "5m", "1h"]
+        assert q["stages"]["ttfb"]["1m"]["count"] == 2
+        slo = {s["name"]: s for s in q["slos"]}
+        assert slo["ttfb_p95"]["burn_rate"]["5m"] == pytest.approx(10.0)
+
+        code, body = _get(server.port, "/debug/buckets")
+        assert code == 200
+        b = json.loads(body)
+        assert b["dispatches_total"] == 1
+        assert b["cold_compiles_total"] == 1
+        assert b["buckets"][0]["batch_bucket"] == 8
+        assert b["per_voice_waste_seconds"]["v1"] == pytest.approx(0.025)
+
+        code, body = _get(server.port, "/debug/timeline")
+        assert code == 200
+        t = json.loads(body)
+        assert t["count"] == 1 and len(t["snapshots"]) == 1
+        assert t["snapshots"][0]["dispatches_total"] == 1
+
+        code, body = _get(server.port, "/debug/timeline?format=chrome")
+        assert code == 200
+        chrome = json.loads(body)
+        assert chrome["traceEvents"]
+        assert all(e["ph"] == "C" for e in chrome["traceEvents"])
+        names = {e["name"] for e in chrome["traceEvents"]}
+        assert "dispatches_total" in names
+    finally:
+        server.stop()
+
+
+def test_bind_metrics_exports_parseable_families():
+    registry = MetricsRegistry()
+    sc = Scope(slos="ttfb:p95:2s,error_rate:0.01", clock=FakeClock())
+    sc.bind_metrics(registry)
+    parsed = parse_prometheus_text(registry.render())
+    # empty windows: quantile series are skipped, burn series absent
+    assert "sonata_stage_quantile" not in parsed
+    sc.observe("ttfb", 0.1)
+    parsed = parse_prometheus_text(registry.render())
+    quant = {(lbl["stage"], lbl["q"], lbl["window"]): v
+             for lbl, v in parsed["sonata_stage_quantile"]}
+    assert quant[("ttfb", "p50", "1m")] == pytest.approx(0.1, rel=0.02)
+    burn = {(lbl["slo"], lbl["window"]): v
+            for lbl, v in parsed["sonata_slo_burn_rate"]}
+    assert burn[("ttfb_p95", "5m")] == 0.0
+    remaining = {lbl["slo"]: v
+                 for lbl, v in parsed["sonata_slo_budget_remaining"]}
+    assert remaining["ttfb_p95"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# structured logs carry the health context (satellite)
+# ---------------------------------------------------------------------------
+
+def _log_line(logger_name="sonata.test", msg="hello"):
+    import io
+
+    stream = io.StringIO()
+    handler = logging.StreamHandler(stream)
+    handler.addFilter(TraceContextFilter())
+    handler.setFormatter(JsonLineFormatter())
+    logger = logging.getLogger(logger_name)
+    logger.addHandler(handler)
+    logger.setLevel(logging.INFO)
+    try:
+        logger.info(msg)
+    finally:
+        logger.removeHandler(handler)
+    return json.loads(stream.getvalue())
+
+
+def test_json_logs_carry_degradation_and_slo_breach():
+    ladder = degradation.DegradationLadder(
+        shed_threshold=0, watchdog_threshold=1, burn_threshold=0,
+        window_s=30.0, recover_s=600.0)
+    degradation.install(ladder)
+    sc = Scope(slos="ttfb:p95:2s,error_rate:0.01", clock=FakeClock())
+    scope_mod.install(sc)
+    try:
+        entry = _log_line()
+        assert entry["degradation"] == 0  # level present even at normal
+        assert "slo_breach" not in entry  # flag absent while healthy
+        ladder.record_watchdog()
+        for _ in range(5):
+            sc.observe("ttfb", 30.0)  # blow the SLO
+        sc.tick()  # refresh the cached breach state
+        assert sc.slo_breach and "ttfb_p95" in sc.breached_slos
+        entry = _log_line()
+        assert entry["degradation"] == 1
+        assert entry["slo_breach"] is True
+    finally:
+        scope_mod.uninstall(sc)
+        degradation.uninstall(ladder)
+
+
+def test_logs_without_plane_installed_stay_clean():
+    entry = _log_line()
+    assert "degradation" not in entry
+    assert "slo_breach" not in entry
+
+
+def _text_log_line(msg="hello"):
+    import io
+
+    stream = io.StringIO()
+    handler = logging.StreamHandler(stream)
+    handler.addFilter(TraceContextFilter())
+    handler.setFormatter(TextFormatter())
+    logger = logging.getLogger("sonata.test")
+    logger.addHandler(handler)
+    logger.setLevel(logging.INFO)
+    try:
+        logger.info(msg)
+    finally:
+        logger.removeHandler(handler)
+    return stream.getvalue().rstrip("\n")
+
+
+def test_text_logs_flag_degradation_and_breach_only_when_unhealthy():
+    # healthy: the familiar line, no lvl=/slo_breach noise
+    line = _text_log_line()
+    assert "lvl=" not in line and "slo_breach" not in line
+    ladder = degradation.DegradationLadder(
+        shed_threshold=0, watchdog_threshold=1, burn_threshold=0,
+        window_s=30.0, recover_s=600.0)
+    degradation.install(ladder)
+    sc = Scope(slos="ttfb:p95:2s", clock=FakeClock())
+    scope_mod.install(sc)
+    try:
+        ladder.record_watchdog()
+        for _ in range(5):
+            sc.observe("ttfb", 30.0)
+        sc.tick()
+        line = _text_log_line()
+        assert "lvl=1" in line and "slo_breach" in line
+    finally:
+        scope_mod.uninstall(sc)
+        degradation.uninstall(ladder)
+
+
+# ---------------------------------------------------------------------------
+# concurrency sanity: feeds from several threads stay consistent
+# ---------------------------------------------------------------------------
+
+def test_concurrent_observation_counts():
+    sc = Scope(slos="error_rate:0.01", clock=FakeClock())
+    n, threads = 200, []
+
+    def feed(i):
+        for k in range(n):
+            sc.observe("e2e", 0.01 * (k % 7 + 1))
+
+    for i in range(4):
+        threads.append(threading.Thread(target=feed, args=(i,)))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sc._stages["e2e"]["1h"].merged().count == 4 * n
+
+
+def test_merged_races_concurrent_adds():
+    # merged() must fold the live write slot under the ring lock: doing
+    # it unlocked races QuantileSketch._bins iteration against add()'s
+    # insertions and raised "dictionary keys changed during iteration"
+    # on real scrape traffic (review-pass fix, pinned)
+    rolling = RollingSketch(60.0, 12)
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        k = 0
+        while not stop.is_set():
+            rolling.add(0.001 * (k % 997 + 1))
+            k += 1
+
+    def reader():
+        try:
+            while not stop.is_set():
+                rolling.merged().quantile(0.99)
+        except RuntimeError as exc:  # pragma: no cover - the regression
+            errors.append(exc)
+
+    threads = ([threading.Thread(target=writer) for _ in range(4)]
+               + [threading.Thread(target=reader) for _ in range(2)])
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert rolling.merged().count > 0

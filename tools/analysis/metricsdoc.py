@@ -4,8 +4,11 @@ Two invariants:
 
 - **documented → registered**: every ``sonata_*`` series name in the
   operator docs must correspond to a metric family the code actually
-  registers (literal names, or the ``f"sonata_pool_{key}"`` family
-  patterns).  Histogram sub-series suffixes (``_bucket``/``_sum``/
+  registers — literal names, the ``f"sonata_pool_{key}"`` family
+  patterns, or names flowing through a loop variable from a literal
+  family table (``for name, help in GAUGE_FAMILIES:
+  registry.gauge(name, ...)``, the scope.py registration idiom).
+  Histogram sub-series suffixes (``_bucket``/``_sum``/
   ``_count``) and doc prefixes (``sonata_ttfb`` as shorthand for
   ``sonata_ttfb_seconds``) resolve against the registered families.
 - **register ↔ unregister symmetry**: per-voice series created by a
@@ -83,6 +86,67 @@ def walk_functions_all(ctx: AnalysisContext):
             yield fn
 
 
+def _literal_elements(node: ast.AST, consts: Dict[str, ast.AST]):
+    """Elements of a tuple/list literal, resolving a bare/attribute name
+    through the module-level constant table (``GAUGE_FAMILIES``-style)."""
+    if isinstance(node, ast.Name):
+        node = consts.get(node.id)
+    elif isinstance(node, ast.Attribute):  # module.CONST
+        node = consts.get(node.attr)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return node.elts
+    return None
+
+
+def _loop_bound_names(tree: ast.Module,
+                      consts: Dict[str, ast.AST]) -> Dict[str, set]:
+    """Loop variables bound to literal family-name tables.
+
+    Resolves the scope.py registration idiom — ``for name, help in
+    FAMILIES: registry.gauge(name, help)`` — by mapping each ``for``
+    target that iterates a literal tuple/list (directly or through a
+    module-level constant) to the string constants it takes:
+    ``for name in ("sonata_a", ...)`` binds whole elements; ``for name,
+    help in (("sonata_a", "..."), ...)`` binds each element's first
+    item.  Only ``sonata_``-prefixed strings are kept.
+    """
+    bound: Dict[str, set] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.For, ast.comprehension)):
+            continue
+        elements = _literal_elements(node.iter, consts)
+        if elements is None:
+            continue
+        target = node.target
+        if isinstance(target, ast.Tuple) and target.elts \
+                and isinstance(target.elts[0], ast.Name):
+            name = target.elts[0].id
+            values = [e.elts[0] for e in elements
+                      if isinstance(e, (ast.Tuple, ast.List)) and e.elts]
+        elif isinstance(target, ast.Name):
+            name = target.id
+            values = list(elements)
+        else:
+            continue
+        strings = {v.value for v in values
+                   if isinstance(v, ast.Constant)
+                   and isinstance(v.value, str)
+                   and v.value.startswith("sonata_")}
+        if strings:
+            bound.setdefault(name, set()).update(strings)
+    return bound
+
+
+def _module_literal_consts(tree: ast.Module) -> Dict[str, ast.AST]:
+    consts: Dict[str, ast.AST] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            consts[node.targets[0].id] = node.value
+    return consts
+
+
 def registered_families(ctx: AnalysisContext
                         ) -> Tuple[Dict[str, tuple], List[str]]:
     """(literal name -> (file, line), [regex patterns])."""
@@ -90,6 +154,8 @@ def registered_families(ctx: AnalysisContext
     patterns: List[str] = []
     register_calls = REGISTER_CALLS | _register_wrappers(ctx)
     for rel, mod in ctx.modules.items():
+        consts = _module_literal_consts(mod.tree)
+        loop_bound = _loop_bound_names(mod.tree, consts)
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call) or not node.args:
                 continue
@@ -103,6 +169,11 @@ def registered_families(ctx: AnalysisContext
                 p = _joinedstr_pattern(arg)
                 if p is not None:
                     patterns.append(p)
+            elif isinstance(arg, ast.Name):
+                # the computed-name form: the argument is a loop
+                # variable drawing from a literal family table
+                for name in loop_bound.get(arg.id, ()):
+                    literals.setdefault(name, (rel, node.lineno))
     return literals, patterns
 
 

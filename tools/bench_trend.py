@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Fold the per-revision bench artifacts into one trend table.
+
+The repo accumulates one ``BENCH*_rNN.json`` per revision per bench
+family (``BENCH_rNN`` accelerator RTF, ``BENCH_STREAMING_CPU_rNN``
+streaming TTFB/throughput/overhead, ``BENCH_CPU_rNN`` lowering A/Bs),
+but nothing reads them *across* revisions — a slow 10% drift per PR is
+invisible until someone diffs artifacts by hand.  This tool:
+
+1. parses every ``BENCH*_r*.json`` at the repo root into
+   ``{family: {metric: {rev: value}}}``;
+2. flags any metric that regressed **> 20%** against the immediately
+   preceding revision (direction-aware: TTFB/RTF/overhead down is
+   good, audio-throughput up is good; metrics with no known direction
+   are reported but never flagged);
+3. writes the machine-readable fold to ``BENCH_TREND.json`` (committed
+   like the per-rev artifacts) and prints one markdown table per
+   family.
+
+Run: ``python tools/bench_trend.py`` (wired into tools/run_ci_local.sh
+as a *reported, non-blocking* step).  Exit code: 0 clean, 2 when a
+regression was flagged — informational for CI, gating for nobody.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional
+
+REPO = Path(__file__).resolve().parent.parent
+TREND_PATH = REPO / "BENCH_TREND.json"
+REGRESSION_THRESHOLD = 0.20
+
+_REV_RE = re.compile(r"^(BENCH[A-Z_]*)_r(\d+)\.json$")
+
+#: metric-name fragments → comparison direction
+_LOWER_IS_BETTER = ("ttfb", "rtf", "overhead", "latency", "wall")
+_HIGHER_IS_BETTER = ("audio_s_per_s", "audio_seconds_per_second",
+                     "throughput", "speedup")
+
+
+def direction(metric: str) -> Optional[str]:
+    """'down' (lower better), 'up' (higher better), or None (report
+    only — e.g. coalescing ratios and booleans have no better side)."""
+    name = metric.lower()
+    if any(f in name for f in _LOWER_IS_BETTER):
+        return "down"
+    if any(f in name for f in _HIGHER_IS_BETTER):
+        return "up"
+    return None
+
+
+def _results_of(config: dict) -> List[dict]:
+    return [r for r in config.get("results", ())
+            if isinstance(r, dict) and r.get("metric")]
+
+
+def parse_artifact(path: Path) -> Dict[str, float]:
+    """One artifact → {metric: value} (None-valued rows skipped)."""
+    try:
+        data = json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return {}
+    out: Dict[str, float] = {}
+    parsed = data.get("parsed")
+    if isinstance(parsed, dict) and parsed.get("metric"):
+        if isinstance(parsed.get("value"), (int, float)):
+            out[parsed["metric"]] = float(parsed["value"])
+    configs = data.get("configs")
+    if isinstance(configs, dict):
+        only = len(configs) == 1
+        for cname, config in configs.items():
+            if not isinstance(config, dict):
+                continue
+            prefix = "" if (only or cname == "default") else f"{cname}:"
+            for row in _results_of(config):
+                if isinstance(row.get("value"), (int, float)):
+                    out[prefix + row["metric"]] = float(row["value"])
+    return out
+
+
+def collect() -> Dict[str, Dict]:
+    """{family: {"revs": [int...], "metrics": {metric: {"rN": value}}}}"""
+    families: Dict[str, Dict] = {}
+    for path in sorted(REPO.glob("BENCH*_r*.json")):
+        m = _REV_RE.match(path.name)
+        if m is None:
+            continue
+        family, rev = m.group(1), int(m.group(2))
+        metrics = parse_artifact(path)
+        if not metrics:
+            continue
+        fam = families.setdefault(family, {"revs": [], "metrics": {}})
+        fam["revs"].append(rev)
+        for metric, value in metrics.items():
+            fam["metrics"].setdefault(metric, {})[f"r{rev:02d}"] = value
+    for fam in families.values():
+        fam["revs"] = sorted(set(fam["revs"]))
+    return families
+
+
+def find_regressions(families: Dict[str, Dict]) -> List[dict]:
+    """>20% worse than the *previous rev that has the metric*."""
+    flags: List[dict] = []
+    for family, fam in families.items():
+        for metric, by_rev in fam["metrics"].items():
+            d = direction(metric)
+            if d is None:
+                continue
+            revs = sorted(by_rev)
+            for prev, cur in zip(revs, revs[1:]):
+                base, now = by_rev[prev], by_rev[cur]
+                if base == 0:
+                    continue
+                change = (now - base) / abs(base)
+                regressed = (change > REGRESSION_THRESHOLD if d == "down"
+                             else change < -REGRESSION_THRESHOLD)
+                if regressed:
+                    flags.append({
+                        "family": family, "metric": metric,
+                        "from_rev": prev, "to_rev": cur,
+                        "from": base, "to": now,
+                        "change_pct": round(change * 100.0, 1)})
+    return flags
+
+
+def _fmt(v: Optional[float]) -> str:
+    if v is None:
+        return "—"
+    if abs(v) >= 100:
+        return f"{v:.0f}"
+    return f"{v:.3g}"
+
+
+def markdown(families: Dict[str, Dict], flags: List[dict]) -> str:
+    flagged = {(f["family"], f["metric"], f["to_rev"]) for f in flags}
+    lines: List[str] = []
+    for family, fam in sorted(families.items()):
+        revs = [f"r{r:02d}" for r in fam["revs"]]
+        lines.append(f"### {family}")
+        lines.append("| metric | " + " | ".join(revs) + " |")
+        lines.append("|" + "---|" * (len(revs) + 1))
+        for metric in sorted(fam["metrics"]):
+            by_rev = fam["metrics"][metric]
+            cells = []
+            for rev in revs:
+                cell = _fmt(by_rev.get(rev))
+                if (family, metric, rev) in flagged:
+                    cell += " ⚠"
+                cells.append(cell)
+            lines.append(f"| {metric} | " + " | ".join(cells) + " |")
+        lines.append("")
+    if flags:
+        lines.append(f"**{len(flags)} regression(s) > "
+                     f"{REGRESSION_THRESHOLD:.0%} vs the prior rev:**")
+        for f in flags:
+            lines.append(
+                f"- {f['family']} `{f['metric']}` {f['from_rev']}→"
+                f"{f['to_rev']}: {_fmt(f['from'])} → {_fmt(f['to'])} "
+                f"({f['change_pct']:+.1f}%)")
+    else:
+        lines.append("No regressions > "
+                     f"{REGRESSION_THRESHOLD:.0%} between adjacent revs.")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    families = collect()
+    if not families:
+        print("bench-trend: no BENCH*_r*.json artifacts found")
+        return 0
+    flags = find_regressions(families)
+    # no generated-at timestamp: the artifact is committed, and a fresh
+    # wall-clock stamp would dirty it on every CI run even when no
+    # bench number changed — content is a pure function of the inputs
+    TREND_PATH.write_text(json.dumps({
+        "regression_threshold": REGRESSION_THRESHOLD,
+        "families": families,
+        "regressions": flags,
+    }, indent=1, sort_keys=True) + "\n", encoding="utf-8")
+    print(markdown(families, flags))
+    print(f"\nbench-trend: wrote {TREND_PATH.name} "
+          f"({len(families)} families, {len(flags)} regression flag(s))")
+    return 2 if flags else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

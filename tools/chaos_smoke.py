@@ -79,6 +79,11 @@ os.environ["SONATA_DEGRADE_SHED_THRESHOLD"] = "4"
 os.environ["SONATA_DEGRADE_WINDOW_S"] = "30"
 os.environ["SONATA_DEGRADE_WATCHDOG_THRESHOLD"] = "4"
 os.environ["SONATA_DEGRADE_RECOVER_S"] = "8"
+# flight recorder (serving/scope.py): the run must demonstrate the
+# incident auto-dump path — the watchdog conviction in phase D and the
+# ladder reaching level >= 2 in phase F each ship the preceding minutes
+TIMELINE_DIR = tempfile.mkdtemp(prefix="chaos_timeline")
+os.environ["SONATA_TIMELINE_DUMP_DIR"] = TIMELINE_DIR
 if "xla_force_host_platform_device_count" not in os.environ.get(
         "XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
@@ -353,6 +358,9 @@ def main() -> int:
     spans = {s["name"] for s in trace["spans"]} if trace else set()
     check("hung dispatch: trace shows watchdog and resubmit spans",
           {"watchdog", "resubmit"} <= spans, f"({sorted(spans)})")
+    wd_dumps = [f for f in os.listdir(TIMELINE_DIR) if "watchdog" in f]
+    check("watchdog conviction auto-dumped the flight recorder",
+          len(wd_dumps) == 1, f"({wd_dumps})")
     code, _ = http_get(base + "/readyz")
     check("readyz survives one wedged replica", code == 200)
 
@@ -482,6 +490,27 @@ def main() -> int:
     code, _ = http_get(base + "/readyz")
     check("readyz 503 at degradation level 3", code == 503,
           f"(code {code})")
+    # the ladder crossing level 2 must have auto-dumped the flight
+    # recorder, and the dump's final snapshots must show the pressure
+    # that caused it (the escalated level, and admission sheds rising)
+    time.sleep(1.5)  # one recorder tick past the crossing
+    level_dumps = sorted(f for f in os.listdir(TIMELINE_DIR)
+                         if "degradation-level" in f)
+    check("ladder level >= 2 auto-dumped the flight recorder",
+          bool(level_dumps), f"({os.listdir(TIMELINE_DIR)})")
+    if level_dumps:
+        with open(os.path.join(TIMELINE_DIR, level_dumps[-1]),
+                  encoding="utf-8") as f:
+            dump = json.load(f)
+        snaps = dump.get("snapshots", [])
+        check("dump carries the preceding snapshots", len(snaps) >= 2,
+              f"({len(snaps)} snapshots)")
+        last = snaps[-1] if snaps else {}
+        check("dump's last snapshot shows the escalated ladder",
+              last.get("degradation_level", 0) >= 2, f"({last})")
+        check("dump's snapshots show the shed pressure",
+              any(s.get("shed_total", 0) > 0 for s in snaps),
+              f"(last shed_total={last.get('shed_total')})")
     disarm_all()
     deadline = time.monotonic() + 45.0
     while ladder.current_level() > 0 and time.monotonic() < deadline:

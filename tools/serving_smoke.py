@@ -199,6 +199,46 @@ def main() -> int:
                      parsed.get("sonata_ttfb_seconds_count", []))
     check("ttfb histogram observed the request", ttfb_total >= 1)
 
+    # ---- scope aggregation plane (serving/scope.py) ----
+    quant = parsed.get("sonata_stage_quantile", [])
+    check("sonata_stage_quantile series populated", bool(quant),
+          f"({len(quant)} series)")
+    stages_seen = {lbl.get("stage") for lbl, _v in quant}
+    check("quantiles cover the e2e stage", "e2e" in stages_seen,
+          f"({sorted(stages_seen)})")
+    burn = parsed.get("sonata_slo_burn_rate", [])
+    check("sonata_slo_burn_rate series populated", bool(burn),
+          f"({len(burn)} series)")
+    check("burn windows are 5m and 1h",
+          {lbl.get("window") for lbl, _v in burn} == {"5m", "1h"})
+    check("sonata_slo_budget_remaining series populated",
+          bool(parsed.get("sonata_slo_budget_remaining")))
+    check("sonata_dispatch_padding_waste_seconds_total labeled by voice",
+          any(lbl.get("voice") == info.voice_id for lbl, _v in
+              parsed.get("sonata_dispatch_padding_waste_seconds_total",
+                         [])))
+    code, body = http_get(base + "/debug/quantiles")
+    check("/debug/quantiles is 200", code == 200)
+    qdoc = json.loads(body)
+    check("/debug/quantiles has e2e data",
+          qdoc.get("stages", {}).get("e2e", {}).get("1m", {})
+              .get("count", 0) >= 1)
+    check("/debug/quantiles reports the SLO table",
+          {s["name"] for s in qdoc.get("slos", [])} >= {"error_rate"})
+    code, body = http_get(base + "/debug/buckets")
+    check("/debug/buckets is 200 with dispatches", code == 200
+          and json.loads(body)["dispatches_total"] >= 1)
+    code, body = http_get(base + "/debug/timeline")
+    tdoc = json.loads(body) if code == 200 else {}
+    check("/debug/timeline is populated",
+          code == 200 and tdoc.get("count", 0) >= 1,
+          f"({tdoc.get('count', 0)} snapshots)")
+    snaps = tdoc.get("snapshots") or [{}]
+    check("timeline snapshots carry recorder fields",
+          all(k in snaps[-1] for k in ("ts", "dispatches_total",
+                                       "degradation_level", "in_flight")),
+          f"({sorted(snaps[-1])})")
+
     server.stop(grace=None)
     server.sonata_service.shutdown()
 
