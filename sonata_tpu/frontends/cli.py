@@ -221,13 +221,60 @@ def _numbered_output(template: str, i: int) -> str:
     return str(p.with_name(f"{p.stem}-{i}{p.suffix}"))
 
 
-def stdin_json_loop(synth: SpeechSynthesizer, args) -> None:
+def _install_signal_handlers(drain_state: dict, runtime, log=log) -> bool:
+    """SIGTERM/SIGINT drain the CLI gracefully: the in-flight request
+    finishes (and its audio is written), the stdin loop stops taking
+    new lines, then the normal teardown runs (pool drained, runtime
+    closed) — the CLI mirror of the gRPC server's rolling-restart
+    drain.  Idle (blocked on stdin between requests), the signal exits
+    immediately through the same teardown.  Returns False when not on
+    the main thread (``signal.signal`` is main-thread-only)."""
+    import signal
+    import threading
+
+    if threading.current_thread() is not threading.main_thread():
+        return False
+
+    def _handle(signum, frame):
+        name = signal.Signals(signum).name
+        if drain_state.get("drain"):
+            # second signal: the operator means NOW — escalate past the
+            # in-flight request (a wedged synthesis must not make the
+            # process un-killable short of SIGKILL)
+            log.warning("received %s again; exiting without waiting "
+                        "for the in-flight request", name)
+            raise SystemExit(1)
+        drain_state["drain"] = True
+        if runtime is not None:
+            # readiness off + the sonata_draining gauge: scrapers of a
+            # long-running stdin loop see the deploy like the server's
+            runtime.begin_drain(name)
+        log.warning("received %s; draining (in-flight request finishes, "
+                    "then exit)", name)
+        if not drain_state.get("in_request"):
+            raise SystemExit(0)  # idle: unwind into the finally teardown
+
+    signal.signal(signal.SIGTERM, _handle)
+    signal.signal(signal.SIGINT, _handle)
+    return True
+
+
+def stdin_json_loop(synth: SpeechSynthesizer, args,
+                    drain_state: dict | None = None) -> None:
     """Read one JSON ``SynthesisRequest`` per line (``main.rs:234-258``).
 
     Request schema: ``{"text": str, "output_file"?: str, "speaker_id"?: int,
     "rate"?: int, "volume"?: int, "pitch"?: int,
     "appended_silence_ms"?: int, "noise_scale"?: float,
     "length_scale"?: float, "noise_w"?: float}``.
+
+    ``drain_state``: the signal handlers' flag dict.  A SIGTERM while a
+    request is in flight stops the loop right AFTER that request — the
+    check runs at the end of each iteration, never in front of the
+    blocking stdin read (a deploy usually means no further lines ever
+    arrive, so a top-of-loop check would leave the process wedged in
+    the read until SIGKILL; a signal landing while idle-blocked on the
+    read exits through the handler's SystemExit instead).
     """
     counter = 0
     # snapshot the CLI-level baseline so one request's scales never leak
@@ -237,29 +284,43 @@ def stdin_json_loop(synth: SpeechSynthesizer, args) -> None:
         line = line.strip()
         if not line:
             continue
+        # the line is consumed: from here until its processing ends the
+        # request counts as in flight — a signal landing while the JSON
+        # is still being parsed must finish this request, not SystemExit
+        # and silently drop work already taken off stdin
+        if drain_state is not None:
+            drain_state["in_request"] = True
         try:
-            req = json.loads(line)
-            text = req["text"]
-        except (json.JSONDecodeError, KeyError) as e:
-            log.error("bad request line: %s", e)  # main.rs:252-255
-            continue
-        synth.set_fallback_synthesis_config(base_config.copy())
-        ns = argparse.Namespace(**vars(args))
-        for field in ("speaker_id", "rate", "volume", "pitch",
-                      "noise_scale", "length_scale", "noise_w"):
-            if field in req:
-                setattr(ns, field, req[field])
-        if "appended_silence_ms" in req:
-            ns.silence_ms = req["appended_silence_ms"]
-        _apply_scales(synth, ns)
-        out = req.get("output_file") or args.output
-        if out and out != "-":
-            out = _numbered_output(out, counter)
-            counter += 1
-        try:
-            process_synthesis_request(synth, ns, text, out)
-        except SonataError as e:
-            log.error("synthesis failed: %s", e)
+            try:
+                req = json.loads(line)
+                text = req["text"]
+            except (json.JSONDecodeError, KeyError) as e:
+                log.error("bad request line: %s", e)  # main.rs:252-255
+                continue
+            synth.set_fallback_synthesis_config(base_config.copy())
+            ns = argparse.Namespace(**vars(args))
+            for field in ("speaker_id", "rate", "volume", "pitch",
+                          "noise_scale", "length_scale", "noise_w"):
+                if field in req:
+                    setattr(ns, field, req[field])
+            if "appended_silence_ms" in req:
+                ns.silence_ms = req["appended_silence_ms"]
+            _apply_scales(synth, ns)
+            out = req.get("output_file") or args.output
+            if out and out != "-":
+                out = _numbered_output(out, counter)
+                counter += 1
+            try:
+                process_synthesis_request(synth, ns, text, out)
+            except SonataError as e:
+                log.error("synthesis failed: %s", e)
+        finally:
+            if drain_state is not None:
+                drain_state["in_request"] = False
+        if drain_state is not None and drain_state.get("drain"):
+            log.info("draining: stdin loop stopping after the in-flight "
+                     "request")
+            break
 
 
 def main(argv=None) -> int:
@@ -344,14 +405,24 @@ def main(argv=None) -> int:
                         lambda: pool.healthy_count() > 0)
                 runtime.health.set_ready("voice loaded")
         _apply_scales(synth, args)
+        # graceful SIGTERM/SIGINT: finish the in-flight request, stop
+        # taking stdin lines, exit through the teardown below (the CLI
+        # side of the rolling-restart drain contract)
+        drain_state: dict = {"drain": False, "in_request": False}
+        _install_signal_handlers(drain_state, runtime)
         text = args.text
         if args.input_file:
             text = Path(args.input_file).read_text(encoding="utf-8")
         try:
             if text is not None:
-                process_synthesis_request(synth, args, text, args.output)
+                drain_state["in_request"] = True
+                try:
+                    process_synthesis_request(synth, args, text,
+                                              args.output)
+                finally:
+                    drain_state["in_request"] = False
             else:
-                stdin_json_loop(synth, args)
+                stdin_json_loop(synth, args, drain_state)
         finally:
             if pool is not None:
                 pool.shutdown()

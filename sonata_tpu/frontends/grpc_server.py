@@ -55,11 +55,13 @@ from ..models import PiperVoice, from_config_path
 from ..serving import (
     Deadline,
     DeadlineExceeded,
+    Draining,
     Overloaded,
     ServingRuntime,
     faults,
     tracing,
 )
+from ..serving import warmup as serving_warmup
 from ..serving.logs import configure_logging
 from ..synth import AudioOutputConfig, SpeechSynthesizer
 from ..utils.profiling import RtfCounter
@@ -110,6 +112,11 @@ class _Voice:
 
 def _status_for(e: SonataError) -> grpc.StatusCode:
     # main.rs:47-59 mapping, extended with the serving-runtime errors
+    if isinstance(e, Draining):
+        # a deploy, not overload: UNAVAILABLE (with a "draining" detail)
+        # tells clients "retry another replica now" and keeps the
+        # degradation ladder's shed accounting clean
+        return grpc.StatusCode.UNAVAILABLE
     if isinstance(e, Overloaded):
         return grpc.StatusCode.RESOURCE_EXHAUSTED
     if isinstance(e, DeadlineExceeded):
@@ -216,6 +223,12 @@ class SonataGrpcService:
         if not request.config_path:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           "config_path is required")
+        if self.runtime.drain.draining:
+            # a voice loaded mid-drain would race the teardown that is
+            # about to close every voice — refuse typed, like admissions
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          "draining: server is shutting down for a "
+                          "restart; not loading new voices")
         vid = voice_id_for(request.config_path)
         # per-voice load lock: concurrent loads of the same path block on
         # one load instead of each importing the model (the reference holds
@@ -400,10 +413,16 @@ class SonataGrpcService:
                     # wait cost); the stack holds the slot for the body
                     # with real exception info reaching release
                     with tracing.span("admission"):
+                        # drain beats admission: a restarting process
+                        # refuses new work typed (UNAVAILABLE) BEFORE
+                        # taking a slot, so the in-flight count the
+                        # drain waits on only ever shrinks — in-flight
+                        # requests already hold their slot and finish
+                        rt.drain.raise_if_draining()
                         stack.enter_context(rt.admission.admit())
                     rt.requests.labels(rpc=rpc).inc()
                     yield from body(request, context)
-        except Overloaded as e:
+        except (Draining, Overloaded) as e:
             self._abort_sonata(context, rpc, e)
 
     def SynthesizeUtterance(self, request: pb.Utterance,
@@ -542,19 +561,99 @@ class SonataGrpcService:
         self.runtime.unregister_voice(v.voice_id)
 
     def shutdown(self) -> None:
-        """Close every loaded voice (server termination path)."""
+        """Close every loaded voice immediately (server termination
+        path; the graceful sibling is :meth:`drain`, which waits for
+        in-flight work first and then funnels into the same teardown)."""
         # same lock as the warmup's check-and-set_ready: the pair must be
         # atomic or a warmup finishing mid-shutdown could re-flip a
         # closed replica to ready
         with self._lock:
             self._draining.set()
             self.runtime.health.set_not_ready("shutting down")
+        # flag only (health is already not-ready with the pinned
+        # reason): admissions refuse typed while the teardown runs
+        self.runtime.drain.begin("shutdown")
         with self._lock:
             voices = list(self._voices.values())
             self._voices.clear()
         for v in voices:
+            if v.pool is not None:
+                # breaker resubmission / half-open probes must refuse
+                # the closing pool fast and typed, not race the teardown
+                v.pool.start_draining()
+        for v in voices:
             self._close_voice(v)
         self.runtime.close()
+
+    def drain(self, timeout_s: Optional[float] = None,
+              reason: str = "shutdown") -> bool:
+        """Graceful drain: make a rolling restart a non-event.
+
+        Runs the pinned :data:`~sonata_tpu.serving.drain.DRAIN_PHASES`
+        order — readiness off FIRST (the balancer stops routing here
+        before anything changes), new admissions refused typed
+        (UNAVAILABLE ``draining``, via :meth:`_admitted`), in-flight
+        streams and queued dispatches finish inside
+        ``SONATA_DRAIN_TIMEOUT_S``, then pool → schedulers →
+        tracer/scope → metrics plane tear down.  One structured log
+        line per phase.  Returns False when a drain/shutdown already
+        ran (first caller wins).  The caller stops the gRPC listener
+        *after* this returns, so in-flight streams keep their
+        transport until they finish.
+        """
+        rt = self.runtime
+        if not rt.begin_drain(reason):
+            return False
+        d = rt.drain
+        with self._lock:
+            # the warmup pin (PR 2) extends to this path: a lattice
+            # warmup finishing mid-drain must never re-flip readiness
+            self._draining.set()
+        d.note_phase("readiness-off")
+        # nothing else to do for this phase: _admitted consults the
+        # drain flag before taking an admission slot, so from this
+        # instant every new request fails UNAVAILABLE("draining")
+        d.note_phase("reject-admissions",
+                     in_flight=rt.admission.in_flight)
+
+        def idle() -> bool:
+            if rt.admission.in_flight > 0:
+                return False
+            with self._lock:
+                voices = list(self._voices.values())
+            return all(v.scheduler.queue_depth() == 0 for v in voices
+                       if v.scheduler is not None)
+
+        t0 = time.monotonic()
+        idle_ok = d.wait_idle(idle, timeout_s)
+        waited_ms = round((time.monotonic() - t0) * 1e3, 1)
+        d.note_phase("wait-in-flight", ok=idle_ok, waited_ms=waited_ms,
+                     stragglers=rt.admission.in_flight)
+        if not idle_ok:
+            log.error("drain: %d request(s) still in flight after the "
+                      "%gs budget; tearing down (stragglers fail typed "
+                      "when their scheduler shuts down)",
+                      rt.admission.in_flight,
+                      timeout_s if timeout_s is not None else d.timeout_s)
+        with self._lock:
+            voices = list(self._voices.values())
+            self._voices.clear()
+        for v in voices:
+            if v.pool is not None:
+                # pinned order within the phase: the pool refuses
+                # resubmission/probes BEFORE its schedulers close, so a
+                # breaker trip racing this teardown fails fast typed
+                v.pool.start_draining()
+        for v in voices:
+            self._close_voice(v)
+        d.note_phase("voices", closed=len(voices))
+        # tracer/scope (runtime.close uninstalls the ladder and closes
+        # the scope's recorder) and the metrics plane last — the scrape
+        # surface outlives everything it observes
+        rt.close()
+        d.note_phase("runtime")
+        d.note_phase("done", stragglers=rt.admission.in_flight)
+        return True
 
     def ListVoices(self, request: pb.Empty, context) -> pb.VoiceList:
         """sonata-tpu extension: catalog of loaded voices (the reference
@@ -638,14 +737,34 @@ class SonataGrpcService:
                                reason=h["reason"], version=__version__)
 
     def warmup_and_mark_ready(self) -> None:
-        """Synthesize one utterance through every loaded voice, then flip
-        readiness.  The warmup pays the XLA compile of the common
-        executables up front, so the readiness gate guarantees the first
-        real request is served at steady-state latency (rolling-restart
-        contract, docs/DEPLOY.md)."""
+        """Warm every loaded voice, then flip readiness.
+
+        Two stages per voice (rolling-restart contract, docs/DEPLOY.md
+        "Rolling restarts, drain & the warmup lattice"):
+
+        1. **calibration** — one real utterance through every replica
+          (the legacy warmup): compiles the first shapes AND feeds each
+          replica's frame estimator a real observation, so stage 2
+          enumerates frame buckets with live data, not the cold prior;
+        2. **bucket lattice** (``SONATA_WARMUP_LATTICE``, default
+          ``full``; ``off`` keeps stage 1 only) — every (batch, text,
+          frame) bucket shape compiled ahead of traffic on EVERY
+          replica, bounded by ``SONATA_WARMUP_BUDGET_S``.  Budget
+          expiry keeps readiness **false** with one loud log line: a
+          half-warm replica must not join the serving set.
+
+        Progress rides the ``sonata_warmup_progress`` gauge; completion
+        arms the scope's cold-compile containment (any later
+        ``compile=cold`` dispatch counts and dumps an incident).
+        """
         with self._lock:
             voices = list(self._voices.values())
+        progress = self.runtime.warmup_progress
+        progress.reset()
         try:
+            mode = serving_warmup.resolve_mode()
+            budget_s = serving_warmup.resolve_budget_s()
+            deadline = time.monotonic() + budget_s
             faults.fire("warmup")
             for v in voices:
                 if v.pool is not None:
@@ -653,13 +772,22 @@ class SonataGrpcService:
                     # readiness — routed warmup would warm one chip and
                     # leave the others to pay cold compiles under traffic
                     v.pool.warmup(list(v.synth.phonemize_text("Ready.")))
+                    targets = [(f"{v.voice_id}[r{r.index}]", r.model)
+                               for r in v.pool.replicas]
                 else:
                     for _audio in v.synth.synthesize_parallel("Ready."):
                         pass
+                    targets = [(v.voice_id, v.voice)]
+                if mode != "off":
+                    for label, model in targets:
+                        serving_warmup.warm_model_lattice(
+                            model, mode=mode, deadline=deadline,
+                            progress=progress, label=label)
+            progress.finish()
             # a shutdown that began while the warmup synthesized (slow
             # cold compile) must win: never flip a draining replica back
             # into the serving set.  Check and set under the same lock
-            # shutdown() uses, so the pair is atomic against it.
+            # shutdown()/drain() use, so the pair is atomic against them.
             with self._lock:
                 if self._draining.is_set():
                     log.info("warmup finished during shutdown; staying "
@@ -667,11 +795,70 @@ class SonataGrpcService:
                     return
                 self.runtime.health.set_ready(
                     f"{len(voices)} voice(s) loaded and warmed")
-            log.info("readiness: %s", self.runtime.health.reason)
+            # from here on a cold compile is a lattice-coverage hole:
+            # count it, dump an incident, fail the smoke.  Armed only
+            # when a lattice actually ran — under mode=off the legacy
+            # one-utterance warmup makes no coverage promise, so
+            # flagging every later compile would be pure noise — and
+            # scoped to the voices THIS warmup covered, so a voice
+            # loaded after readiness doesn't alarm on its first compiles
+            if mode != "off" and self.runtime.scope is not None:
+                self.runtime.scope.mark_warmup_complete(
+                    voices=[v.voice_id for v in voices])
+            log.info("readiness: %s (warmup lattice mode=%s, %s)",
+                     self.runtime.health.reason, mode,
+                     progress.snapshot())
+        except serving_warmup.WarmupBudgetExceeded as e:
+            progress.finish(failed_reason=str(e))
+            # LOUD and unready: the orchestrator keeps traffic away and
+            # retries/rolls back instead of sending users into compiles
+            log.error("warmup budget expired; readiness stays false: %s "
+                      "(progress %s)", e, progress.snapshot())
         except Exception:
+            progress.finish(failed_reason="warmup failed")
             # stay not-ready: the orchestrator keeps traffic away and
             # retries the rollout rather than sending users into compiles
             log.exception("warmup failed; readiness stays false")
+
+
+def install_signal_handlers(server, grace_s: float = 2.0) -> bool:
+    """Route SIGTERM/SIGINT into the graceful drain.
+
+    On signal: a daemon thread runs :meth:`SonataGrpcService.drain`
+    (readiness off → typed refusals → bounded in-flight wait → pinned
+    teardown) and only then stops the gRPC listener, so ``/readyz``
+    answers 503 while in-flight streams still own their transport.  A
+    second signal mid-drain skips straight to ``server.stop`` (the
+    drain already ran or is running; first caller wins).  Returns False
+    when handlers cannot be installed (not the main thread — e.g. under
+    a test runner) — the caller keeps the abrupt path.
+    """
+    import signal
+
+    service = getattr(server, "sonata_service", None)
+    if service is None:
+        return False
+    if threading.current_thread() is not threading.main_thread():
+        return False  # signal.signal is main-thread-only
+
+    def _drain_then_stop(sig_name: str) -> None:
+        try:
+            service.drain(reason=sig_name)
+        except Exception:
+            log.exception("graceful drain failed; stopping hard")
+        finally:
+            server.stop(grace=grace_s)
+
+    def _handle(signum, frame):
+        name = signal.Signals(signum).name
+        log.warning("received %s; draining gracefully (budget %gs)",
+                    name, service.runtime.drain.timeout_s)
+        threading.Thread(target=_drain_then_stop, args=(name,),
+                         name="sonata_drain", daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _handle)
+    signal.signal(signal.SIGINT, _handle)
+    return True
 
 
 # method name → (request type, response type, is_server_streaming)
@@ -856,6 +1043,10 @@ def main(argv=None) -> int:
     server.start()
     log.info("sonata-tpu gRPC server v%s listening on %s:%d",
              __version__, args.host, port)
+    # rolling restarts: SIGTERM/SIGINT drain gracefully (readiness off
+    # first, in-flight streams finish, pinned teardown order) instead
+    # of vanishing mid-stream; see docs/DEPLOY.md "Rolling restarts"
+    install_signal_handlers(server)
     try:
         if args.voice:
             # preload through the public RPC path for identical semantics
@@ -885,6 +1076,10 @@ def main(argv=None) -> int:
             # that it will serve LoadVoice immediately
             runtime = getattr(server, "sonata_runtime", None)
             if runtime is not None:  # absent on test stubs
+                # no warmup was ever owed: the progress gauge must read
+                # 1.0, not sit at 0 forever looking like a wedged boot
+                # (the documented alert is "ready but progress < 1")
+                runtime.warmup_progress.finish()
                 runtime.health.set_ready("no preloaded voices")
         server.wait_for_termination()
     except KeyboardInterrupt:

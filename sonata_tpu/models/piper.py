@@ -22,6 +22,7 @@ serialized inside the text backend.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import queue
 import threading
@@ -494,7 +495,8 @@ class PiperVoice(BaseModel):
 
     def prewarm_neighbor_buckets(self) -> None:
         """Compile the frame buckets adjacent to every cached
-        full-pipeline shape (dummy args, one blocking run each)."""
+        full-pipeline shape (one blocking :meth:`warm_shape` each — the
+        single place the dummy-argument signature lives)."""
         from ..utils.buckets import FRAME_BUCKETS as _FB
 
         for (b, t, f) in list(self._full_cache):
@@ -503,17 +505,192 @@ class PiperVoice(BaseModel):
             i = _FB.index(f)
             for nf in {_FB[max(i - 1, 0)],
                        _FB[min(i + 1, len(_FB) - 1)]} - {f}:
-                fn = self._full_fn(b, t, nf)
-                args = [self.params,
-                        jnp.zeros((b, t), jnp.int32),
-                        jnp.ones((b,), jnp.int32),
-                        jax.random.PRNGKey(0),
-                        jnp.full((b,), 0.8, jnp.float32),
-                        jnp.ones((b,), jnp.float32),
-                        jnp.full((b,), 0.667, jnp.float32)]
-                if self.multi_speaker:
-                    args.append(jnp.zeros((b,), jnp.int32))
-                jax.block_until_ready(fn(*args))
+                self.warm_shape((b, t, nf))
+
+    # ------------------------------------------------------------------
+    # bucket-lattice AOT warmup (serving/warmup.py drives this contract)
+    # ------------------------------------------------------------------
+
+    def lattice_shapes(self, mode: str = "full") -> list[tuple[int, int, int]]:
+        """Enumerate the (batch, text, frame) shapes a restart must warm.
+
+        The serving path compiles one executable per (b, t, f) bucket
+        triple (:meth:`_full_fn`); this enumerates the triples real
+        traffic can hit so the boot warmup compiles them *before*
+        readiness instead of the first unlucky request paying the
+        compile cliff (PR-4 measured cold 4556 ms vs cached 30 ms):
+
+        - text axis: every :data:`TEXT_BUCKETS` entry (any sentence
+          lands in one of them);
+        - frame axis: the RANGE of buckets the live frame estimator
+          can pick across the text bucket's id-length span (a sentence
+          in bucket 128 may hold anywhere from 97 to 128 ids, and the
+          frame estimate is linear in that length) — callers should
+          run one *real* calibration utterance first so the estimator
+          enumerates with an observed frames-per-id, not the
+          cold-start prior — plus the next bucket UP in every mode
+          (the estimator is a decaying upper bound that jumps up
+          *immediately* on a higher observation, so the first
+          post-warm sentence with a long duration draw lands there),
+          plus the bucket below the range in ``full`` mode (slow
+          downward decay under sustained traffic);
+        - batch axis: 1 (sequential / per-request dispatch), plus, in
+          ``full`` mode, the canonical coalesced batch the scheduler
+          pads multi-request groups to (if coalescing is enabled —
+          a CPU policy with max_batch 1 adds nothing).
+
+        ``minimal`` is the batch-1, estimated-bucket-only subset —
+        strictly contained in ``full``.  ``off`` returns [] (the
+        caller keeps the legacy one-utterance warmup).  Ordered
+        smallest-first so a budget expiry leaves the most common
+        shapes warm.
+        """
+        if mode == "off":
+            return []
+        batches = {1}
+        if mode == "full":
+            try:
+                kw = self.dispatch_policy.scheduler_kwargs()
+                from ..utils.buckets import canonical_dispatch_batch
+
+                canonical = canonical_dispatch_batch(kw["max_batch"])
+            except Exception:  # policy probe failure must not block boot
+                canonical = 1
+            if canonical > 1:
+                batches.add(canonical)
+        ls = float(self.get_fallback_synthesis_config().length_scale)
+        shapes: list[tuple[int, int, int]] = []
+        n_fb = len(FRAME_BUCKETS)
+        for ti, t in enumerate(TEXT_BUCKETS):
+            # shortest and longest id counts that pad to this bucket
+            lo_ids = TEXT_BUCKETS[ti - 1] + 1 if ti > 0 else 1
+            f_lo = self._estimate_frame_bucket(lo_ids * max(ls, 0.05))
+            f_hi = self._estimate_frame_bucket(t * max(ls, 0.05))
+            frames = {f_lo, f_hi}
+            if f_lo in FRAME_BUCKETS:
+                i_lo = FRAME_BUCKETS.index(f_lo)
+                # an f_hi past the table (bucket_for returns top-bucket
+                # multiples there) still needs the reachable IN-TABLE
+                # run warmed — clamping to the top keeps the range
+                # covered instead of silently skipping it
+                i_hi = (FRAME_BUCKETS.index(f_hi)
+                        if f_hi in FRAME_BUCKETS else n_fb - 1)
+                # the whole reachable range, plus one bucket up (the
+                # estimator jumps up immediately on a higher
+                # observation); full also covers one below (slow decay)
+                if mode == "full":
+                    i_lo = max(i_lo - 1, 0)
+                frames.update(
+                    FRAME_BUCKETS[i]
+                    for i in range(i_lo, min(i_hi + 2, n_fb)))
+            for b in sorted(batches):
+                for f in sorted(frames):
+                    shapes.append((b, t, f))
+        shapes.sort(key=lambda s: (s[1], s[0], s[2]))
+        return shapes
+
+    def warm_shape(self, shape: tuple[int, int, int]) -> None:
+        """Make one (b, t, f) full-pipeline shape hot before traffic.
+
+        Preferred path is the **AOT executable store**
+        (:func:`~sonata_tpu.utils.jax_cache.aot_cache_dir`): a prior
+        boot's serialized executable loads in ~0.3 s with zero
+        retracing; a cold shape compiles via
+        ``jit(...).lower().compile()`` and serializes for the next
+        boot.  Either way the executable is installed into
+        ``_full_cache`` — the exact cache real traffic dispatches
+        through (the compiled object is callable with the same
+        arguments as the jitted function, and takes params as an
+        argument, so one blob serves every voice with these dims).
+        Falls back to a dummy-argument jit call (which rides JAX's own
+        persistent compile cache) when AOT is disabled, a mesh is
+        attached, or anything in the AOT path fails.  Bypasses
+        :meth:`_infer_batch` on purpose: dummy zeros must never feed
+        :meth:`_observe_frames`, or warmup would corrupt the frame
+        estimator the lattice was enumerated with.
+        """
+        b, t, f = shape
+        with self._jit_lock:
+            if (b, t, f) in self._full_cache:
+                return  # already hot (traffic or an earlier warm)
+        args = self._dummy_full_args(b, t)
+        if self.mesh is None:
+            from ..utils.jax_cache import aot_cache_dir
+
+            aot_dir = aot_cache_dir()
+            if aot_dir is not None:
+                try:
+                    if self._warm_shape_aot(shape, args, aot_dir):
+                        return
+                except Exception as e:
+                    import logging
+
+                    logging.getLogger("sonata").warning(
+                        "AOT warm of %s failed (%s); falling back to "
+                        "jit warmup", shape, e)
+        fn = self._full_fn(b, t, f)
+        jax.block_until_ready(fn(*args))
+
+    def _dummy_full_args(self, b: int, t: int) -> list:
+        """The canonical zero-valued argument list for a (b, t, *)
+        full-pipeline executable — the ONE place the warm/prewarm dummy
+        signature lives."""
+        args = [self.params,
+                jnp.zeros((b, t), jnp.int32),
+                jnp.ones((b,), jnp.int32),
+                jax.random.PRNGKey(0),
+                jnp.full((b,), 0.8, jnp.float32),
+                jnp.ones((b,), jnp.float32),
+                jnp.full((b,), 0.667, jnp.float32)]
+        if self.multi_speaker:
+            args.append(jnp.zeros((b,), jnp.int32))
+        return args
+
+    def _aot_key(self, shape: tuple[int, int, int]) -> str:
+        """Cache key for one serialized executable: everything that
+        changes the compiled program — jax version, backend, target
+        device (a replica's executable is placed on ITS chip), model
+        dims, vocab/speaker counts, compute dtype, and the shape.
+        Params are an *argument* of the executable, so voices sharing
+        dims share blobs."""
+        device = getattr(self, "device", None)
+        parts = (jax.__version__, jax.default_backend(), str(device),
+                 repr(sorted(vars(self.hp).items())),
+                 self.config.num_symbols, self.config.num_speakers,
+                 str(self.compute_dtype), bool(self.multi_speaker),
+                 tuple(shape))
+        return hashlib.blake2b(repr(parts).encode(),
+                               digest_size=16).hexdigest()
+
+    def _warm_shape_aot(self, shape: tuple[int, int, int], args: list,
+                        aot_dir: str) -> bool:
+        """Load (or build + serialize) one shape's AOT executable and
+        install it in ``_full_cache``.  Concurrent writers race safely
+        (atomic tmp + rename); a corrupt blob raises and the caller
+        falls back to the jit path."""
+        import pickle
+
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load,
+            serialize,
+        )
+
+        b, t, f = shape
+        path = os.path.join(aot_dir, self._aot_key(shape) + ".aotx")
+        if os.path.exists(path):
+            with open(path, "rb") as fh:
+                payload, in_tree, out_tree = pickle.load(fh)
+            executable = deserialize_and_load(payload, in_tree, out_tree)
+        else:
+            fn = self._full_fn(b, t, f)
+            executable = fn.lower(*args).compile()
+            tmp = f"{path}.tmp{os.getpid()}.{threading.get_ident()}"
+            with open(tmp, "wb") as fh:
+                pickle.dump(serialize(executable), fh)
+            os.replace(tmp, path)
+        with self._jit_lock:
+            self._full_cache[(b, t, f)] = executable
+        return True
 
     # Cap on rows per device dispatch: beyond this, padding waste and
     # compile sizes grow without amortizing any more fixed latency.
@@ -1180,11 +1357,18 @@ class PiperVoice(BaseModel):
         # paid an XLA compile — the single biggest TTFB outlier cause.
         # Group-wise: one speak_batch may issue several device programs,
         # and a cold group must never be shadowed by a later cached one
+        # non-default length scales change the frame estimate, so their
+        # shapes sit OUTSIDE the warmup lattice's coverage promise —
+        # flagged here so the scope's cold-compile containment doesn't
+        # report a legitimate scaled request as a coverage regression
+        scaled = any(abs(l - sc.length_scale) > 1e-9
+                     for l in ls_host[:n_real])
         tracing.annotate_dispatch_group(
             batch_bucket=b, text_bucket=t, frame_bucket=f, rows=n_real,
             padding_rows=b - n_real,
             padding_ratio=round((b - n_real) / b, 3),
-            compile="cached" if cached else "cold")
+            compile="cached" if cached else "cold",
+            **({"scaled": True} if scaled else {}))
         out = self._full_fn(b, t, f)(*args)  # async dispatch
         self._prefetch_to_host(out)
         return {"out": out, "args": args, "b": b, "t": t, "f": f,

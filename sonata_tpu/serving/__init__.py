@@ -40,9 +40,11 @@ from typing import Optional
 from . import degradation as degradation_mod
 from . import faults, tracing
 from . import scope as scope_mod
+from . import warmup as warmup_mod
 from .admission import AdmissionController, Overloaded
 from .deadlines import Deadline, DeadlineExceeded, default_timeout_s
 from .degradation import DegradationLadder
+from .drain import DrainCoordinator, Draining
 from .faults import InjectedFault
 from .health import HealthState
 from .metrics import (
@@ -61,6 +63,8 @@ __all__ = [
     "Deadline",
     "DeadlineExceeded",
     "DegradationLadder",
+    "DrainCoordinator",
+    "Draining",
     "InjectedFault",
     "default_timeout_s",
     "degradation_mod",
@@ -78,6 +82,7 @@ __all__ = [
     "Trace",
     "Tracer",
     "tracing",
+    "warmup_mod",
 ]
 
 
@@ -148,6 +153,25 @@ class ServingRuntime:
         r.gauge("sonata_uptime_seconds", "Seconds since runtime start."
                 ).set_function(
             lambda: time.monotonic() - self._started_at)
+        #: graceful drain (ISSUE 9): the process-wide drain flag + phase
+        #: log + bounded in-flight wait; frontends' admission paths
+        #: consult it so new work mid-drain fails typed (UNAVAILABLE,
+        #: never RESOURCE_EXHAUSTED — a deploy is not overload)
+        self.drain = DrainCoordinator()
+        r.gauge(
+            "sonata_draining",
+            "1 while the process is draining for a restart (readiness "
+            "off, new admissions refused typed), else 0."
+        ).set_function(lambda: 1.0 if self.drain.draining else 0.0)
+        #: bucket-lattice warmup progress (ISSUE 9): 0 → 1 as the boot
+        #: warmup compiles its enumerated shapes; a gauge stuck below
+        #: 1.0 is a wedged or over-budget warmup
+        self.warmup_progress = warmup_mod.WarmupProgress()
+        r.gauge(
+            "sonata_warmup_progress",
+            "Bucket-lattice warmup progress (0 at boot, done/total "
+            "while compiling, 1 once warm; readiness waits for it)."
+        ).set_function(self.warmup_progress.fraction)
         #: graceful-degradation ladder: admission sheds feed it directly;
         #: deep layers (scheduler queue-full, pool no-healthy, watchdog)
         #: feed the process-global install.  The gauge read doubles as
@@ -191,6 +215,17 @@ class ServingRuntime:
         #: per-voice flight-recorder probes added by register_voice, so
         #: unregister removes exactly what was added
         self._voice_probes: dict = {}
+
+    # -- graceful drain ------------------------------------------------------
+    def begin_drain(self, reason: str = "shutdown") -> bool:
+        """Enter the drain state: readiness flips off FIRST (the load
+        balancer stops routing here before anything tears down), then
+        the admission paths refuse new work typed.  First caller wins;
+        returns whether this call started the drain."""
+        first = self.drain.begin(reason)
+        if first:
+            self.health.set_not_ready(f"draining: {reason}")
+        return first
 
     # -- deadlines -----------------------------------------------------------
     def deadline_for(self, context=None) -> Deadline:
@@ -284,6 +319,18 @@ class ServingRuntime:
             waste.labels(**lbl).set_function(
                 lambda v=voice_id: self.scope.padding_waste_seconds(v))
             owned.append((waste, lbl))
+            # cold-compile containment: compiles AFTER warmup completion
+            # are lattice-coverage regressions — zero under smoke
+            # traffic is the acceptance bar, and any nonzero value also
+            # ships a flight-recorder incident
+            cold = r.counter(
+                "sonata_runtime_cold_compiles_total",
+                "Device dispatches that paid an XLA compile after the "
+                "boot warmup completed (warmup-lattice coverage holes), "
+                "per voice.")
+            cold.labels(**lbl).set_function(
+                lambda v=voice_id: self.scope.runtime_cold_compiles(v))
+            owned.append((cold, lbl))
         if scheduler is not None:
             voice_gauge("sonata_scheduler_queue_depth",
                         "Items waiting in the batch scheduler, per voice.",

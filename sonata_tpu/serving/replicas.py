@@ -58,6 +58,7 @@ from ..utils.profiling import QUEUE_WAIT_BUCKETS_S, Histogram
 from . import degradation, faults, tracing
 from .admission import Overloaded
 from .deadlines import Deadline, DeadlineExceeded
+from .drain import Draining
 
 log = logging.getLogger("sonata.serving")
 
@@ -300,6 +301,10 @@ class ReplicaPool:
             else _env_float(PROBE_MAX_ENV, DEFAULT_PROBE_MAX_S)))
         self._lock = threading.RLock()
         self._closed = False
+        #: drain state (terminal, always followed by shutdown): the pool
+        #: refuses new submits, breaker resubmission, and half-open
+        #: probe rebuilds FAST and TYPED instead of racing the teardown
+        self._draining = False
         self._on_health_change = on_health_change
         #: pool-level counters (replica-level ones live on each Replica)
         self.stats = {"routed": 0, "resubmitted": 0, "failed": 0,
@@ -342,6 +347,10 @@ class ReplicaPool:
         """
         if self._closed:
             raise OperationError("replica pool is shut down")
+        if self._draining:
+            raise Draining(
+                f"draining: replica pool {self.name!r} is shutting down "
+                "for a restart; not accepting new work")
         outer: "Future" = Future()
         with self._lock:
             self.stats["routed"] += 1
@@ -424,6 +433,28 @@ class ReplicaPool:
             agg["healthy_replicas"] = self._healthy_count_locked()
         return agg
 
+    def start_draining(self) -> None:
+        """Enter the drain state ahead of :meth:`shutdown` (the frontend
+        calls this once its in-flight wait is over, just before voice
+        teardown).  From here on: new submits, breaker resubmission, and
+        half-open probe rebuilds all refuse fast with a typed
+        :class:`~sonata_tpu.serving.drain.Draining` — a breaker trip
+        racing the teardown must not feed work into a closing scheduler,
+        and a probe must not build a worker thread nobody will join.
+        Queued and in-flight dispatches are untouched; they finish (or
+        fail out) through their schedulers as usual."""
+        with self._lock:
+            if self._draining or self._closed:
+                return
+            self._draining = True
+        log.info("pool %s: draining (no new submits, no resubmission, "
+                 "no probe rebuilds)", self.name)
+        self._probe_wake.set()  # the prober exits instead of rebuilding
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
     def shutdown(self) -> None:
         """Drain the whole pool: every replica's scheduler shuts down and
         fails its queued work (no resubmission — the pool is closing)."""
@@ -431,6 +462,7 @@ class ReplicaPool:
             if self._closed:
                 return
             self._closed = True
+            self._draining = True
         self._probe_wake.set()
         for r in self.replicas:
             r.scheduler.shutdown()
@@ -448,6 +480,7 @@ class ReplicaPool:
     def snapshot(self) -> dict:
         with self._lock:
             return {"name": self.name, "closed": self._closed,
+                    "draining": self._draining,
                     "healthy": self._healthy_count_locked(),
                     "stats": dict(self.stats),
                     "replicas": [r.snapshot() for r in self.replicas]}
@@ -518,12 +551,20 @@ class ReplicaPool:
                 return
             except OperationError as e:
                 self._release(replica)
-                if "shut down" in str(e) and not self._closed:
+                if ("shut down" in str(e) and not self._closed
+                        and not self._draining):
                     # raced a concurrent breaker-open drain on this
                     # replica: no dispatch happened, so retrying another
                     # replica does not spend the resubmit budget
                     tried.append(replica)
                     continue
+                if self._draining:
+                    # the teardown is what closed the scheduler under
+                    # us: surface the drain, not the raced internals
+                    self._fail(outer, Draining(
+                        f"draining: replica pool {self.name!r} is "
+                        f"shutting down ({type(e).__name__}: {e})"))
+                    return
                 self._fail(outer, e)
                 return
             break
@@ -548,6 +589,16 @@ class ReplicaPool:
         except Exception as e:
             # replica-fault path (device dispatch error, or the replica
             # was drained under us): fail over — once
+            if self._draining:
+                # drain-vs-resubmission race class: a breaker trip while
+                # the pool is draining must NOT resubmit into a closing
+                # scheduler — fail fast and typed so the client (and the
+                # ladder) sees a deploy, not a fault or overload
+                self._fail(outer, Draining(
+                    f"draining: replica pool {self.name!r} is shutting "
+                    f"down; not resubmitting after "
+                    f"{type(e).__name__}: {e}"))
+                return
             if (resubmits_left > 0 and not self._closed
                     and (deadline is None or deadline.alive())):
                 now = time.monotonic()
@@ -725,6 +776,14 @@ class ReplicaPool:
         """Flip OPEN replicas to HALF_OPEN once their probe time comes;
         the router then hands each exactly one trial request."""
         while not self._closed:
+            if self._draining:
+                # a draining pool never comes back from OPEN: building a
+                # fresh scheduler now would orphan its worker thread in
+                # the teardown (the drain-vs-probe race class).  The
+                # drain is terminal, so the prober simply exits.
+                log.info("pool %s: probe loop exiting (pool draining)",
+                         self.name)
+                return
             with self._lock:
                 due = [r for r in self.replicas
                        if r.state == OPEN and r.next_probe_at is not None]
@@ -736,10 +795,10 @@ class ReplicaPool:
                 self._probe_wake.clear()
                 continue
             with self._lock:
-                if self._closed:
-                    # shutdown() may have drained the replicas between
-                    # our loop check and here — installing a fresh
-                    # scheduler now would leak its worker thread
+                if self._closed or self._draining:
+                    # shutdown()/start_draining() may have raced the
+                    # wait above — installing a fresh scheduler now
+                    # would leak its worker thread
                     return
                 now = time.monotonic()
                 ripe = []
@@ -785,9 +844,10 @@ class ReplicaPool:
             changed = False
             with self._lock:
                 for r, sched in fresh:
-                    if self._closed or r.state != OPEN:
-                        # raced shutdown() (or an operator state change):
-                        # installing now would leak the worker thread
+                    if self._closed or self._draining or r.state != OPEN:
+                        # raced shutdown()/start_draining() (or an
+                        # operator state change): installing now would
+                        # leak the worker thread
                         self._drain_off_thread(sched, r.index)
                         continue
                     # the old scheduler was drained at trip time

@@ -286,6 +286,19 @@ class Scope:
         self.dispatches_total = 0
         self.padding_waste_seconds_total = 0.0
         self.cold_compiles_total = 0
+        #: cold-compile containment (ISSUE 9): once the boot warmup
+        #: marks itself complete, any further ``compile=cold`` dispatch
+        #: is a lattice-coverage regression — counted per voice
+        #: (``sonata_runtime_cold_compiles_total``) and shipped as a
+        #: flight-recorder incident, so it cannot land silently.
+        #: ``_warmed_voices`` scopes the promise: None arms every voice
+        #: (tests / single-voice processes); a set arms exactly the
+        #: voices the boot warmup covered, so a voice legitimately
+        #: loaded AFTER readiness does not false-alarm on its first
+        #: compiles.
+        self._warmup_complete = False
+        self._warmed_voices: Optional[frozenset] = None
+        self._runtime_cold: Dict[str, int] = {}
 
         # flight recorder
         self._timeline: "deque[dict]" = deque(maxlen=max(self.timeline_cap,
@@ -382,12 +395,34 @@ class Scope:
         key = (attrs.get("batch_bucket"), attrs.get("text_bucket"),
                attrs.get("frame_bucket"))
         waste = duration_s * float(ratio) if ratio is not None else 0.0
+        runtime_cold = False
         with self._bucket_lock:
             self.dispatches_total += 1
             if cold:
                 self.cold_compiles_total += 1
-            if ratio is None:
-                return  # a model that never annotated (no bucket story)
+                # `scaled` = a non-default length scale changed the
+                # frame estimate: that shape was never in the lattice's
+                # coverage promise, so its compile is expected work,
+                # not a regression
+                if (self._warmup_complete
+                        and not attrs.get("scaled")
+                        and (self._warmed_voices is None
+                             or voice in self._warmed_voices)):
+                    runtime_cold = True
+                    v = voice if voice is not None else ""
+                    self._runtime_cold[v] = self._runtime_cold.get(v, 0) + 1
+        if runtime_cold:
+            # a compile AFTER warmup completion means the lattice missed
+            # a shape real traffic hits: loud log + incident dump (the
+            # preceding minutes show which traffic found the hole)
+            log.error(
+                "runtime cold compile after warmup completion "
+                "(voice=%s bucket=%s): the warmup lattice does not "
+                "cover this shape", voice, key)
+            self.note_incident("cold-compile")
+        if ratio is None:
+            return  # a model that never annotated (no bucket story)
+        with self._bucket_lock:
             self.padding_waste_seconds_total += waste
             if voice is not None:
                 self._voice_waste[voice] = (
@@ -409,6 +444,35 @@ class Scope:
     def padding_waste_seconds(self, voice: str) -> float:
         with self._bucket_lock:
             return self._voice_waste.get(voice, 0.0)
+
+    # -- cold-compile containment ---------------------------------------------
+    def mark_warmup_complete(self, voices=None) -> None:
+        """The boot warmup finished: from here on, a ``compile=cold``
+        dispatch counts as a runtime cold compile (a lattice-coverage
+        hole) and lands a flight-recorder incident.  ``voices`` scopes
+        the promise to the voice ids the lattice actually covered —
+        a voice loaded via LoadVoice *after* readiness made no coverage
+        promise, and its legitimate first compiles must not alarm.
+        None (the default) arms every voice."""
+        with self._bucket_lock:
+            self._warmup_complete = True
+            self._warmed_voices = (None if voices is None
+                                   else frozenset(voices))
+
+    @property
+    def warmup_complete(self) -> bool:
+        with self._bucket_lock:
+            return self._warmup_complete
+
+    def runtime_cold_compiles(self, voice: str) -> float:
+        """Cold compiles after warmup completion, per voice (the
+        ``sonata_runtime_cold_compiles_total`` callback)."""
+        with self._bucket_lock:
+            return float(self._runtime_cold.get(voice, 0))
+
+    def runtime_cold_compiles_total(self) -> int:
+        with self._bucket_lock:
+            return sum(self._runtime_cold.values())
 
     # -- quantile / SLO queries ----------------------------------------------
     def _merged(self, stage: str, window: str) -> QuantileSketch:
@@ -496,6 +560,8 @@ class Scope:
             snap["padding_waste_seconds_total"] = round(
                 self.padding_waste_seconds_total, 3)
             snap["cold_compiles_total"] = self.cold_compiles_total
+            snap["runtime_cold_compiles_total"] = sum(
+                self._runtime_cold.values())
         breached = []
         for spec in self.slos:
             burn = self.burn_rate(spec.name, FAST_WINDOW[0])
@@ -593,6 +659,9 @@ class Scope:
                     "padding_waste_seconds_total": round(
                         self.padding_waste_seconds_total, 6),
                     "cold_compiles_total": self.cold_compiles_total,
+                    "runtime_cold_compiles_total": sum(
+                        self._runtime_cold.values()),
+                    "warmup_complete": self._warmup_complete,
                     "per_voice_waste_seconds": {
                         v: round(w, 6)
                         for v, w in sorted(self._voice_waste.items())},

@@ -394,6 +394,10 @@ def annotate_dispatch_group(**attrs) -> None:
     if "padding_ratio" in attrs:
         d["padding_ratio"] = max(d.get("padding_ratio", 0.0),
                                  attrs["padding_ratio"])
+    if attrs.get("scaled"):
+        # any scaled group puts the whole dispatch outside the warmup
+        # lattice's coverage promise (cold-compile containment skips it)
+        d["scaled"] = True
 
 
 # ---------------------------------------------------------------------------

@@ -122,6 +122,33 @@ class FakeModel(BaseModel):
                 sc.length_scale if sc else None)))
         return out
 
+    # -- bucket-lattice warmup contract (serving/warmup.py) ------------------
+    #: per-shape synthetic "compile" cost; tests raise it to exercise
+    #: the SONATA_WARMUP_BUDGET_S expiry path deterministically
+    warm_delay_s: float = 0.0
+    #: the lattice a fake replica advertises — small and fixed so tests
+    #: can assert exact coverage (full ⊃ minimal, like the real voice)
+    _LATTICE_FULL = ((1, 16, 64), (1, 16, 128), (1, 32, 128),
+                     (2, 16, 64), (2, 32, 128))
+    _LATTICE_MINIMAL = ((1, 16, 64), (1, 32, 128))
+
+    def lattice_shapes(self, mode: str = "full") -> list:
+        if mode == "off":
+            return []
+        return list(self._LATTICE_MINIMAL if mode == "minimal"
+                    else self._LATTICE_FULL)
+
+    def warm_shape(self, shape) -> None:
+        self.calls.append(("warm_shape", tuple(shape)))
+        if self.warm_delay_s:
+            import time
+
+            time.sleep(self.warm_delay_s)
+
+    @property
+    def warmed_shapes(self) -> list:
+        return [c[1] for c in self.calls if c[0] == "warm_shape"]
+
     def supports_streaming_output(self) -> bool:
         return True
 

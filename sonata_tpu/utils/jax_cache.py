@@ -34,6 +34,18 @@ def pin_platform_from_env() -> str | None:
     return platform
 
 
+AOT_CACHE_ENV = "SONATA_AOT_CACHE"
+
+
+def _default_cache_dir() -> str:
+    """``SONATA_JAX_CACHE_DIR`` > ``$XDG_CACHE_HOME/sonata_jax`` >
+    ``~/.cache/sonata_jax`` (one resolution for both cache layers)."""
+    return os.environ.get("SONATA_JAX_CACHE_DIR") or os.path.join(
+        os.environ.get("XDG_CACHE_HOME")
+        or os.path.join(os.path.expanduser("~"), ".cache"),
+        "sonata_jax")
+
+
 def enable_persistent_compile_cache(min_compile_secs: float = 1.0) -> str | None:
     """Point JAX's compilation cache at a per-user directory and return it.
 
@@ -47,14 +59,38 @@ def enable_persistent_compile_cache(min_compile_secs: float = 1.0) -> str | None
     try:
         import jax
 
-        cache_dir = os.environ.get("SONATA_JAX_CACHE_DIR") or os.path.join(
-            os.environ.get("XDG_CACHE_HOME")
-            or os.path.join(os.path.expanduser("~"), ".cache"),
-            "sonata_jax")
+        cache_dir = _default_cache_dir()
         os.makedirs(cache_dir, mode=0o700, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           float(min_compile_secs))
         return cache_dir
+    except Exception:
+        return None
+
+
+def aot_cache_dir() -> str | None:
+    """Directory for serialized AOT executables (the warmup lattice's
+    fast-boot layer), or None when disabled/unavailable.
+
+    JAX's own persistent cache skips the XLA compile on a cache hit but
+    still re-traces and re-lowers every jitted shape — ~1-2 s per
+    full-pipeline shape, paid again on EVERY boot.  The AOT layer
+    serializes the *compiled executable* itself
+    (``jax.experimental.serialize_executable``), so the next boot loads
+    each shape in ~0.3 s with zero retracing.  ``SONATA_AOT_CACHE``:
+    ``0``/``off`` disables, a path overrides, unset defaults to
+    ``<jax cache dir>/aot``.  Created mode 0700 — the blobs are
+    pickles and the directory must be trusted like the XLA cache it
+    sits inside.  Returns None on any failure: an optimization, never
+    a boot blocker.
+    """
+    raw = (os.environ.get(AOT_CACHE_ENV) or "").strip()
+    if raw.lower() in ("0", "off", "false", "no"):
+        return None
+    try:
+        aot_dir = raw or os.path.join(_default_cache_dir(), "aot")
+        os.makedirs(aot_dir, mode=0o700, exist_ok=True)
+        return aot_dir
     except Exception:
         return None

@@ -25,6 +25,12 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Bucket-lattice warmup (serving/warmup.py) defaults to "full" — right
+# for production boots, tens of compiles too many for unit tests that
+# merely need readiness to flip.  Dedicated lattice tests opt back in
+# with monkeypatch.setenv("SONATA_WARMUP_LATTICE", ...).
+os.environ.setdefault("SONATA_WARMUP_LATTICE", "off")
+
 # Persistent executable cache: the suite's cost is almost entirely XLA
 # compiles of the tiny test voices (hundreds of jit shapes across
 # modules); caching them across runs cuts repeat suite time several-fold.

@@ -124,3 +124,52 @@ def test_info_flag(voice_path, capsys):
     assert info["sample_rate"] == 16000
     assert info["supports_streaming_output"] is True
     assert info["synthesis"]["length_scale"] == 1.0
+
+
+def test_stdin_loop_stops_on_drain_flag(tmp_path, voice_path, monkeypatch):
+    """ISSUE-9 CLI drain: once the signal handlers mark the drain, the
+    stdin loop stops BEFORE reading the next request — the in-flight
+    request's audio is still written, later lines are never taken."""
+    from sonata_tpu.frontends.cli import build_parser, stdin_json_loop
+    from sonata_tpu.models import from_config_path
+    from sonata_tpu.synth import SpeechSynthesizer
+
+    out = tmp_path / "drain.wav"
+    reqs = "\n".join([
+        json.dumps({"text": "Served before the drain.",
+                    "output_file": str(out)}),
+        json.dumps({"text": "Never taken.", "output_file": str(out)}),
+    ]) + "\n"
+    monkeypatch.setattr(sys, "stdin", io.StringIO(reqs))
+    voice = from_config_path(str(voice_path))
+    synth = SpeechSynthesizer(voice)
+    args = build_parser().parse_args([str(voice_path)])
+    drain_state = {"drain": False, "in_request": False}
+    real_process = sys.modules[
+        "sonata_tpu.frontends.cli"].process_synthesis_request
+
+    def process_then_drain(*a, **kw):
+        real_process(*a, **kw)
+        drain_state["drain"] = True  # the SIGTERM arrives mid-request
+
+    monkeypatch.setattr("sonata_tpu.frontends.cli."
+                        "process_synthesis_request", process_then_drain)
+    stdin_json_loop(synth, args, drain_state)
+    a0, _, _ = read_wave_file(tmp_path / "drain-0.wav")
+    assert a0.size > 0                          # request 1 finished
+    assert not (tmp_path / "drain-1.wav").exists()  # request 2 never ran
+
+
+def test_cli_signal_handlers_main_thread_only():
+    """signal.signal is main-thread-only: off the main thread the
+    installer declines instead of raising."""
+    import threading
+
+    from sonata_tpu.frontends.cli import _install_signal_handlers
+
+    results = []
+    t = threading.Thread(target=lambda: results.append(
+        _install_signal_handlers({"drain": False}, None)))
+    t.start()
+    t.join(5.0)
+    assert results == [False]

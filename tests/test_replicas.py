@@ -22,6 +22,7 @@ from sonata_tpu.serving.replicas import (
     CLOSED,
     HALF_OPEN,
     OPEN,
+    Replica,
     ReplicaPool,
     resolve_replica_count,
 )
@@ -544,3 +545,122 @@ def test_grpc_replica_pool_end_to_end(tmp_path):
     finally:
         server.stop(grace=None)
         service.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# drain-vs-resubmission race class (ISSUE 9): a breaker trip or
+# half-open probe firing while the pool is draining must refuse fast
+# and typed — no resubmission into a closing scheduler, no orphaned
+# probe-built worker thread.  All under the thread-hygiene fixture.
+# ---------------------------------------------------------------------------
+
+def test_draining_pool_refuses_new_submits_typed():
+    from sonata_tpu.serving.drain import Draining
+
+    pool = make_pool([FakeModel(), FakeModel()])
+    try:
+        pool.submit("before drain").result(timeout=30)
+        pool.start_draining()
+        assert pool.draining
+        with pytest.raises(Draining) as ei:
+            pool.submit("after drain")
+        assert "draining" in str(ei.value)
+        # typed as a deploy, not overload and not a bare shutdown error
+        assert not isinstance(ei.value, Overloaded)
+    finally:
+        pool.shutdown()
+
+
+def test_breaker_trip_during_drain_fails_fast_no_resubmission():
+    """An in-flight dispatch failing after the drain began must NOT
+    resubmit into a closing scheduler: the outer future fails fast with
+    the typed Draining, the resubmit counter stays put."""
+    from sonata_tpu.serving.drain import Draining
+
+    class GatedFailModel(FakeModel):
+        def __init__(self):
+            super().__init__()
+            self.gate = threading.Event()
+            self.entered = threading.Event()
+
+        def speak_batch(self, *args, **kwargs):
+            self.entered.set()
+            assert self.gate.wait(timeout=30)
+            raise RuntimeError("device died mid-drain")
+
+    m0, m1 = GatedFailModel(), GatedFailModel()
+    pool = make_pool([m0, m1])
+    try:
+        fut = pool.submit("doomed")
+        # the item is in flight (blocked inside speak_batch) when the
+        # drain begins; releasing the gate then fails the dispatch
+        deadline = time.monotonic() + 5.0
+        while not (m0.entered.is_set() or m1.entered.is_set()) \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert m0.entered.is_set() or m1.entered.is_set()
+        pool.start_draining()
+        m0.gate.set()
+        m1.gate.set()
+        t0 = time.monotonic()
+        with pytest.raises(Draining) as ei:
+            fut.result(timeout=30)
+        assert time.monotonic() - t0 < 5.0  # fast, not hung
+        assert "not resubmitting" in str(ei.value)
+        assert pool.stats["resubmitted"] == 0
+    finally:
+        pool.shutdown()
+
+
+def test_half_open_probe_refuses_draining_pool():
+    """A probe firing against a draining pool must not rebuild a
+    scheduler (whose worker thread nobody would join): the replica
+    stays OPEN and the prober exits — the drain is terminal."""
+    pool = make_pool([FakeModel(), FakeModel()], probe_interval_s=0.05)
+    try:
+        built = []
+        real_new = Replica._new_scheduler
+
+        def counting_new(self):
+            built.append(self.index)
+            return real_new(self)
+
+        pool.force_open(0, "test")
+        pool.start_draining()
+        built.clear()
+        for r in pool.replicas:
+            r._new_scheduler = counting_new.__get__(r)
+        with pool._lock:
+            pool.replicas[0].next_probe_at = time.monotonic()
+        pool._probe_wake.set()
+        deadline = time.monotonic() + 1.0
+        while pool._prober.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pool.replicas[0].state == OPEN  # never flipped half-open
+        assert built == []                     # no scheduler was built
+        assert not pool._prober.is_alive()     # terminal: prober exited
+    finally:
+        pool.shutdown()
+
+
+def test_route_racing_drain_surfaces_draining_not_internals():
+    """A submit callback racing start_draining + a replica drain used
+    to retry other replicas on the raw 'shut down' error; draining it
+    must surface the typed Draining instead."""
+    from sonata_tpu.serving.drain import Draining
+
+    pool = make_pool([FakeModel()])
+    try:
+        pool.start_draining()
+        # simulate the raced path directly: _route on a draining pool
+        # whose replica scheduler is already closing
+        pool.replicas[0].scheduler.shutdown()
+        from concurrent.futures import Future
+
+        outer = Future()
+        pool._route(outer, "raced", None, None, None,
+                    resubmits_left=1, exclude=())
+        with pytest.raises(Draining):
+            outer.result(timeout=5)
+    finally:
+        pool.shutdown()

@@ -46,12 +46,15 @@ def tiny_multispeaker_voice(n: int = 4, seed: int = 0) -> PiperVoice:
 
 def write_tiny_voice(dirpath, seed: int = 0, **overrides):
     """Materialize a tiny voice on disk (config JSON + npz weights);
-    returns the config path."""
+    returns the config path.  A ``model=`` override is honored in the
+    written config too (not just the in-memory params), so callers can
+    materialize larger-than-tiny voices for timing-sensitive checks."""
     import json
     from pathlib import Path
 
     from sonata_tpu.models.serialization import save_params
 
+    model_dims = dict(overrides.get("model", TINY_MODEL))
     v = tiny_voice(seed=seed, **overrides)
     dirpath = Path(dirpath)
     cfg = {
@@ -62,10 +65,10 @@ def write_tiny_voice(dirpath, seed: int = 0, **overrides):
         "num_symbols": v.config.num_symbols,
         "phoneme_id_map": v.config.phoneme_id_map,
         "model": {k: (list(x) if isinstance(x, tuple) else x)
-                  for k, x in TINY_MODEL.items()},
+                  for k, x in model_dims.items()},
     }
     cfg["model"]["resblock_dilation_sizes"] = [
-        list(d) for d in TINY_MODEL["resblock_dilation_sizes"]]
+        list(d) for d in model_dims["resblock_dilation_sizes"]]
     config_path = dirpath / "voice.onnx.json"
     config_path.write_text(json.dumps(cfg))
     save_params(dirpath / "voice.npz", v.params)

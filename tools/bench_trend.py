@@ -3,12 +3,15 @@
 
 The repo accumulates one ``BENCH*_rNN.json`` per revision per bench
 family (``BENCH_rNN`` accelerator RTF, ``BENCH_STREAMING_CPU_rNN``
-streaming TTFB/throughput/overhead, ``BENCH_CPU_rNN`` lowering A/Bs),
-but nothing reads them *across* revisions — a slow 10% drift per PR is
-invisible until someone diffs artifacts by hand.  This tool:
+streaming TTFB/throughput/overhead, ``BENCH_CPU_rNN`` lowering A/Bs)
+plus the ``WARMUP_rNN.json`` warm-restart artifact (cold/warm
+time-to-ready from the serving smoke's lattice phase — a warmup-cost
+regression is a deploy-latency regression and gets flagged like any
+other), but nothing reads them *across* revisions — a slow 10% drift
+per PR is invisible until someone diffs artifacts by hand.  This tool:
 
-1. parses every ``BENCH*_r*.json`` at the repo root into
-   ``{family: {metric: {rev: value}}}``;
+1. parses every ``BENCH*_r*.json`` / ``WARMUP_r*.json`` at the repo
+   root into ``{family: {metric: {rev: value}}}``;
 2. flags any metric that regressed **> 20%** against the immediately
    preceding revision (direction-aware: TTFB/RTF/overhead down is
    good, audio-throughput up is good; metrics with no known direction
@@ -34,10 +37,11 @@ REPO = Path(__file__).resolve().parent.parent
 TREND_PATH = REPO / "BENCH_TREND.json"
 REGRESSION_THRESHOLD = 0.20
 
-_REV_RE = re.compile(r"^(BENCH[A-Z_]*)_r(\d+)\.json$")
+_REV_RE = re.compile(r"^((?:BENCH|WARMUP)[A-Z_]*)_r(\d+)\.json$")
 
 #: metric-name fragments → comparison direction
-_LOWER_IS_BETTER = ("ttfb", "rtf", "overhead", "latency", "wall")
+_LOWER_IS_BETTER = ("ttfb", "rtf", "overhead", "latency", "wall",
+                    "time_to_ready", "cold_compiles")
 _HIGHER_IS_BETTER = ("audio_s_per_s", "audio_seconds_per_second",
                      "throughput", "speedup")
 
@@ -85,7 +89,9 @@ def parse_artifact(path: Path) -> Dict[str, float]:
 def collect() -> Dict[str, Dict]:
     """{family: {"revs": [int...], "metrics": {metric: {"rN": value}}}}"""
     families: Dict[str, Dict] = {}
-    for path in sorted(REPO.glob("BENCH*_r*.json")):
+    paths = sorted(list(REPO.glob("BENCH*_r*.json"))
+                   + list(REPO.glob("WARMUP_r*.json")))
+    for path in paths:
         m = _REV_RE.match(path.name)
         if m is None:
             continue
@@ -114,6 +120,16 @@ def find_regressions(families: Dict[str, Dict]) -> List[dict]:
             for prev, cur in zip(revs, revs[1:]):
                 base, now = by_rev[prev], by_rev[cur]
                 if base == 0:
+                    # a zero baseline has no percentage — but for a
+                    # down-is-better metric whose healthy state IS zero
+                    # (runtime_cold_compiles), any rise from 0 is the
+                    # exact regression the metric exists to catch
+                    if d == "down" and now > 0:
+                        flags.append({
+                            "family": family, "metric": metric,
+                            "from_rev": prev, "to_rev": cur,
+                            "from": base, "to": now,
+                            "change_pct": None})
                     continue
                 change = (now - base) / abs(base)
                 regressed = (change > REGRESSION_THRESHOLD if d == "down"
@@ -157,10 +173,12 @@ def markdown(families: Dict[str, Dict], flags: List[dict]) -> str:
         lines.append(f"**{len(flags)} regression(s) > "
                      f"{REGRESSION_THRESHOLD:.0%} vs the prior rev:**")
         for f in flags:
+            pct = ("rose from 0" if f["change_pct"] is None
+                   else f"{f['change_pct']:+.1f}%")
             lines.append(
                 f"- {f['family']} `{f['metric']}` {f['from_rev']}→"
                 f"{f['to_rev']}: {_fmt(f['from'])} → {_fmt(f['to'])} "
-                f"({f['change_pct']:+.1f}%)")
+                f"({pct})")
     else:
         lines.append("No regressions > "
                      f"{REGRESSION_THRESHOLD:.0%} between adjacent revs.")

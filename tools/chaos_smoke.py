@@ -30,6 +30,12 @@ robustness invariants end to end:
     stubbed out vs. the real disarmed hook stays within noise (the
     tracing ``trace_overhead`` bar from BENCH_STREAMING_CPU_r09), and
     the per-call disarmed cost is bounded.
+8.  **Rolling restart is a non-event** (ISSUE 9) — SIGTERM against the
+    loaded server mid-burst: ``/readyz`` answers 503 *before* the
+    listener closes, every in-flight stream completes with full audio,
+    a late request gets UNAVAILABLE with a ``draining`` detail (never
+    RESOURCE_EXHAUSTED, never a hang), and the shutdown-phase log lines
+    appear in the pinned DRAIN_PHASES order.
 
 Every site in ``faults.SITES`` fires at least once per run (a
 deterministic sweep tops up whatever the random schedule missed), which
@@ -84,6 +90,12 @@ os.environ["SONATA_DEGRADE_RECOVER_S"] = "8"
 # ladder reaching level >= 2 in phase F each ship the preceding minutes
 TIMELINE_DIR = tempfile.mkdtemp(prefix="chaos_timeline")
 os.environ["SONATA_TIMELINE_DUMP_DIR"] = TIMELINE_DIR
+# the smoke drives its own bucket prewarm (below); the lattice warmup
+# would re-compile dozens of shapes per replica per warmup call here
+os.environ.setdefault("SONATA_WARMUP_LATTICE", "off")
+# restart phase (H): the drain must outwait the two deliberately-slow
+# in-flight streams but never hold the smoke hostage
+os.environ.setdefault("SONATA_DRAIN_TIMEOUT_S", "20")
 if "xla_force_host_platform_device_count" not in os.environ.get(
         "XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
@@ -536,6 +548,114 @@ def main() -> int:
     check("post-unload exposition parses", "sonata_ready" in parsed)
     check("failpoint counters survive the voice",
           "sonata_failpoint_fires_total" in parsed)
+
+    # ---- phase H: rolling restart — SIGTERM drain mid-burst ----
+    # reload the voice the symmetry phase unloaded, re-warm, then SIGTERM
+    # the loaded server with streams in flight (invariant 8)
+    import logging
+    import signal
+
+    from sonata_tpu.frontends.grpc_server import install_signal_handlers
+    from sonata_tpu.serving.drain import DRAIN_PHASES
+
+    info = unary("LoadVoice", pb.VoicePath(config_path=cfg), pb.VoiceInfo)
+    voice_id = info.voice_id
+    service.warmup_and_mark_ready()
+    code, _ = http_get(base + "/readyz")
+    check("restart: readyz 200 before the SIGTERM", code == 200,
+          f"(code {code})")
+    check("restart: signal handlers install on the main thread",
+          install_signal_handlers(server))
+
+    drain_records: list = []
+
+    class _DrainLogTap(logging.Handler):
+        def emit(self, record):
+            msg = record.getMessage()
+            if msg.startswith("drain: phase="):
+                drain_records.append(msg)
+
+    tap = _DrainLogTap()
+    logging.getLogger("sonata.serving").addHandler(tap)
+
+    # two in-flight streams, slow enough (~2.5 s phonemize) that the
+    # SIGTERM lands while both hold admission slots; max_hits=2 so the
+    # late request (refused before its body runs) never burns a hit
+    arm_spec("phonemize:slow:1:2500:2")
+    in_flight_results: dict = {}
+
+    def in_flight(j):
+        in_flight_results[j] = synth(TEXTS[j], rid=f"drain-{args.seed}-{j}")
+
+    threads = [threading.Thread(target=in_flight, args=(j,))
+               for j in range(2)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 5.0
+    while runtime.admission.in_flight < 2 \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    check("restart: both streams admitted and in flight",
+          runtime.admission.in_flight == 2,
+          f"({runtime.admission.in_flight})")
+
+    os.kill(os.getpid(), signal.SIGTERM)
+
+    # readiness must drop while the listener is still serving the
+    # in-flight streams (the balancer routes away BEFORE anything dies)
+    code = None
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        code, _ = http_get(base + "/readyz")
+        if code == 503:
+            break
+        time.sleep(0.02)
+    check("restart: readyz 503 while streams still in flight",
+          code == 503 and runtime.admission.in_flight > 0,
+          f"(code {code}, in_flight {runtime.admission.in_flight})")
+    parsed = parse_prometheus_text(http_get(base + "/metrics")[1])
+    check("restart: sonata_draining gauge is 1 mid-drain",
+          parsed.get("sonata_draining", [(None, 0)])[0][1] == 1.0)
+
+    # a late request against the STILL-OPEN listener: typed UNAVAILABLE
+    # with a draining detail — not a hang, not RESOURCE_EXHAUSTED
+    _e, _t, _r, err = synth(TEXTS[2], rid=f"late-{args.seed}")
+    check("restart: late request gets UNAVAILABLE (not shed, not hang)",
+          err is not None
+          and err.code() == grpc.StatusCode.UNAVAILABLE
+          and "draining" in (err.details() or ""),
+          f"({err.code().name if err else 'ok'}: "
+          f"{(err.details() or '')[:60] if err else ''})")
+
+    for t in threads:
+        t.join(timeout=BUDGET_S)
+    ok_streams = all(
+        j in in_flight_results
+        and in_flight_results[j][3] is None
+        and in_flight_results[j][2]
+        and len(in_flight_results[j][2][0].wav_samples) > 0
+        for j in range(2))
+    check("restart: every in-flight stream completed with full audio",
+          ok_streams,
+          str({j: (r[3].code().name if r[3] else f"{len(r[2])} items")
+               for j, r in in_flight_results.items()}))
+
+    # the drain thread finishes the pinned teardown
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        phases = [p for p, _ms in runtime.drain.phases]
+        if phases and phases[-1] == "done":
+            break
+        time.sleep(0.05)
+    check("restart: drain ran to completion",
+          [p for p, _ms in runtime.drain.phases][-1:] == ["done"],
+          f"({runtime.drain.phases})")
+    logged = [line.split("phase=")[1].split()[0] for line in drain_records]
+    check("restart: shutdown-phase log lines in the pinned order",
+          logged == list(DRAIN_PHASES), f"({logged})")
+    check("restart: zero dropped in-flight requests across the drain",
+          ok_streams and not overruns, f"({overruns})")
+    logging.getLogger("sonata.serving").removeHandler(tap)
 
     server.stop(grace=None)
     service.shutdown()

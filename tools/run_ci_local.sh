@@ -16,14 +16,19 @@
 #      /debug/traces with a bounded /debug/slowest; then re-boot with a
 #      2-replica pool on 2 forced host devices and assert per-replica
 #      gauges + breaker readiness semantics + replica-attributed
-#      dispatch spans (tools/serving_smoke.py)
+#      dispatch spans; then the warm-restart phase: two subprocess
+#      boots with the bucket-lattice warmup against one persistent
+#      compile cache — second boot materially faster, zero runtime
+#      cold compiles under the traffic mix (tools/serving_smoke.py)
 #   5. "Multi-device lane" — test_replicas on a forced 4-device CPU
 #      host (the replica-pool acceptance shape), plus test_parallel on
 #      its 8-device virtual mesh (make_mesh(8) needs all 8)
 #   6. "Chaos smoke" — seeded fault injection against a live 2-replica
 #      server on the two pinned seeds (tools/chaos_smoke.py): failpoint
 #      sites, hung-dispatch watchdog + exactly-once resubmission,
-#      degradation ladder, readiness/trace/metric invariants
+#      degradation ladder, readiness/trace/metric invariants, and the
+#      SIGTERM restart drain (readyz 503 before the listener closes,
+#      in-flight streams finish, pinned shutdown-phase log order)
 #
 # The workflow's dependency-install step is intentionally skipped: this
 # environment (and any dev box that can run the suite at all) already has

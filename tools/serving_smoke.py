@@ -21,7 +21,16 @@ Checks (exit 0 only if all hold):
    devices: per-replica gauges appear in ``/metrics``, readiness
    survives one breaker-open replica (flipping only at zero healthy),
    and the traced request's dispatch span is attributed to a replica
-   and device.
+   and device;
+8. warm-restart check (ISSUE 9): two boots with the bucket-lattice
+   warmup (``SONATA_WARMUP_LATTICE=minimal``) against one populated
+   ``SONATA_JAX_CACHE_DIR`` — the second boot's time-to-ready must be
+   materially faster (the persistent compile cache carries the
+   executables), ``sonata_runtime_cold_compiles_total`` must stay 0
+   under the smoke's traffic mix on both boots, and
+   ``sonata_warmup_progress`` must read 1.0.  With
+   ``--warmup-artifact PATH`` the cold/warm numbers are written as a
+   bench-trend-foldable artifact (the committed ``WARMUP_rNN.json``).
 
 Run: ``JAX_PLATFORMS=cpu python tools/serving_smoke.py`` (used by
 tools/run_ci_local.sh and .github/workflows/ci.yml).
@@ -37,6 +46,9 @@ import urllib.request
 from pathlib import Path
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# phases 1-7 predate the lattice warmup and pin their own timings; the
+# warm-restart phase opts back in explicitly
+os.environ.setdefault("SONATA_WARMUP_LATTICE", "off")
 # small slowest-ring so the boundedness check exercises eviction (must be
 # set before sonata_tpu imports create the default tracer)
 os.environ.setdefault("SONATA_TRACE_SLOWEST", "4")
@@ -59,7 +71,78 @@ def http_get(url: str) -> tuple[int, str]:
         return e.code, e.read().decode()
 
 
-def main() -> int:
+def warm_restart_boot() -> int:
+    """Subprocess entry for the warm-restart phase: one full server
+    boot — voice load, calibration + bucket-lattice warmup, the smoke
+    traffic mix — reporting one ``WARMBOOT {json}`` line.  The cache
+    dir, lattice mode, and voice config arrive via the parent's env
+    (``SONATA_JAX_CACHE_DIR`` / ``SONATA_WARMUP_LATTICE`` /
+    ``SMOKE_VOICE_CFG``); the persistent compile cache is configured
+    BEFORE the first compile, like a real process boot."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from sonata_tpu.utils.jax_cache import enable_persistent_compile_cache
+
+    cache_dir = enable_persistent_compile_cache(0.0)
+    import json
+    import time
+
+    import grpc
+
+    from sonata_tpu.frontends import grpc_messages as pb
+    from sonata_tpu.frontends.grpc_server import create_server
+    from sonata_tpu.serving import parse_prometheus_text
+
+    cfg = os.environ["SMOKE_VOICE_CFG"]
+    server, port = create_server(0, continuous_batching=True,
+                                 metrics_port=0, request_timeout_s=60.0)
+    server.start()
+    runtime = server.sonata_runtime
+    base = f"http://127.0.0.1:{runtime.http_port}"
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    load = channel.unary_unary(
+        "/sonata_grpc.sonata_grpc/LoadVoice",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.VoiceInfo.decode)
+    synthesize = channel.unary_stream(
+        "/sonata_grpc.sonata_grpc/SynthesizeUtterance",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.SynthesisResult.decode)
+    t0 = time.monotonic()
+    info = load(pb.VoicePath(config_path=cfg))
+    server.sonata_service.warmup_and_mark_ready()
+    time_to_ready_s = time.monotonic() - t0
+    ready_code, _ = http_get(base + "/readyz")
+    # the traffic mix: single-sentence texts across several text
+    # buckets, two passes so pass 2 runs on a traffic-fed estimator
+    mix = ("Warm restart check.", "Short.",
+           "A medium sentence for the middle text bucket.",
+           "A considerably longer sentence that should land well into "
+           "one of the larger text buckets of the warmup lattice.")
+    for _pass in range(2):
+        for text in mix:
+            results = list(synthesize(pb.Utterance(
+                voice_id=info.voice_id, text=text)))
+            assert results and len(results[0].wav_samples) > 0
+    parsed = parse_prometheus_text(http_get(base + "/metrics")[1])
+    colds = sum(v for _lbl, v in parsed.get(
+        "sonata_runtime_cold_compiles_total", []))
+    progress = parsed.get("sonata_warmup_progress", [({}, 0.0)])[0][1]
+    report = {"ready": ready_code == 200,
+              "time_to_ready_s": round(time_to_ready_s, 3),
+              "progress": progress,
+              "runtime_cold_compiles": int(colds),
+              "lattice_shapes":
+                  runtime.warmup_progress.snapshot()["total"],
+              "cache_dir": cache_dir}
+    print("WARMBOOT " + json.dumps(report))
+    server.stop(grace=None)
+    server.sonata_service.shutdown()
+    return 0
+
+
+def main(args=None) -> int:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -328,6 +411,104 @@ def main() -> int:
 
     server.stop(grace=None)
     server.sonata_service.shutdown()
+
+    # ---- warm-restart phase (ISSUE 9): lattice + persistent cache ----
+    # Each boot is a real SUBPROCESS: a rolling restart is a new
+    # process, and the JAX persistent compile cache only engages when
+    # configured before the process's first compile (configuring it
+    # mid-process after earlier phases compiled is silently inert).
+    # Boot 1 runs against an initially-EMPTY SONATA_JAX_CACHE_DIR
+    # (genuinely cold, populates it); boot 2 warms from disk.
+    import json
+    import subprocess
+    import time
+
+    cache_dir = tempfile.mkdtemp(prefix="smoke_jax_cache")
+    # workers pinned to 1: the A/B below isolates the CACHE effect
+    # (XLA persistent cache + the AOT executable store, both rooted in
+    # SONATA_JAX_CACHE_DIR) on time-to-ready, so both boots must share
+    # one compile configuration — a wider cold boot would flatter the
+    # ratio.  The warm boot deserializes AOT executables instead of
+    # retracing, which is what makes the ratio robust on a noisy host.
+    boot_env = dict(os.environ,
+                    SONATA_JAX_CACHE_DIR=cache_dir,
+                    SONATA_WARMUP_LATTICE="minimal",
+                    SONATA_WARMUP_WORKERS="1",
+                    JAX_PLATFORMS="cpu",
+                    SMOKE_VOICE_CFG=cfg)
+
+    def boot(tag: str) -> dict:
+        t0 = time.monotonic()
+        p = subprocess.run(
+            [sys.executable, __file__, "--warm-restart-boot"],
+            env=boot_env, capture_output=True, text=True, timeout=600)
+        proc_s = time.monotonic() - t0
+        check(f"warm-restart[{tag}]: boot subprocess exits 0",
+              p.returncode == 0, f"(rc {p.returncode}: "
+              f"{p.stderr.strip().splitlines()[-3:] if p.stderr else ''})")
+        lines = [line for line in p.stdout.splitlines()
+                 if line.startswith("WARMBOOT ")]
+        report = json.loads(lines[-1][len("WARMBOOT "):]) if lines else {}
+        report["proc_total_s"] = round(proc_s, 3)
+        check(f"warm-restart[{tag}]: readyz 200 after lattice warmup",
+              report.get("ready") is True, f"({report})")
+        check(f"warm-restart[{tag}]: sonata_warmup_progress is 1.0",
+              report.get("progress") == 1.0, f"({report.get('progress')})")
+        check(f"warm-restart[{tag}]: sonata_runtime_cold_compiles_total "
+              "stays 0 under the traffic mix",
+              report.get("runtime_cold_compiles") == 0,
+              f"({report.get('runtime_cold_compiles')})")
+        return report
+
+    if args is None:
+        import argparse
+
+        args = argparse.Namespace(warmup_artifact=None)
+    cold = boot("cold")
+    check("warm-restart: cold boot populated the persistent cache",
+          bool(os.listdir(cache_dir)),
+          f"({len(os.listdir(cache_dir))} entries)")
+    warm = boot("warm")
+    ttr_cold = cold.get("time_to_ready_s", 0.0)
+    ttr_warm = warm.get("time_to_ready_s", 1e9)
+    n_shapes = cold.get("lattice_shapes", 0)
+    colds_cold = cold.get("runtime_cold_compiles", -1)
+    colds_warm = warm.get("runtime_cold_compiles", -1)
+    ratio = ttr_warm / max(ttr_cold, 1e-9)
+    check("warm-restart: second boot time-to-ready materially faster "
+          "(persistent compile cache)", ratio < 0.6,
+          f"(cold {ttr_cold:.1f}s -> warm {ttr_warm:.1f}s, "
+          f"ratio {ratio:.3f}, {n_shapes} lattice shapes)")
+    if args.warmup_artifact:
+        artifact = {
+            "bench": "warm_restart",
+            "host": "ci-cpu",
+            "notes": ("serving_smoke warm-restart phase: two subprocess "
+                      "boots, SONATA_WARMUP_LATTICE=minimal, "
+                      "SONATA_WARMUP_WORKERS=1 (controlled A/B), one "
+                      "shared initially-empty SONATA_JAX_CACHE_DIR "
+                      "rooting both the XLA persistent cache and the "
+                      "AOT executable store — the warm boot "
+                      "deserializes executables instead of retracing; "
+                      "traffic mix of 4 texts x 2 passes per boot; "
+                      "time_to_ready = LoadVoice -> readiness"),
+            "configs": {"warm_restart": {"results": [
+                {"metric": "time_to_ready_cold_s",
+                 "value": round(ttr_cold, 3)},
+                {"metric": "time_to_ready_warm_s",
+                 "value": round(ttr_warm, 3)},
+                {"metric": "time_to_ready_warm_over_cold",
+                 "value": round(ratio, 4)},
+                {"metric": "lattice_shapes_warmed",
+                 "value": n_shapes},
+                {"metric": "runtime_cold_compiles",
+                 "value": int(colds_cold + colds_warm)},
+            ]}}}
+        Path(args.warmup_artifact).write_text(
+            json.dumps(artifact, indent=1, sort_keys=True) + "\n",
+            encoding="utf-8")
+        print(f"smoke: wrote {args.warmup_artifact}")
+
     if failures:
         print(f"smoke: {len(failures)} FAILED: {failures}")
         return 1
@@ -336,4 +517,16 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--warmup-artifact", default=None,
+                    help="write the warm-restart cold/warm numbers to "
+                         "this path (the committed WARMUP_rNN.json); "
+                         "omitted in CI so the artifact never churns")
+    ap.add_argument("--warm-restart-boot", action="store_true",
+                    help=argparse.SUPPRESS)  # subprocess entry
+    cli_args = ap.parse_args()
+    if cli_args.warm_restart_boot:
+        sys.exit(warm_restart_boot())
+    sys.exit(main(cli_args))
