@@ -229,6 +229,107 @@ def main() -> None:
             "probe": pol.get("probe"),
         }))
 
+    # ----------------------------------------------------------------
+    # iteration-vs-dispatch A/B (SONATA_BATCH_MODE): same host, fresh
+    # voice per arm, coalescing forced ON for both (the modes differ in
+    # HOW a batch forms, not whether; the CPU default policy would give
+    # both arms per-request dispatch and measure nothing), interleaved
+    # runs at 1/4/8 streams so host noise hits both arms equally.
+    # Primary metric on this 2-vCPU host: the per-iteration padding
+    # ratio (deterministic, above noise); TTFB p50s are reported but
+    # carry the documented 2x run-to-run swing under oversubscription.
+    # ----------------------------------------------------------------
+    import os as _os
+
+    _saved_env = {k: _os.environ.get(k)
+                  for k in ("SONATA_BATCH_MODE", "SONATA_DISPATCH_POLICY")}
+    _os.environ["SONATA_DISPATCH_POLICY"] = "on"
+
+    def _set_mode(mode: str) -> None:
+        _os.environ["SONATA_BATCH_MODE"] = mode
+
+    ab_voices = {}
+    try:
+        for mode in ("dispatch", "iteration"):
+            _set_mode(mode)
+            vm = PiperVoice.random(seed=0, audio={"sample_rate": 22050,
+                                                  "quality": "high"})
+            vm.prewarm(texts=[SENTENCE], streaming=True, chunk_size=55,
+                       chunk_padding=3)
+            ab_voices[mode] = vm
+
+        def _one_run(mode: str, n: int) -> float:
+            _set_mode(mode)
+            vm = ab_voices[mode]
+            sm = SpeechSynthesizer(vm)
+
+            def first_chunk(i: int) -> float:
+                t = time.perf_counter()
+                stream = sm.synthesize_streamed(SENTENCE, chunk_size=55,
+                                                chunk_padding=3)
+                next(iter(stream))
+                dt = time.perf_counter() - t
+                for _chunk in stream:
+                    pass
+                return dt
+
+            if n == 1:
+                return first_chunk(0)
+            with concurrent.futures.ThreadPoolExecutor(n) as ex:
+                return statistics.median(ex.map(first_chunk, range(n)))
+
+        RUNS_PER_ARM = 3
+        for n in (1, 4, 8):
+            p50s = {"dispatch": [], "iteration": []}
+            for _rep in range(RUNS_PER_ARM):
+                for mode in ("dispatch", "iteration"):  # interleaved
+                    p50s[mode].append(_one_run(mode, n))
+            for mode in ("dispatch", "iteration"):
+                print(json.dumps({
+                    "metric": f"batch_mode_ab_ttfb_p50_at_{n}_streams_"
+                              f"{mode}",
+                    "value": round(
+                        statistics.median(p50s[mode]) * 1000.0, 2),
+                    "unit": "ms",
+                    "vs_baseline": None,
+                    "runs": RUNS_PER_ARM,
+                }))
+
+        def _padding_ratio(stats: dict) -> float:
+            rows = stats.get("rows", 0)
+            padded = stats.get("padded_rows", 0)
+            return round(padded / max(rows + padded, 1), 4)
+
+        ratios = {}
+        for mode in ("dispatch", "iteration"):
+            st = ab_voices[mode].dispatch_stats()
+            s = st["iteration"] if mode == "iteration" \
+                else st["stream_decode"]
+            ratios[mode] = _padding_ratio(s or {})
+            print(json.dumps({
+                "metric": f"window_decode_padding_ratio_{mode}",
+                "value": ratios[mode],
+                "unit": "padding_rows_over_total_rows",
+                "vs_baseline": None,
+                "engine_stats": s,
+            }))
+        print(json.dumps({
+            "metric": "iteration_vs_dispatch_padding_ratio",
+            "value": (round(ratios["iteration"]
+                            / max(ratios["dispatch"], 1e-9), 4)
+                      if ratios["dispatch"] else None),
+            "unit": "ratio_iteration_over_dispatch",
+            "vs_baseline": None,
+        }))
+    finally:
+        for vm in ab_voices.values():
+            vm.close()
+        for k, old in _saved_env.items():
+            if old is None:
+                _os.environ.pop(k, None)
+            else:
+                _os.environ[k] = old
+
     # replica-pool row: batched throughput fanned across one replica per
     # local device (1 on a single-chip host — the row then documents the
     # single-replica baseline; forced multi-device CPU hosts show the

@@ -152,7 +152,8 @@ class Model(Protocol):
         ...
 
     def stream_synthesis(
-        self, phonemes: str, chunk_size: int, chunk_padding: int
+        self, phonemes: str, chunk_size: int, chunk_padding: int,
+        deadline=None,
     ) -> Iterator["Audio"]:
         ...
 
@@ -191,7 +192,8 @@ class BaseModel:
         return False
 
     def stream_synthesis(
-        self, phonemes: str, chunk_size: int, chunk_padding: int
+        self, phonemes: str, chunk_size: int, chunk_padding: int,
+        deadline=None,
     ) -> Iterator["Audio"]:
         raise OperationError(
             "this model does not support streaming synthesis"

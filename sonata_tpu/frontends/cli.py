@@ -119,13 +119,18 @@ def _output_config(args) -> AudioOutputConfig | None:
                              appended_silence_ms=args.silence_ms)
 
 
-def _stream_for(synth: SpeechSynthesizer, args, text: str):
+def _stream_for(synth: SpeechSynthesizer, args, text: str,
+                deadline=None):
     cfg = _output_config(args)
     if args.mode == "lazy":
         return synth.synthesize_lazy(text, cfg)
     if args.mode == "realtime":
+        # the deadline rides into the model's streaming path: an
+        # iteration-mode resident stream carries it (expiry fails this
+        # stream alone), same contract as the gRPC realtime RPC
         return synth.synthesize_streamed(text, cfg, args.chunk_size,
-                                         args.chunk_padding)
+                                         args.chunk_padding,
+                                         deadline=deadline)
     return synth.synthesize_parallel(text, cfg)
 
 
@@ -186,7 +191,7 @@ def _process_synthesis_request(synth: SpeechSynthesizer, args, text: str,
     # construct the stream before the emit span opens: batched mode does
     # its device work here, and those spans (phonemize, encode-ids,
     # dispatch) belong to the pipeline, not to emission
-    stream = _stream_for(synth, args, text)
+    stream = _stream_for(synth, args, text, deadline=deadline)
     if out_path == "-":
         raw = sys.stdout.buffer
         with tracing.span("stream-emit"):
@@ -381,6 +386,9 @@ def main(argv=None) -> int:
                      len(pool.replicas),
                      [str(r.device) for r in pool.replicas])
         synth = SpeechSynthesizer(voice, replica_pool=pool)
+        # iteration-mode scope attribution names the voice (the CLI
+        # registers its one voice as "cli" on the metrics plane below)
+        voice.scope_voice = "cli"
         runtime = None
         if args.metrics_port is not None or os.environ.get(
                 "SONATA_METRICS_PORT"):

@@ -90,6 +90,10 @@ class _Voice:
         self.rtf_logged_at = 0  # watermark for periodic aggregate logging
         self.scheduler = None
         self.pool = None
+        # the voice id rides the iteration loop's per-iteration scope
+        # attribution (the scheduler path names the voice via
+        # trace_attrs below; the streaming path has no scheduler)
+        voice.scope_voice = voice_id
         if replicas:
             # replica pool: one device-pinned copy of the voice per chip,
             # each with its own continuous-batching scheduler; the pool
@@ -581,9 +585,19 @@ class SonataGrpcService:
                 # breaker resubmission / half-open probes must refuse
                 # the closing pool fast and typed, not race the teardown
                 v.pool.start_draining()
+            self._drain_iteration_loop(v)
         for v in voices:
             self._close_voice(v)
         self.runtime.close()
+
+    @staticmethod
+    def _drain_iteration_loop(v: _Voice) -> None:
+        """Iteration-mode streams: stop admitting new joins (refused
+        typed) while resident streams finish — the loop retires at an
+        iteration boundary instead of being hard-closed mid-iteration."""
+        start = getattr(v.voice, "start_draining", None)
+        if start is not None:
+            start()
 
     def drain(self, timeout_s: Optional[float] = None,
               reason: str = "shutdown") -> bool:
@@ -644,6 +658,7 @@ class SonataGrpcService:
                 # resubmission/probes BEFORE its schedulers close, so a
                 # breaker trip racing this teardown fails fast typed
                 v.pool.start_draining()
+            self._drain_iteration_loop(v)
         for v in voices:
             self._close_voice(v)
         d.note_phase("voices", closed=len(voices))
@@ -696,9 +711,12 @@ class SonataGrpcService:
         t0 = time.monotonic()
         stream = None
         try:
+            # the deadline rides into the streaming path: in iteration
+            # mode the resident stream carries it, so expiry fails this
+            # stream alone at an iteration boundary (peers keep riding)
             stream = v.synth.synthesize_streamed(
                 request.text, cfg, chunk_size=chunk_size,
-                chunk_padding=chunk_padding)
+                chunk_padding=chunk_padding, deadline=deadline)
             with tracing.span("stream-emit") as emit_sp:
                 first = True
                 n_chunks = 0

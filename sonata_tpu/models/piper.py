@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import hashlib
 import os
-import queue
 import threading
 import time
 from pathlib import Path
@@ -43,6 +42,16 @@ from ..core import (
     Phonemes,
 )
 from ..serving import tracing
+from ..synth.batching import (
+    BatchingCore,
+    IterationLoop,
+    WorkItem,
+    drain_pending_futures,
+    effective_batch_mode,
+    resolve_batch_mode,
+    try_set_exception,
+    try_set_result,
+)
 from ..text import text_to_phonemes
 from ..text.tashkeel import TashkeelEngine, get_default_engine
 from ..utils.buckets import (
@@ -102,6 +111,15 @@ class PiperVoice(BaseModel):
         self._dec_cache: dict = {}
         self._stream_coalescer: "Optional[_StreamDecodeCoalescer]" = None
         self._stage_coalescer: "Optional[_StreamStageCoalescer]" = None
+        #: iteration-mode engine (SONATA_BATCH_MODE=iteration): the
+        #: persistent per-device decode loop; coexists with the
+        #: dispatch-mode coalescer so the degradation ladder can force
+        #: new streams back to dispatch mode while resident ones finish
+        self._iter_decoder: "Optional[_IterationStreamDecoder]" = None
+        #: voice id the serving runtime registered this model under —
+        #: stamps the iteration loop's per-iteration scope attribution
+        #: (the scheduler path carries it via trace_attrs instead)
+        self.scope_voice: Optional[str] = None
         # backend-adaptive dispatch policy (utils/dispatch_policy): pass
         # one explicitly to pin the serving shape; None resolves lazily
         # on first use (env overrides → backend fast path → cached probe)
@@ -248,6 +266,7 @@ class PiperVoice(BaseModel):
                            else None),
             dispatch_policy=self._dispatch_policy)
         replica.device = device
+        replica.scope_voice = self.scope_voice
         return replica
 
     # ------------------------------------------------------------------
@@ -418,10 +437,16 @@ class PiperVoice(BaseModel):
         # sequential drain itself coalesces its look-ahead windows, so a
         # width can enter the cache at b=max only — and the first lone
         # straggler at that width would then pay a b=1 cold compile
-        # mid-request (the exact stall prewarm exists to prevent)
+        # mid-request (the exact stall prewarm exists to prevent).
+        # Iteration mode pads to the graduated ladder instead of the
+        # canonical pair, so every rung up to max_batch warms.
+        if isinstance(co, _IterationStreamDecoder):
+            batch_set = {b for b in BATCH_BUCKETS if b <= co._max_batch}
+        else:
+            batch_set = {1, co._max_batch}
         widths = {(k[1], k[3]) for k in seen}
         for (width, has_sid) in widths:
-            for b in {1, co._max_batch}:
+            for b in batch_set:
 
                 def warm_dec(width=width, b=b, has_sid=has_sid):
                     fn = self._decode_windows_batch_fn(width, b, has_sid)
@@ -587,7 +612,45 @@ class PiperVoice(BaseModel):
                 for f in sorted(frames):
                     shapes.append((b, t, f))
         shapes.sort(key=lambda s: (s[1], s[0], s[2]))
+        shapes.extend(self._iteration_lattice_shapes(mode))
         return shapes
+
+    def _iteration_lattice_shapes(self, mode: str) -> list:
+        """Iteration-mode window-decoder shapes, appended to the lattice
+        when ``SONATA_BATCH_MODE`` resolves to iteration.
+
+        The persistent decode loop pads each iteration to the *graduated*
+        batch ladder (1, 2, 4, ..., max) instead of dispatch mode's
+        canonical {1, max} — that is where its padding-waste win comes
+        from — so every rung x reachable window width must be warm or the
+        first mid-occupancy iteration pays a cold compile the PR-9
+        containment would rightly flag.  Tagged ``("wdec", width, batch,
+        has_sid)`` tuples; :meth:`warm_shape` understands them.
+        ``minimal`` keeps batch 1 only (single-resident-stream serving);
+        iteration-mode deployments should warm ``full``.
+        """
+        try:
+            policy = self.dispatch_policy
+            if resolve_batch_mode(policy) != "iteration":
+                return []
+            kwargs = policy.stream_decode_kwargs()
+        except Exception:  # policy probe failure must not block boot
+            return []
+        max_b = kwargs["max_batch"]
+        if max_b <= 1:
+            from ..utils.dispatch_policy import COALESCING_DEFAULTS
+
+            max_b = COALESCING_DEFAULTS["stream_decode_max_batch"]
+        ladder = [b for b in BATCH_BUCKETS if b <= max_b]
+        if mode == "minimal":
+            ladder = [1]
+        # reachable widths: chunk windows bucket through FRAME_BUCKETS
+        # and the chunk-growth schedule caps at 1024 frames plus padding,
+        # so 1536 is the largest bucket a plan can produce
+        widths = [w for w in FRAME_BUCKETS if w <= 1536]
+        has_sid = bool(self.multi_speaker)
+        return [("wdec", w, b, has_sid)
+                for w in widths for b in ladder]
 
     def warm_shape(self, shape: tuple[int, int, int]) -> None:
         """Make one (b, t, f) full-pipeline shape hot before traffic.
@@ -608,7 +671,23 @@ class PiperVoice(BaseModel):
         :meth:`_infer_batch` on purpose: dummy zeros must never feed
         :meth:`_observe_frames`, or warmup would corrupt the frame
         estimator the lattice was enumerated with.
+
+        Iteration-mode shapes (``("wdec", width, batch, has_sid)`` from
+        :meth:`_iteration_lattice_shapes`) compile the batched window
+        decoder directly — a plain jit warm riding the persistent
+        compile cache (no AOT store: the decoder program is small and
+        retraces in well under a second).
         """
+        if shape and shape[0] == "wdec":
+            _tag, width, b, has_sid = shape
+            fn = self._decode_windows_batch_fn(width, b, has_sid)
+            args = [self.params,
+                    jnp.zeros((b, width, self.hp.inter_channels),
+                              jnp.float32)]
+            if has_sid:
+                args.append(jnp.zeros((b,), jnp.int32))
+            jax.block_until_ready(fn(*args))
+            return
         b, t, f = shape
         with self._jit_lock:
             if (b, t, f) in self._full_cache:
@@ -1196,18 +1275,52 @@ class PiperVoice(BaseModel):
 
         with self._jit_lock:
             decode, stage = self._stream_coalescer, self._stage_coalescer
+            iteration = self._iter_decoder
         pol = self._dispatch_policy
+        try:
+            mode = resolve_batch_mode(pol)
+        except OperationError:
+            mode = None  # typo'd SONATA_BATCH_MODE fails at stream time
         return {"policy": pol.as_dict() if pol is not None else None,
+                "batch_mode": mode,
                 "stream_decode": view(decode),
-                "stream_stage": view(stage)}
+                "stream_stage": view(stage),
+                "iteration": view(iteration)}
 
     @property
-    def _stream_decoder(self) -> "_StreamDecodeCoalescer":
-        kwargs = self.dispatch_policy.stream_decode_kwargs()
+    def _stream_decoder(self):
+        """The active window-decode engine for NEW streams.
+
+        ``SONATA_BATCH_MODE`` (default: iteration iff the PR-1 dispatch
+        policy kept coalescing) picks between the dispatch-granular
+        coalescer and the persistent iteration loop; the degradation
+        ladder can force iteration back to dispatch at level >= 1
+        (consulted per stream, so recovery re-admits the loop with no
+        restart).  Both engines can exist at once — streams resident in
+        the loop finish there while degraded traffic takes the wave
+        path."""
+        policy = self.dispatch_policy
+        mode = effective_batch_mode(policy)
+        kwargs = policy.stream_decode_kwargs()
         with self._jit_lock:
             if self._voice_closed:
                 raise OperationError(
                     "voice is closed; streaming is unavailable")
+            if mode == "iteration":
+                if self._iter_decoder is None:
+                    # an env-forced iteration mode on a per-request
+                    # policy (batch 1) still wants a real batch axis —
+                    # the loop exists to share iterations across
+                    # streams, so take the canonical coalescing batch
+                    b = kwargs["max_batch"]
+                    if b <= 1:
+                        from ..utils.dispatch_policy import (
+                            COALESCING_DEFAULTS)
+
+                        b = COALESCING_DEFAULTS["stream_decode_max_batch"]
+                    self._iter_decoder = _IterationStreamDecoder(
+                        self, max_batch=b)
+                return self._iter_decoder
             if self._stream_coalescer is None:
                 self._stream_coalescer = _StreamDecodeCoalescer(
                     self, **kwargs)
@@ -1225,6 +1338,19 @@ class PiperVoice(BaseModel):
                     self, **kwargs)
             return self._stage_coalescer
 
+    def start_draining(self) -> None:
+        """Graceful-drain hook (the frontends call this alongside
+        ``ReplicaPool.start_draining`` before voice teardown): the
+        iteration loop stops admitting NEW stream joins — refused typed
+        ``draining`` — while resident streams keep their riders until
+        they finish; the loop then exits at an iteration boundary.  The
+        dispatch-mode coalescers need no equivalent (they hold no
+        resident state; close() drains them).  Idempotent."""
+        with self._jit_lock:
+            iteration = self._iter_decoder
+        if iteration is not None:
+            iteration.start_draining()
+
     def close(self) -> None:
         """Unload the voice: stop the coalescer threads and fail their
         queued work.
@@ -1241,10 +1367,13 @@ class PiperVoice(BaseModel):
             self._voice_closed = True
             decoder, self._stream_coalescer = self._stream_coalescer, None
             stages, self._stage_coalescer = self._stage_coalescer, None
+            iteration, self._iter_decoder = self._iter_decoder, None
         if decoder is not None:
             decoder.close()
         if stages is not None:
             stages.close()
+        if iteration is not None:
+            iteration.close()
 
     def _pad_batch(self, ids_list: list[list[int]]):
         """Pad a sentence batch to (batch, text) buckets.
@@ -1420,7 +1549,13 @@ class PiperVoice(BaseModel):
     # ------------------------------------------------------------------
 
     def stream_synthesis(self, phonemes: str, chunk_size: int,
-                         chunk_padding: int) -> Iterator[Audio]:
+                         chunk_padding: int,
+                         deadline=None) -> Iterator[Audio]:
+        """``deadline``: optional per-request
+        :class:`~sonata_tpu.serving.deadlines.Deadline` — in iteration
+        mode the resident stream carries it, so expiry mid-flight fails
+        *this* stream at an iteration boundary without touching its
+        batch peers."""
         sc = self.get_fallback_synthesis_config()
         with tracing.span("encode-ids"):
             ids = self._encode_phonemes(phonemes)
@@ -1440,12 +1575,20 @@ class PiperVoice(BaseModel):
         total_frames = min(total_frames, f)
         enc_ms = (time.perf_counter() - t_enc0) * 1000.0
 
+        # the encode landed: this stream's window decodes join the
+        # device's running batch (iteration mode) or the wave coalescer
+        # (dispatch mode); one engine resolved per stream, so a ladder
+        # flip mid-stream cannot split a stream across engines
+        decoder = self._stream_decoder
+        join = getattr(decoder, "join", None)
+        handle = join(deadline) if join is not None else None
+
         # window decodes are independent given z, so they pipeline through
-        # the coalescer (and batch with other streams') while the consumer
+        # the engine (and batch with other streams') while the consumer
         # drains chunk by chunk — but only a bounded look-ahead is in
         # flight: a stream abandoned early (gRPC client cancel drops the
         # generator) then wastes at most LOOKAHEAD window decodes and
-        # coalescer slots instead of decoding its whole tail on-device.
+        # batch slots instead of decoding its whole tail on-device.
         LOOKAHEAD = 3
         plans = list(plan_chunks(total_frames, chunk_size, chunk_padding))
 
@@ -1453,63 +1596,61 @@ class PiperVoice(BaseModel):
             width = bucket_for(plan.width, FRAME_BUCKETS)
             start = min(plan.win_start, max(f - width, 0))
             return (plan, start, width,
-                    self._stream_decoder.submit(z_row, start, width, sid0))
+                    decoder.submit(z_row, start, width, sid0,
+                                   stream=handle))
 
-        submitted = [submit(p) for p in plans[:LOOKAHEAD]]
-        next_i = len(submitted)
-        while submitted:
-            plan, start, width, fut = submitted.pop(0)
-            t0 = time.perf_counter()
-            with tracing.span("decode-window", width=width):
-                wav = fut.result()
-            shift = plan.win_start - start  # window moved left by padding
-            lo = (shift + plan.trim_left) * hop
-            hi = (shift + plan.width - plan.trim_right) * hop
-            samples = AudioSamples(wav[lo:hi])
-            samples.crossfade(CROSSFADE_SAMPLES)  # edge taper (:838)
-            ms = (time.perf_counter() - t0) * 1000.0 + enc_ms
-            enc_ms = 0.0  # encoder cost attributed to the first chunk
-            if next_i < len(plans):  # top up the look-ahead before yielding
-                submitted.append(submit(plans[next_i]))
-                next_i += 1
-            yield Audio(samples, info, inference_ms=ms)
-
-
-def _drain_pending_futures(q: "queue.Queue", fut_of, reason: str) -> None:
-    """Fail every future still sitting in a coalescer queue.
-
-    ``fut_of(item)`` extracts the future(s) from one queued item.  Called
-    on close after the worker threads have exited: without it a caller
-    blocked in ``fut.result()`` (no timeout) would hang forever on a
-    voice unloaded mid-request.
-    """
-    while True:
         try:
-            item = q.get_nowait()
-        except queue.Empty:
-            return
-        if item is None:
-            continue
-        futs = fut_of(item)
-        for fut in (futs if isinstance(futs, list) else [futs]):
-            try:
-                fut.set_exception(OperationError(reason))
-            except Exception:
-                pass
+            submitted = [submit(p) for p in plans[:LOOKAHEAD]]
+            next_i = len(submitted)
+            while submitted:
+                plan, start, width, fut = submitted.pop(0)
+                t0 = time.perf_counter()
+                with tracing.span("decode-window", width=width):
+                    wav = fut.result()
+                shift = plan.win_start - start  # window moved left by pad
+                lo = (shift + plan.trim_left) * hop
+                hi = (shift + plan.width - plan.trim_right) * hop
+                samples = AudioSamples(wav[lo:hi])
+                samples.crossfade(CROSSFADE_SAMPLES)  # edge taper (:838)
+                ms = (time.perf_counter() - t0) * 1000.0 + enc_ms
+                enc_ms = 0.0  # encoder cost attributed to the first chunk
+                if next_i < len(plans):  # top up look-ahead before yield
+                    submitted.append(submit(plans[next_i]))
+                    next_i += 1
+                yield Audio(samples, info, inference_ms=ms)
+        finally:
+            # stream end OR abandonment (gRPC cancel closes the
+            # generator): retire from the running batch at the next
+            # iteration boundary; pending look-ahead rows are cancelled
+            if handle is not None:
+                decoder.retire(handle)
+
+
+# the generic queue-drain helper moved into the batching core with the
+# rest of the gather/dispatch machinery; re-exported here because the
+# coalescer drain contract is pinned against this module
+_drain_pending_futures = drain_pending_futures
 
 
 class _StreamDecodeCoalescer:
-    """Shared dispatcher for streaming window decodes.
+    """Shared dispatcher for streaming window decodes (dispatch mode).
 
     The reference serves each realtime stream from its own blocking thread
     (``grpc/src/main.rs:381-409``), so N concurrent streams contend for
     the device with N independent decode calls per chunk wave.  Here every
-    stream's window decode funnels through one queue; a worker groups
-    requests of equal window width (and same z frame-bucket shape) that
-    arrive within ``max_wait_ms`` and issues ONE batched decode — under
-    concurrent load the chunk cost approaches one dispatch per wave
-    instead of one per stream, while a lone stream pays only the tiny
-    wait window.
+    stream's window decode funnels through one queue; the batching core
+    groups requests of equal window width (and same z frame-bucket shape)
+    that arrive within ``max_wait_ms`` and this class issues ONE batched
+    decode — under concurrent load the chunk cost approaches one dispatch
+    per wave instead of one per stream, while a lone stream pays only the
+    tiny wait window.
+
+    Since the batching-core unification the queue/gather/drain machinery
+    lives in :class:`~sonata_tpu.synth.batching.BatchingCore` (two-phase:
+    the dispatcher thread enqueues device programs back-to-back while the
+    finisher blocks on each async-prefetched result copy — a single
+    thread doing both serialized every wave behind the previous wave's
+    ~100 ms host-link fetch); this class keeps only the decode policy.
     """
 
     def __init__(self, voice: "PiperVoice", *, max_batch: int = 8,
@@ -1522,67 +1663,165 @@ class _StreamDecodeCoalescer:
         self._voice_ref = weakref.ref(voice)
         self._max_batch = max_batch
         self._max_wait = max_wait_ms / 1000.0
-        self._queue: "queue.Queue" = queue.Queue()
-        # dispatch and result-fetch are separate pipeline stages: the
-        # dispatcher enqueues device programs back-to-back while the
-        # finisher blocks on each (async-prefetched) result copy.  A
-        # single thread doing both serialized every wave behind the
-        # previous wave's ~100 ms host-link fetch — under 8 concurrent
-        # streams that alone multiplied TTFB several-fold.
-        self._results: "queue.Queue" = queue.Queue()
-        self.stats = {"requests": 0, "dispatches": 0}
-        self._closed = False
-        self._worker = threading.Thread(target=self._run,
-                                        name="sonata_stream_decoder",
-                                        daemon=True)
-        self._worker.start()
-        self._finisher = threading.Thread(target=self._finish_loop,
-                                          name="sonata_stream_fetcher",
-                                          daemon=True)
-        self._finisher.start()
+        self._reason = "stream-decode coalescer closed (voice unloaded)"
+        self._core = BatchingCore(
+            dispatch=self._dispatch, finish=self._finish,
+            max_batch=max_batch, max_wait_s=self._max_wait,
+            name="sonata_stream_decoder", keyed=True,
+            alive=lambda: self._voice_ref() is not None,
+            closed_reason=self._reason, poll_s=5.0)
+        self.stats = self._core.stats
+
+    # thread handles pinned by the close/teardown tests
+    @property
+    def _worker(self):
+        return self._core._worker
+
+    @property
+    def _finisher(self):
+        return self._core._finisher
 
     def close(self) -> None:
         """Stop both threads and fail any work still queued.
 
-        Joins the worker before draining so nothing is added to a queue
-        after its drain; requests already dispatched to the device resolve
-        normally via the finisher before it exits."""
-        self._closed = True
-        self._queue.put(None)   # wake the worker
-        self._results.put(None)  # wake the finisher
-        self._worker.join(timeout=10.0)
-        self._finisher.join(timeout=10.0)
-        reason = "stream-decode coalescer closed (voice unloaded)"
-        _drain_pending_futures(self._queue, lambda it: it[3], reason)
-        _drain_pending_futures(self._results, lambda it: it[1], reason)
+        The core joins the worker before draining so nothing is added to
+        a queue after its drain; requests already dispatched to the
+        device resolve normally via the finisher before it exits."""
+        self._core.shutdown(join_timeout_s=10.0)
 
-    def submit(self, z_row, start: int, width: int, sid: "Optional[int]"):
+    def submit(self, z_row, start: int, width: int, sid: "Optional[int]",
+               stream=None):
         """Enqueue a window decode; returns a Future of the [width*hop]
-        waveform.  ``z_row``: [F, C] device array.
+        waveform.  ``z_row``: [F, C] device array.  ``stream`` is the
+        iteration-mode join handle — ignored here (dispatch mode has no
+        resident-stream state).
 
         The window is sliced out of ``z_row`` here, eagerly (a tiny
         on-device op), so everything behind the queue handles fixed
         [width, C] windows regardless of the utterance's frame bucket —
         see :meth:`PiperVoice._decode_windows_batch_fn`."""
-        from concurrent.futures import Future
-
         window = jax.lax.dynamic_slice_in_dim(
             z_row, jnp.int32(start), width, axis=0)
-        fut: "Future[np.ndarray]" = Future()
-        reason = "stream-decode coalescer closed (voice unloaded)"
-        if self._closed:
-            fut.set_exception(OperationError(reason))
+        item = WorkItem((window, sid), key=(width, sid is not None))
+        if self._core.closed:
+            try_set_exception(item.future, OperationError(self._reason))
+            return item.future
+        self._core.put(item)
+        return item.future
+
+    def decode(self, z_row, start: int, width: int,
+               sid: "Optional[int]") -> np.ndarray:
+        """Blocking variant of :meth:`submit`."""
+        return self.submit(z_row, start, width, sid).result()
+
+    def _dispatch(self, group: list):
+        v = self._voice_ref()
+        if v is None:
+            raise OperationError("voice was garbage-collected")
+        n = len(group)
+        # any multi-window group pads to ONE canonical batch size: the
+        # executable set is then exactly {b=1, b=max} — both prewarmed
+        # — so concurrency can never hit a cold compile mid-request.
+        # The padding rows' decode compute is cheap next to the
+        # XLA-compile stall a graduated bucket ladder risks per rung.
+        # (Iteration mode walks the graduated ladder instead — and warms
+        # every rung through the lattice; see _IterationStreamDecoder.)
+        b = self._max_batch if n > 1 else 1
+        pad = b - n
+        windows = jnp.stack([item.payload[0] for item in group]
+                            + [group[0].payload[0]] * pad)
+        width, has_sid = group[0].key
+        args = [v.params, windows]
+        if has_sid:
+            args.append(jnp.asarray(
+                [item.payload[1] for item in group]
+                + [group[0].payload[1]] * pad, dtype=jnp.int32))
+        fn = v._decode_windows_batch_fn(width, b, has_sid)
+        out = fn(*args)  # async dispatch
+        try:
+            out.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        self._core.bump("requests", n)
+        self._core.bump("dispatches")
+        # padding accounting, same keys as the iteration loop's stats —
+        # the bench's iteration-vs-dispatch A/B compares these directly
+        self._core.bump("rows", n)
+        self._core.bump("padded_rows", pad)
+        return out
+
+    def _finish(self, group: list, out) -> None:
+        wavs = np.asarray(jax.device_get(out))
+        for item, wav in zip(group, wavs):
+            try_set_result(item.future, wav)
+
+
+class _IterationStreamDecoder:
+    """Iteration-mode window decoder (``SONATA_BATCH_MODE=iteration``).
+
+    Same ``submit`` surface as :class:`_StreamDecodeCoalescer`, but the
+    engine underneath is the persistent
+    :class:`~sonata_tpu.synth.batching.IterationLoop`: a stream *joins*
+    the device's running batch once its encode lands, each of its window
+    decodes rides an iteration alongside every other resident stream's
+    rows, and the stream *retires* at an iteration boundary when it ends.
+    No wave-gather wait window, and the batch axis steps the graduated
+    bucket ladder (1, 2, 4, 8) — lattice-warmed, so occupancy-sized
+    dispatches stay recompile-free where dispatch mode overpads every
+    multi-stream wave to the canonical max.
+    """
+
+    def __init__(self, voice: "PiperVoice", *, max_batch: int = 8):
+        import weakref
+
+        self._voice_ref = weakref.ref(voice)
+        self._max_batch = max_batch
+        self._max_wait = 0.0  # no gather window: joins happen at
+        # iteration boundaries, not inside a wait loop
+        attrs = {}
+        device = getattr(voice, "device", None)
+        if device is not None:
+            attrs["device"] = str(device)
+        self._loop = IterationLoop(self._dispatch, max_batch=max_batch,
+                                   name="sonata_iter_decode", attrs=attrs)
+        self.stats = self._loop.stats
+
+    # -- stream lifecycle (stream_synthesis drives this) -----------------
+    def join(self, deadline=None):
+        return self._loop.join(deadline)
+
+    def retire(self, handle) -> None:
+        self._loop.retire(handle)
+
+    def start_draining(self) -> None:
+        self._loop.start_draining()
+
+    @property
+    def resident_streams(self) -> int:
+        return self._loop.resident_streams
+
+    def submit(self, z_row, start: int, width: int, sid: "Optional[int]",
+               stream=None):
+        """Same eager-slice contract as the dispatch-mode coalescer.
+        Without a ``stream`` handle (direct callers, tools) the row rides
+        as a one-iteration stream that retires when its future resolves."""
+        window = jax.lax.dynamic_slice_in_dim(
+            z_row, jnp.int32(start), width, axis=0)
+        key = (width, sid is not None)
+        if stream is not None:
+            return self._loop.submit(stream, key, (window, sid))
+        try:
+            handle = self._loop.join()
+        except OperationError as e:
+            # closed/draining: fail the future instead of raising — the
+            # same fail-fast contract as the dispatch-mode coalescer
+            from concurrent.futures import Future
+
+            fut: Future = Future()
+            fut.set_exception(e)
             return fut
-        self._queue.put((window, width, sid, fut))
-        if self._closed:
-            # enqueue-vs-drain race: close() may have drained the queue
-            # between our check and our put — drain again so this future
-            # cannot be left unresolved (fut.result() would hang forever).
-            # Re-put the wake sentinel afterwards: the drain may have
-            # eaten close()'s None before the worker saw it, which would
-            # leave the worker blocked out its full 5 s poll.
-            _drain_pending_futures(self._queue, lambda it: it[3], reason)
-            self._queue.put(None)
+        fut = self._loop.submit(handle, key, (window, sid))
+        fut.add_done_callback(lambda _f: self._loop.retire(handle))
         return fut
 
     def decode(self, z_row, start: int, width: int,
@@ -1590,114 +1829,43 @@ class _StreamDecodeCoalescer:
         """Blocking variant of :meth:`submit`."""
         return self.submit(z_row, start, width, sid).result()
 
-    # -- worker ---------------------------------------------------------
-    def _run(self) -> None:
-        while not self._closed:
-            try:
-                first = self._queue.get(timeout=5.0)
-            except queue.Empty:
-                if self._voice_ref() is None:
-                    return  # voice collected: let the thread die
-                continue
-            if first is None:
-                continue
-            group = [first]
-            key = self._key(first)
-            deadline = time.monotonic() + self._max_wait
-            leftovers = []
-            while len(group) < self._max_batch:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                try:
-                    nxt = self._queue.get(timeout=remaining)
-                except queue.Empty:
-                    break
-                if nxt is None:
-                    break
-                if self._key(nxt) == key:
-                    group.append(nxt)
-                else:
-                    leftovers.append(nxt)  # different shape: next wave
-            for item in leftovers:
-                self._queue.put(item)
-            self._dispatch(group)
+    def close(self) -> None:
+        self._loop.close()
+
+    # -- one iteration's device call --------------------------------------
+    def _dispatch(self, key, payloads, b: int):
+        v = self._voice_ref()
+        if v is None:
+            raise OperationError("voice was garbage-collected")
+        width, has_sid = key
+        n = len(payloads)
+        pad = b - n
+        windows = jnp.stack([p[0] for p in payloads]
+                            + [payloads[0][0]] * pad)
+        args = [v.params, windows]
+        if has_sid:
+            args.append(jnp.asarray(
+                [p[1] for p in payloads] + [payloads[0][1]] * pad,
+                dtype=jnp.int32))
+        cache_key = ("wbatch", width, b, has_sid, should_donate())
+        with v._jit_lock:
+            cached = cache_key in v._dec_cache
+        fn = v._decode_windows_batch_fn(width, b, has_sid)
+        wavs = self._run_and_fetch(fn, args)
+        attrs = {"frame_bucket": width, "text_bucket": 0,
+                 "compile": "cached" if cached else "cold"}
+        voice_label = getattr(v, "scope_voice", None)
+        if voice_label is not None:
+            attrs["voice"] = voice_label
+        return list(wavs[:n]), attrs
 
     @staticmethod
-    def _key(item) -> tuple:
-        _window, width, sid, _fut = item
-        return (width, sid is not None)
-
-    def _dispatch(self, group) -> None:
-        v = self._voice_ref()
-        futures = [item[3] for item in group]
-        if v is None:
-            for fut in futures:
-                try:
-                    fut.set_exception(
-                        OperationError("voice was garbage-collected"))
-                except Exception:
-                    pass
-            return
-        try:
-            n = len(group)
-            # any multi-window group pads to ONE canonical batch size: the
-            # executable set is then exactly {b=1, b=max} — both prewarmed
-            # — so concurrency can never hit a cold compile mid-request.
-            # The padding rows' decode compute is cheap next to the
-            # XLA-compile stall a graduated bucket ladder risks per rung.
-            b = self._max_batch if n > 1 else 1
-            pad = b - n
-            windows = jnp.stack([item[0] for item in group]
-                                + [group[0][0]] * pad)
-            width = group[0][1]
-            has_sid = group[0][2] is not None
-            args = [v.params, windows]
-            if has_sid:
-                args.append(jnp.asarray(
-                    [item[2] for item in group] + [group[0][2]] * pad,
-                    dtype=jnp.int32))
-            fn = v._decode_windows_batch_fn(width, b, has_sid)
-            out = fn(*args)  # async dispatch
-            try:
-                out.copy_to_host_async()
-            except (AttributeError, RuntimeError):
-                pass
-            self.stats["requests"] += n
-            self.stats["dispatches"] += 1
-            self._results.put((out, futures))
-        except Exception as e:
-            for fut in futures:
-                try:
-                    fut.set_exception(e)
-                except Exception:
-                    pass
-
-    def _finish_loop(self) -> None:
-        while not self._closed:
-            try:
-                item = self._results.get(timeout=5.0)
-            except queue.Empty:
-                if self._voice_ref() is None:
-                    return
-                continue
-            if item is None:
-                continue
-            out, futures = item
-            try:
-                wavs = np.asarray(jax.device_get(out))
-            except Exception as e:
-                for fut in futures:
-                    try:
-                        fut.set_exception(e)
-                    except Exception:
-                        pass
-                continue
-            for fut, wav in zip(futures, wavs):
-                try:
-                    fut.set_result(wav)
-                except Exception:
-                    pass
+    def _run_and_fetch(fn, args) -> np.ndarray:
+        """Dispatch + blocking fetch: the loop is synchronous per
+        iteration by design (the next iteration's occupancy depends on
+        which rows resolved), so there is no later pipeline stage for an
+        async copy to overlap with."""
+        return np.asarray(jax.device_get(fn(*args)))
 
 
 class _StreamStageCoalescer:
@@ -1712,9 +1880,10 @@ class _StreamStageCoalescer:
     scales and speaker ids ride the same row-wise arrays the batch path
     uses, so streams with different configs still share a dispatch.
 
-    Pipeline shape mirrors the decode coalescer: a dispatcher thread
-    groups and enqueues device programs; a finisher thread blocks on each
-    group's (async-prefetched) frame counts, handles the rare
+    Pipeline shape mirrors the decode coalescer (and lives in the same
+    :class:`~sonata_tpu.synth.batching.BatchingCore`): a dispatcher
+    thread groups and enqueues device programs; a finisher thread blocks
+    on each group's (async-prefetched) frame counts, handles the rare
     frame-budget retry, and resolves per-stream futures with their z row.
     """
 
@@ -1729,31 +1898,27 @@ class _StreamStageCoalescer:
         self._voice_ref = weakref.ref(voice)
         self._max_batch = max_batch
         self._max_wait = max_wait_ms / 1000.0
-        self._queue: "queue.Queue" = queue.Queue()
-        self._results: "queue.Queue" = queue.Queue()
-        self.stats = {"requests": 0, "dispatches": 0}
-        self._closed = False
-        self._worker = threading.Thread(target=self._run,
-                                        name="sonata_stream_stages",
-                                        daemon=True)
-        self._worker.start()
-        self._finisher = threading.Thread(target=self._finish_loop,
-                                          name="sonata_stage_fetcher",
-                                          daemon=True)
-        self._finisher.start()
+        self._reason = "stream-stage coalescer closed (voice unloaded)"
+        self._core = BatchingCore(
+            dispatch=self._dispatch, finish=self._finish,
+            max_batch=max_batch, max_wait_s=self._max_wait,
+            name="sonata_stream_stages", keyed=True,
+            alive=lambda: self._voice_ref() is not None,
+            closed_reason=self._reason, poll_s=5.0)
+        self.stats = self._core.stats
+
+    @property
+    def _worker(self):
+        return self._core._worker
+
+    @property
+    def _finisher(self):
+        return self._core._finisher
 
     def close(self) -> None:
         """Stop both threads and fail any work still queued (see
         :meth:`_StreamDecodeCoalescer.close`)."""
-        self._closed = True
-        self._queue.put(None)
-        self._results.put(None)
-        self._worker.join(timeout=10.0)
-        self._finisher.join(timeout=10.0)
-        reason = "stream-stage coalescer closed (voice unloaded)"
-        _drain_pending_futures(self._queue, lambda it: it[2], reason)
-        _drain_pending_futures(self._results,
-                               lambda it: [g[2] for g in it[0]], reason)
+        self._core.shutdown(join_timeout_s=10.0)
 
     def start(self, ids: list, sc: SynthesisConfig):
         """Blocking: run encode+acoustics for one stream (possibly batched
@@ -1761,153 +1926,74 @@ class _StreamStageCoalescer:
         ``z_row`` is the [f, C] on-device latent, ``total_frames`` the true
         frame count, ``f`` the allocated frame bucket, and ``sid0`` the
         row's speaker id (None on single-speaker voices)."""
-        from concurrent.futures import Future
+        if self._core.closed:
+            raise OperationError(self._reason)
+        item = WorkItem((ids, sc),
+                        key=(bucket_for(len(ids), TEXT_BUCKETS),))
+        self._core.put(item)
+        return item.future.result()
 
-        fut: Future = Future()
-        reason = "stream-stage coalescer closed (voice unloaded)"
-        if self._closed:
-            raise OperationError(reason)
-        self._queue.put((ids, sc, fut))
-        if self._closed:
-            # enqueue-vs-drain race (see _StreamDecodeCoalescer.submit);
-            # re-put the sentinel in case the drain ate close()'s wake
-            _drain_pending_futures(self._queue, lambda it: it[2], reason)
-            self._queue.put(None)
-        return fut.result()
-
-    # -- dispatcher -----------------------------------------------------
-    def _run(self) -> None:
-        while not self._closed:
-            try:
-                first = self._queue.get(timeout=5.0)
-            except queue.Empty:
-                if self._voice_ref() is None:
-                    return
-                continue
-            if first is None:
-                continue
-            group = [first]
-            key = self._key(first)
-            deadline = time.monotonic() + self._max_wait
-            leftovers = []
-            while len(group) < self._max_batch:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                try:
-                    nxt = self._queue.get(timeout=remaining)
-                except queue.Empty:
-                    break
-                if nxt is None:
-                    break
-                if self._key(nxt) == key:
-                    group.append(nxt)
-                else:
-                    leftovers.append(nxt)
-            for item in leftovers:
-                self._queue.put(item)
-            self._dispatch(group)
-
-    @staticmethod
-    def _key(item) -> tuple:
-        ids, _sc, _fut = item
-        return (bucket_for(len(ids), TEXT_BUCKETS),)
-
-    def _dispatch(self, group) -> None:
+    def _dispatch(self, group: list):
         v = self._voice_ref()
-        futures = [item[2] for item in group]
         if v is None:
-            for fut in futures:
-                try:
-                    fut.set_exception(
-                        OperationError("voice was garbage-collected"))
-                except Exception:
-                    pass
-            return
+            raise OperationError("voice was garbage-collected")
+        ids_list = [item.payload[0] for item in group]
+        scs = [item.payload[1] for item in group]
+        # same canonical-batch rule as the decode coalescer: any
+        # multi-stream group pads to max_batch rows, so only the
+        # (b=1, b=max) encode/acoustics shapes exist and prewarm
+        # covers them completely
+        if len(group) > 1:
+            pad_rows = self._max_batch - len(group)
+            ids_list = ids_list + [[0]] * pad_rows
+            scs = scs + [scs[0]] * pad_rows
+        ids, lens, b, t = v._pad_batch(ids_list)
+        speakers = None
+        if v.multi_speaker:
+            speakers = [sc.speaker[1] if sc.speaker else 0 for sc in scs]
+        sid = v._sid_array(scs[0], b, speakers)
+        nw, ls, ns, ls_host = v._scale_arrays(scs[0], b, scales=scs)
+        weighted = max(len(row) * max(ls_host[i], 0.05)
+                       for i, row in enumerate(ids_list))
+        f = v._estimate_frame_bucket(weighted)
+        # one split key per dispatch, like the fused batch path — a
+        # frame-budget retry reuses it for identical audio
+        rng_enc, rng_aco = jax.random.split(v._next_rng())
+        enc_args = [v.params, ids, lens, rng_enc, nw, ls]
+        if sid is not None:
+            enc_args.append(sid)
+        m_p, logs_p, w_ceil, x_mask = v._encode_fn(b, t)(*enc_args)
+        # per-row frame counts: prefetched so the finisher's fetch
+        # rides behind the acoustics dispatch
+        frames_vec = jnp.sum(w_ceil.reshape(b, -1), axis=1)
         try:
-            ids_list = [item[0] for item in group]
-            scs = [item[1] for item in group]
-            # same canonical-batch rule as the decode coalescer: any
-            # multi-stream group pads to max_batch rows, so only the
-            # (b=1, b=max) encode/acoustics shapes exist and prewarm
-            # covers them completely
-            if len(group) > 1:
-                pad_rows = self._max_batch - len(group)
-                ids_list = ids_list + [[0]] * pad_rows
-                scs = scs + [scs[0]] * pad_rows
-            ids, lens, b, t = v._pad_batch(ids_list)
-            speakers = None
-            if v.multi_speaker:
-                speakers = [sc.speaker[1] if sc.speaker else 0 for sc in scs]
-            sid = v._sid_array(scs[0], b, speakers)
-            nw, ls, ns, ls_host = v._scale_arrays(scs[0], b, scales=scs)
-            weighted = max(len(row) * max(ls_host[i], 0.05)
-                           for i, row in enumerate(ids_list))
-            f = v._estimate_frame_bucket(weighted)
-            # one split key per dispatch, like the fused batch path — a
-            # frame-budget retry reuses it for identical audio
-            rng_enc, rng_aco = jax.random.split(v._next_rng())
-            enc_args = [v.params, ids, lens, rng_enc, nw, ls]
+            frames_vec.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+
+        def run_acoustics(bucket: int):
+            args = [v.params, m_p, logs_p, w_ceil, x_mask, rng_aco, ns]
             if sid is not None:
-                enc_args.append(sid)
-            m_p, logs_p, w_ceil, x_mask = v._encode_fn(b, t)(*enc_args)
-            # per-row frame counts: prefetched so the finisher's fetch
-            # rides behind the acoustics dispatch
-            frames_vec = jnp.sum(w_ceil.reshape(b, -1), axis=1)
-            try:
-                frames_vec.copy_to_host_async()
-            except (AttributeError, RuntimeError):
-                pass
+                args.append(sid)
+            return v._acoustics_fn(b, t, bucket)(*args)
 
-            def run_acoustics(bucket: int):
-                args = [v.params, m_p, logs_p, w_ceil, x_mask, rng_aco, ns]
-                if sid is not None:
-                    args.append(sid)
-                return v._acoustics_fn(b, t, bucket)(*args)
+        z, _y_lengths = run_acoustics(f)
+        self._core.bump("requests", len(group))
+        self._core.bump("dispatches")
+        self._core.bump("rows", len(group))
+        self._core.bump("padded_rows", b - len(group))
+        return (z, frames_vec, f, weighted, speakers, run_acoustics)
 
-            z, _y_lengths = run_acoustics(f)
-            self.stats["requests"] += len(group)
-            self.stats["dispatches"] += 1
-            self._results.put((group, z, frames_vec, f, weighted, speakers,
-                               run_acoustics))
-        except Exception as e:
-            for fut in futures:
-                try:
-                    fut.set_exception(e)
-                except Exception:
-                    pass
-
-    # -- finisher -------------------------------------------------------
-    def _finish_loop(self) -> None:
-        while not self._closed:
-            try:
-                item = self._results.get(timeout=5.0)
-            except queue.Empty:
-                if self._voice_ref() is None:
-                    return
-                continue
-            if item is None:
-                continue
-            group, z, frames_vec, f, weighted, speakers, run_acoustics = item
-            v = self._voice_ref()
-            futures = [g[2] for g in group]
-            try:
-                frames = np.asarray(jax.device_get(frames_vec)).astype(int)
-                actual = int(frames[:len(group)].max())
-                if v is not None:
-                    v._observe_frames(weighted, actual)
-                if actual > f and v is not None:  # clipped: redo, same rng
-                    f = bucket_for(actual, FRAME_BUCKETS)
-                    z, _ = run_acoustics(f)
-                for i, (_ids, _sc, fut) in enumerate(group):
-                    sid0 = speakers[i] if speakers is not None else None
-                    try:
-                        fut.set_result((z[i], int(frames[i]), f, sid0))
-                    except Exception:
-                        pass
-            except Exception as e:
-                for fut in futures:
-                    try:
-                        fut.set_exception(e)
-                    except Exception:
-                        pass
+    def _finish(self, group: list, ticket) -> None:
+        z, frames_vec, f, weighted, speakers, run_acoustics = ticket
+        v = self._voice_ref()
+        frames = np.asarray(jax.device_get(frames_vec)).astype(int)
+        actual = int(frames[:len(group)].max())
+        if v is not None:
+            v._observe_frames(weighted, actual)
+        if actual > f and v is not None:  # clipped: redo, same rng
+            f = bucket_for(actual, FRAME_BUCKETS)
+            z, _ = run_acoustics(f)
+        for i, item in enumerate(group):
+            sid0 = speakers[i] if speakers is not None else None
+            try_set_result(item.future, (z[i], int(frames[i]), f, sid0))

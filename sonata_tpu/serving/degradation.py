@@ -277,3 +277,15 @@ def gather_scale() -> float:
     if ladder is None:
         return 1.0
     return 0.0 if ladder.current_level() >= 1 else 1.0
+
+
+def force_dispatch_mode() -> bool:
+    """Iteration-mode override: at level >= 1 (the same threshold that
+    collapses gather windows) new streams fall back from the persistent
+    iteration loop to dispatch-granular batching — under pressure the
+    simpler wave path sheds predictably, and recovery (hysteresis)
+    re-admits iteration mode with no operator action."""
+    ladder = _installed
+    if ladder is None:
+        return False
+    return ladder.current_level() >= 1

@@ -1,5 +1,11 @@
 """Synthesis orchestration (analogue of ``crates/sonata/synth``)."""
 
+from .batching import (
+    BatchingCore,
+    IterationLoop,
+    effective_batch_mode,
+    resolve_batch_mode,
+)
 from .output import AudioOutputConfig, percent_to_param, process_prosody
 from .scheduler import BatchScheduler, DispatchStuck, SchedulerCrashed
 from .synthesizer import (
@@ -14,6 +20,10 @@ __all__ = [
     "AudioOutputConfig",
     "percent_to_param",
     "process_prosody",
+    "BatchingCore",
+    "IterationLoop",
+    "effective_batch_mode",
+    "resolve_batch_mode",
     "BatchScheduler",
     "DispatchStuck",
     "SchedulerCrashed",

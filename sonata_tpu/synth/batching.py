@@ -1,0 +1,930 @@
+"""The batching core: ONE gather/dispatch engine for every coalescing path.
+
+Before this module, three copies of the same machinery lived in the
+tree — :class:`~sonata_tpu.synth.scheduler.BatchScheduler` (sentence
+requests), the streaming window-decode coalescer, and the streaming
+encode+acoustics stage coalescer (both in :mod:`sonata_tpu.models.piper`).
+Each owned its own queue, gather loop, shutdown drain, and future
+bookkeeping, and the serving contracts (deadline-drop-before-pack, bounded
+shed, watchdog, crash containment) existed only where someone had
+remembered to copy them.  :class:`BatchingCore` is that contract, once:
+
+- **bounded queueing** — a full queue sheds typed
+  (:class:`~sonata_tpu.serving.admission.Overloaded`) and feeds the
+  degradation ladder, never grows without limit;
+- **gather** — collect up to ``max_batch`` compatible items (same
+  ``key``), waiting at most ``max_wait`` after the first; a degraded
+  process collapses the wait to zero (``degradation.gather_scale``);
+- **deadline-drop-before-pack** — expired/cancelled items leave the
+  batch *before* device work is spent on them;
+- **failpoints** — the gather loop fires an owner-named site;
+- **watchdog** — :class:`DispatchSupervisor` bounds a device call by
+  wall clock and quarantines the helper thread on conviction (a wedged
+  chip raises nothing);
+- **crash containment** — an exception escaping the worker loop fails
+  every gathered and queued future typed instead of stranding callers;
+- **drain** — close fails queued work typed, including the
+  submit-vs-drain race (an item enqueued while close drains can never
+  leave its caller blocked in ``fut.result()``).
+
+The owners are now thin: they supply a ``dispatch`` callback (and
+optionally a ``finish`` callback for two-phase enqueue/fetch pipelining)
+plus their grouping key, and inherit everything above.
+
+This module also houses the **iteration-level scheduler**
+(:class:`IterationLoop`): the Orca-style persistent per-device decode
+loop behind ``SONATA_BATCH_MODE=iteration`` — streams *join* a running
+batch at iteration boundaries and *retire* when they end, instead of
+every dispatch gathering from scratch.  See :func:`resolve_batch_mode`.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Optional
+
+from ..core import OperationError
+from ..serving import degradation, faults, scope, tracing
+from ..serving.admission import Overloaded
+from ..serving.deadlines import Deadline, DeadlineExceeded
+from ..utils.buckets import BATCH_BUCKETS, bucket_for
+
+log = logging.getLogger("sonata.serving")
+
+# ---------------------------------------------------------------------------
+# batch-mode resolution (SONATA_BATCH_MODE)
+# ---------------------------------------------------------------------------
+
+#: dispatch = PR-1 wave batching (gather within a wait window, dispatch,
+#: disband); iteration = the persistent Orca-style decode loop.  The
+#: default rides the PR-1 backend-adaptive dispatch policy: a backend
+#: whose probe keeps coalescing (accelerators) defaults to iteration;
+#: a per-request backend (CPU fast path) keeps dispatch mode.
+BATCH_MODE_ENV = "SONATA_BATCH_MODE"
+BATCH_MODES = ("dispatch", "iteration")
+
+
+def resolve_batch_mode(policy=None, env: Optional[dict] = None) -> str:
+    """``SONATA_BATCH_MODE`` > the dispatch policy's coalesce decision.
+
+    A typo'd mode fails loudly (the warmup-lattice/SLO-table contract:
+    a fleet silently running the wrong batching mode is a utilization
+    regression nobody would see until the next bench run).
+    """
+    env = os.environ if env is None else env
+    raw = env.get(BATCH_MODE_ENV, "").strip().lower()
+    if raw:
+        if raw not in BATCH_MODES:
+            raise OperationError(
+                f"{BATCH_MODE_ENV}={raw!r} is not one of "
+                f"{'/'.join(BATCH_MODES)}")
+        return raw
+    if policy is not None and getattr(policy, "coalesce", False):
+        return "iteration"
+    return "dispatch"
+
+
+def effective_batch_mode(policy=None, env: Optional[dict] = None) -> str:
+    """The mode after the degradation ladder's override: a degraded
+    process (level >= 1, the same threshold that collapses gather
+    windows) forces iteration back to dispatch mode — new streams then
+    take the simpler wave path while pressure lasts; resident streams
+    finish where they are."""
+    mode = resolve_batch_mode(policy, env)
+    if mode == "iteration" and degradation.force_dispatch_mode():
+        return "dispatch"
+    return mode
+
+
+# ---------------------------------------------------------------------------
+# work items
+# ---------------------------------------------------------------------------
+
+class WorkItem:
+    """One queued unit of batchable work."""
+
+    __slots__ = ("payload", "key", "future", "deadline", "tctx", "t_submit")
+
+    def __init__(self, payload, *, key=None,
+                 future: Optional[Future] = None,
+                 deadline: Optional[Deadline] = None, tctx=None):
+        self.payload = payload
+        self.key = key
+        self.future = future if future is not None else Future()
+        self.deadline = deadline
+        self.t_submit = time.monotonic()
+        #: (trace, parent span) captured at submit time — spans recorded
+        #: by a worker thread land in the submitting request's trace
+        self.tctx = tctx
+
+
+def try_set_result(fut: Future, value) -> None:
+    """Resolve a future, tolerating a concurrent cancel (a
+    cancelled-then-set InvalidStateError must never kill a worker)."""
+    try:
+        fut.set_result(value)
+    except Exception:
+        pass
+
+
+def try_set_exception(fut: Future, exc: Exception) -> None:
+    try:
+        fut.set_exception(exc)
+    except Exception:
+        pass
+
+
+def drain_pending_futures(q: "queue.Queue", fut_of, reason: str) -> None:
+    """Fail every future still sitting in a work queue.
+
+    ``fut_of(item)`` extracts the future(s) from one queued item.
+    Called on close after worker threads exited: without it a caller
+    blocked in ``fut.result()`` (no timeout) would hang forever on an
+    engine closed mid-request.
+    """
+    while True:
+        try:
+            item = q.get_nowait()
+        except queue.Empty:
+            return
+        if item is None:
+            continue
+        futs = fut_of(item)
+        for fut in (futs if isinstance(futs, list) else [futs]):
+            try:
+                fut.set_exception(OperationError(reason))
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# the gather/dispatch engine
+# ---------------------------------------------------------------------------
+
+class BatchingCore:
+    """The one gather/dispatch engine (see module docstring).
+
+    Owner hooks:
+
+    - ``dispatch(items) -> ticket | None`` — process one gathered group.
+      Returning ``None`` means the owner fully handled the group
+      (resolved its futures); returning a ticket hands the group to the
+      finisher thread (two-phase pipelining: the dispatcher enqueues
+      device programs back-to-back while the finisher blocks on each
+      result fetch).  An exception fails the whole group's futures.
+    - ``finish(items, ticket)`` — second phase; resolves the futures.
+      Required iff any dispatch returns a ticket.
+    - ``alive() -> bool`` — liveness re-check on idle poll timeouts
+      (the coalescers' weak voice reference); ``False`` exits the
+      worker quietly.
+    - ``on_drop(item, outcome, now)`` — accounting hook when the
+      deadline filter drops an item (outcome ``expired``/``cancelled``);
+      the core already failed/cancelled the future.
+    - ``on_crash(exc, items)`` — containment hook after the core failed
+      the gathered+queued futures typed; owners report to their model
+      (a pool replica recycles itself).
+
+    ``max_queue <= 0`` means unbounded (the coalescers: their callers
+    are already admission-bounded); a bounded queue sheds typed with
+    :class:`Overloaded` and notes the shed to the degradation ladder.
+    """
+
+    def __init__(self, *, dispatch: Callable, max_batch: int,
+                 max_wait_s: float, name: str,
+                 finish: Optional[Callable] = None,
+                 max_queue: int = 0,
+                 keyed: bool = False,
+                 drop_dead: bool = False,
+                 degradation_scaled: bool = False,
+                 failpoint_site: Optional[str] = None,
+                 alive: Optional[Callable[[], bool]] = None,
+                 on_drop: Optional[Callable] = None,
+                 on_crash: Optional[Callable] = None,
+                 closed_reason: str = "batching core shut down",
+                 shed_reason: Optional[str] = None,
+                 poll_s: float = 0.5):
+        self._dispatch_cb = dispatch
+        self._finish_cb = finish
+        self._max_batch = max_batch
+        self._max_wait = max_wait_s
+        self._max_queue = max_queue
+        self._keyed = keyed
+        self._drop_dead = drop_dead
+        self._degradation_scaled = degradation_scaled
+        self._failpoint_site = failpoint_site
+        self._alive = alive
+        self._on_drop = on_drop
+        self._on_crash = on_crash
+        self._closed_reason = closed_reason
+        self._shed_reason = shed_reason
+        self._poll_s = poll_s
+        self.stats = {"requests": 0, "dispatches": 0, "shed": 0,
+                      "expired": 0, "cancelled": 0, "stuck": 0}
+        self._stats_lock = threading.Lock()
+        # maxsize counts the wake sentinel too, but one slot of slack on
+        # a bounded queue is noise; <= 0 means unbounded
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(max_queue, 0))
+        self._results: "Optional[queue.Queue]" = (
+            queue.Queue() if finish is not None else None)
+        self._closed = threading.Event()
+        self._worker = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        self._worker.start()
+        self._finisher: Optional[threading.Thread] = None
+        if self._results is not None:
+            self._finisher = threading.Thread(
+                target=self._finish_loop, name=f"{name}_fetch", daemon=True)
+            self._finisher.start()
+
+    # -- bookkeeping ---------------------------------------------------------
+    def bump(self, key: str, n: int = 1) -> None:
+        """Thread-safe stats increment (submit counters race the
+        worker's; dict += is not atomic under concurrency).  Owners may
+        grow their own keys (e.g. the coalescers' padding accounting)."""
+        with self._stats_lock:
+            self.stats[key] = self.stats.get(key, 0) + n
+
+    def stats_snapshot(self) -> dict:
+        with self._stats_lock:
+            return dict(self.stats)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+    def queue_depth(self) -> int:
+        """Items currently waiting (approximate; for metrics)."""
+        return self._queue.qsize()
+
+    # -- submission ----------------------------------------------------------
+    def put(self, item: WorkItem) -> None:
+        """Enqueue one item; sheds typed on a full bounded queue and
+        covers the submit-vs-drain race (an item landing after close's
+        drain is failed here, and the wake sentinel re-posted in case
+        the drain ate it)."""
+        try:
+            self._queue.put_nowait(item)
+        except queue.Full:
+            self.bump("shed")
+            degradation.note_shed()
+            raise Overloaded(
+                self._shed_reason if self._shed_reason is not None else
+                f"batch queue full ({self._max_queue} items); "
+                "shedding") from None
+        if self._closed.is_set():
+            drain_pending_futures(self._queue, lambda it: it.future,
+                                  self._closed_reason)
+            self._queue.put(None)
+
+    # -- teardown ------------------------------------------------------------
+    def shutdown(self, join_timeout_s: float = 5.0) -> None:
+        """Stop the worker (and finisher) and fail all queued work typed.
+
+        Joins before draining so nothing is added to a queue after its
+        drain; groups already handed to the finisher resolve normally
+        before it exits."""
+        self._closed.set()
+        try:
+            self._queue.put_nowait(None)  # wake the worker
+        except queue.Full:
+            pass  # worker observes _closed on its next poll tick anyway
+        if self._results is not None:
+            self._results.put(None)  # wake the finisher
+        self._worker.join(timeout=join_timeout_s)
+        if self._finisher is not None:
+            self._finisher.join(timeout=10.0)
+        drain_pending_futures(self._queue, lambda it: it.future,
+                              self._closed_reason)
+        if self._results is not None:
+            drain_pending_futures(
+                self._results, lambda it: [i.future for i in it[0]],
+                self._closed_reason)
+
+    # -- worker --------------------------------------------------------------
+    def _run(self) -> None:
+        while not self._closed.is_set():
+            batch: list = []
+            try:
+                try:
+                    first = self._queue.get(timeout=self._poll_s)
+                except queue.Empty:
+                    # re-check closed/liveness: a full queue can eat the
+                    # shutdown sentinel, so the worker must never block
+                    # forever; coalescers also exit once their voice is
+                    # garbage-collected
+                    if self._alive is not None and not self._alive():
+                        return
+                    continue
+                if first is None:
+                    continue
+                batch = self._gather(first)
+                if self._failpoint_site is not None:
+                    faults.fire(self._failpoint_site)
+                if self._drop_dead:
+                    batch = self._filter_dead(batch)
+                if batch:
+                    self._dispatch_group(batch)
+            except Exception as e:
+                self._crashed(e, batch)
+                return
+
+    def _gather(self, first: WorkItem) -> list:
+        """Collect up to ``max_batch`` key-compatible items, waiting at
+        most ``max_wait`` after the first; incompatible items requeue
+        for the next wave."""
+        batch = [first]
+        wait = self._max_wait
+        if self._degradation_scaled:
+            # a degraded process (level >= 1) collapses the gather
+            # window to zero: no *waiting* for coalescing — but items
+            # already queued still ride along for free (get_nowait
+            # below), otherwise a zero window would force batch-1
+            # dispatches exactly when the queue is deepest
+            wait *= degradation.gather_scale()
+        deadline = time.monotonic() + wait
+        leftovers: list = []
+        while len(batch) < self._max_batch:
+            remaining = deadline - time.monotonic()
+            try:
+                nxt = (self._queue.get(timeout=remaining)
+                       if remaining > 0 else self._queue.get_nowait())
+            except queue.Empty:
+                break
+            if nxt is None:
+                break
+            if self._keyed and nxt.key != first.key:
+                leftovers.append(nxt)  # different shape: next wave
+            else:
+                batch.append(nxt)
+        for item in leftovers:
+            self._queue.put(item)
+        return batch
+
+    def _filter_dead(self, batch: list) -> list:
+        """Deadline-drop-before-pack: expired/cancelled items leave the
+        batch *before* it is packed into a device dispatch — a backed-up
+        queue sheds dead work instead of synthesizing audio nobody is
+        waiting for."""
+        live = []
+        now = time.monotonic()
+        for item in batch:
+            dl = item.deadline
+            if dl is None or dl.alive():
+                live.append(item)
+                continue
+            outcome = "cancelled" if dl.cancelled else "expired"
+            if self._on_drop is not None:
+                self._on_drop(item, outcome, now)
+            if dl.cancelled:
+                self.bump("cancelled")
+                item.future.cancel()  # nobody is reading the result
+            else:
+                self.bump("expired")
+                try_set_exception(
+                    item.future,
+                    DeadlineExceeded("deadline expired in scheduler queue "
+                                     "before device dispatch"))
+        return live
+
+    def _dispatch_group(self, batch: list) -> None:
+        try:
+            ticket = self._dispatch_cb(batch)
+        except Exception as e:
+            for item in batch:
+                try_set_exception(item.future, e)
+            return
+        if ticket is not None and self._results is not None:
+            self._results.put((batch, ticket))
+
+    def _crashed(self, exc: Exception, batch: list) -> None:
+        """Worker-crash containment: fail the gathered batch and
+        everything still queued with a typed error instead of stranding
+        callers, then tell the owner."""
+        log.exception("scheduler worker crashed; failing %d gathered and "
+                      "all queued items", len(batch))
+        self._closed.set()
+        err = SchedulerCrashed(
+            f"scheduler worker crashed: {type(exc).__name__}: {exc}")
+        items = list(batch)
+        while True:
+            try:
+                queued = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if queued is not None:
+                items.append(queued)
+        now = time.monotonic()
+        for item in items:
+            if item.tctx is not None:
+                trace, parent = item.tctx
+                trace.new_span("scheduler-crash", parent=parent,
+                               start=now, end=now,
+                               attrs={"error": str(err)})
+            try_set_exception(item.future, err)
+        if self._on_crash is not None:
+            try:
+                self._on_crash(err, items)
+            except Exception:
+                log.exception("scheduler-crash report hook failed")
+
+    # -- finisher ------------------------------------------------------------
+    def _finish_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                entry = self._results.get(timeout=self._poll_s)
+            except queue.Empty:
+                if self._alive is not None and not self._alive():
+                    return
+                continue
+            if entry is None:
+                continue
+            items, ticket = entry
+            try:
+                self._finish_cb(items, ticket)
+            except Exception as e:
+                for item in items:
+                    try_set_exception(item.future, e)
+
+
+class SchedulerCrashed(OperationError):
+    """A batching worker loop died on an unexpected exception; every
+    pending/queued item fails with this instead of hanging forever."""
+
+
+class DispatchStuck(OperationError):
+    """A device dispatch exceeded the watchdog; its worker thread was
+    quarantined and the batch's futures failed (a wedged chip raises
+    nothing — only wall clock can convict it)."""
+
+
+# ---------------------------------------------------------------------------
+# hung-dispatch watchdog (the supervised-call half of the core)
+# ---------------------------------------------------------------------------
+
+class _DispatchHelper:
+    """The watchdog path's long-lived device-call thread.
+
+    Each job carries its own context copy, result box, and done event,
+    so a quarantined call's late result lands in a box nobody reads —
+    discarded naturally, without paying a thread spawn on every
+    supervised dispatch.  Only one owner thread submits, one job at a
+    time.
+    """
+
+    __slots__ = ("_jobs", "thread")
+
+    def __init__(self):
+        self._jobs: "queue.SimpleQueue" = queue.SimpleQueue()
+        self.thread = threading.Thread(target=self._loop,
+                                       name="sonata_dispatch",
+                                       daemon=True)
+        self.thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                return
+            ctx, fn, box, done = job
+            try:
+                box["out"] = ctx.run(fn)
+            except Exception as e:
+                box["err"] = e
+            finally:
+                done.set()
+
+    def submit(self, ctx, fn):
+        box: dict = {}
+        done = threading.Event()
+        self._jobs.put((ctx, fn, box, done))
+        return box, done
+
+    def retire(self) -> None:
+        """Stop the loop once the in-flight job (if any) returns: a
+        quarantined thread that finally unwedges drains this sentinel
+        and exits instead of blocking forever on an abandoned queue."""
+        self._jobs.put(None)
+
+
+class DispatchSupervisor:
+    """Bound a device call by wall clock; quarantine on conviction.
+
+    One long-lived helper thread serves every supervised dispatch
+    (spawning per dispatch would tax the hot path to guard against the
+    rare wedge).  On timeout the helper is quarantined — left running,
+    renamed, its eventual result discarded, a replacement built on the
+    next call — and ``on_stuck()`` runs before :class:`DispatchStuck`
+    raises so the owner can count, degrade, and report.
+    """
+
+    def __init__(self):
+        self._helper: Optional[_DispatchHelper] = None
+
+    def call(self, fn, timeout: float, *, timeout_env: str,
+             on_stuck: Optional[Callable] = None):
+        import contextvars
+
+        helper = self._helper
+        if helper is None or not helper.thread.is_alive():
+            helper = self._helper = _DispatchHelper()
+        ctx = contextvars.copy_context()
+        box, done = helper.submit(ctx, fn)
+        if not done.wait(timeout):
+            helper.thread.name = "sonata_dispatch_quarantined"
+            self._helper = None
+            helper.retire()  # exits after the wedged call (if ever) ends
+            if on_stuck is not None:
+                on_stuck(helper)
+            raise DispatchStuck(
+                f"device dispatch exceeded the {timeout:g}s watchdog "
+                f"({timeout_env}); worker thread quarantined")
+        if "err" in box:
+            raise box["err"]
+        return box["out"]
+
+    def shutdown(self) -> None:
+        helper, self._helper = self._helper, None
+        if helper is not None:
+            helper.retire()
+            helper.thread.join(timeout=1.0)
+
+
+# ---------------------------------------------------------------------------
+# iteration-level scheduling (SONATA_BATCH_MODE=iteration)
+# ---------------------------------------------------------------------------
+
+class StreamSlot:
+    """One resident stream in an :class:`IterationLoop`."""
+
+    __slots__ = ("deadline", "tctx", "pending", "retired", "failed",
+                 "joined_at")
+
+    def __init__(self, deadline: Optional[Deadline], tctx):
+        self.deadline = deadline
+        self.tctx = tctx
+        #: submitted-but-undispatched items, FIFO
+        self.pending: list = []
+        self.retired = False
+        self.failed: Optional[Exception] = None
+        self.joined_at = time.monotonic()
+
+
+class IterationLoop:
+    """Orca-style persistent per-device decode loop.
+
+    Dispatch-granular batching gathers a wave, dispatches, disbands —
+    every wave re-pays the gather window, and a multi-request wave pads
+    to the one canonical batch size so the compiled-shape set stays
+    {1, max}.  This loop instead keeps the batch *running*: streams
+    **join** at iteration boundaries (after their encode lands), their
+    window decodes ride each iteration alongside every other resident
+    stream's, and they **retire** when the stream ends — no wave gather,
+    no wait window, and the batch axis steps through the *graduated*
+    bucket ladder (1, 2, 4, 8, ...) because the warmup lattice
+    enumerates every rung (``lattice_shapes`` grows the iteration-mode
+    shapes), so occupancy-sized dispatches stay recompile-free where the
+    wave path had to overpad to the canonical max.
+
+    Owner hook: ``dispatch(key, payloads, batch_bucket) ->
+    (results, attrs)`` — run one iteration's device call for
+    ``len(payloads)`` live rows padded to ``batch_bucket``, returning
+    one result per live row plus attribution attrs (``frame_bucket``,
+    ``compile``, ``voice``...).  Failures fail only that iteration's
+    rows; the affected streams surface the error through their futures
+    and retire through their consumers' normal teardown.
+
+    Serving-plane composition: every iteration records a shared
+    ``dispatch`` span (``mode=iteration``, peer request ids, padding
+    ratio) into each rider's trace and feeds
+    :func:`sonata_tpu.serving.scope.note_dispatch` so padding-waste
+    accounting is per iteration; ``start_draining`` retires the loop at
+    an iteration boundary (no new joins, resident work finishes);
+    deadline expiry mid-flight fails only the expired stream's rows.
+    """
+
+    def __init__(self, dispatch: Callable, *, max_batch: int,
+                 name: str = "sonata_iteration",
+                 attrs: Optional[dict] = None,
+                 idle_poll_s: float = 0.5):
+        self._dispatch_cb = dispatch
+        self._max_batch = max(int(max_batch), 1)
+        self._attrs = dict(attrs or {})
+        self._idle_poll = idle_poll_s
+        #: submissions and joins land here; the loop admits them at
+        #: iteration boundaries
+        self._inbox: "queue.Queue" = queue.Queue()
+        self._streams: "dict[int, StreamSlot]" = {}
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._closed = threading.Event()
+        self._draining = threading.Event()
+        self.stats = {"requests": 0, "dispatches": 0, "iterations": 0,
+                      "joined": 0, "retired": 0, "expired": 0,
+                      "rows": 0, "padded_rows": 0}
+        self._stats_lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self.stats[key] += n
+
+    def stats_snapshot(self) -> dict:
+        with self._stats_lock:
+            return dict(self.stats)
+
+    # -- stream lifecycle ----------------------------------------------------
+    def join(self, deadline: Optional[Deadline] = None,
+             trace_ctx=None) -> int:
+        """Register one stream with the running loop; its submits ride
+        iterations from the next boundary on.  Refused typed while
+        draining/closed (a deploy is not a hang)."""
+        if self._closed.is_set() or self._draining.is_set():
+            raise OperationError(
+                "iteration loop is draining; stream refused")
+        with self._lock:
+            self._next_id += 1
+            handle = self._next_id
+            self._streams[handle] = StreamSlot(
+                deadline, trace_ctx if trace_ctx is not None
+                else tracing.current())
+        # join-vs-drain-exit race: the loop may have observed an empty
+        # stream set and exited between our check and the registration
+        # (_run's exit path sets _closed) — a stream resident in a dead
+        # loop would hang its consumer, so re-check and refuse typed
+        if self._closed.is_set():
+            with self._lock:
+                self._streams.pop(handle, None)
+            raise OperationError(
+                "iteration loop is draining; stream refused")
+        self._bump("joined")
+        return handle
+
+    def submit(self, handle: int, key, payload) -> "Future":
+        """Queue one row of work for the stream; resolves with that
+        row's device result after the iteration it rides.  The ambient
+        trace context is captured here (the submitting thread's) so the
+        per-iteration dispatch span lands in the right trace; rows
+        submitted off-trace fall back to the stream's join-time
+        context."""
+        item = WorkItem(payload, key=key, tctx=tracing.current())
+        reason = "iteration loop closed (voice unloaded)"
+        if self._closed.is_set():
+            try_set_exception(item.future, OperationError(reason))
+            return item.future
+        self._inbox.put(("work", handle, item))
+        # submit-vs-close race (the BatchingCore.put contract): close()
+        # — or the drain-exit path, which also sets _closed — may have
+        # drained the inbox between our check and our put; re-drain so
+        # this future can never be left unresolved for a caller blocked
+        # in fut.result()
+        if self._closed.is_set():
+            self._drain_inbox(reason)
+        return item.future
+
+    def retire(self, handle: int) -> None:
+        """The stream ended (or was abandoned): it leaves the batch at
+        the next iteration boundary; any rows still pending are
+        cancelled (an abandoned stream wastes bounded device work)."""
+        if self._closed.is_set():
+            return
+        self._inbox.put(("retire", handle, None))
+
+    # -- lifecycle -----------------------------------------------------------
+    def start_draining(self) -> None:
+        """Stop admitting joins; the loop exits at an iteration boundary
+        once resident streams finish (the SIGTERM drain path: readiness
+        is already off, in-flight streams keep their riders)."""
+        self._draining.set()
+        self._inbox.put(None)
+
+    def close(self, join_timeout_s: float = 10.0) -> None:
+        """Terminal: fail everything pending typed and stop the loop."""
+        self._closed.set()
+        self._draining.set()
+        self._inbox.put(None)
+        self._thread.join(timeout=join_timeout_s)
+        reason = "iteration loop closed (voice unloaded)"
+        with self._lock:
+            slots = list(self._streams.values())
+            self._streams.clear()
+        for slot in slots:
+            for item in slot.pending:
+                try_set_exception(item.future, OperationError(reason))
+            slot.pending.clear()
+        self._drain_inbox(reason)
+
+    def _drain_inbox(self, reason: str) -> None:
+        drain_pending_futures(
+            self._inbox,
+            lambda e: (e[2].future if e[0] == "work" else []), reason)
+
+    @property
+    def resident_streams(self) -> int:
+        with self._lock:
+            return len(self._streams)
+
+    # -- the loop ------------------------------------------------------------
+    def _run(self) -> None:
+        try:
+            while not self._closed.is_set():
+                try:
+                    has_work = self._admit_inbox()
+                    if self._closed.is_set():
+                        return
+                    if not has_work:
+                        if self._draining.is_set() and not self._streams:
+                            return  # drained at an iteration boundary
+                        continue
+                    self._expire_dead()
+                    self._iterate()
+                except Exception:
+                    # containment: one bad iteration must not kill the
+                    # resident loop — affected rows already failed via
+                    # their futures; log and keep serving
+                    log.exception("iteration loop error (loop continues)")
+        finally:
+            # EVERY exit (close, drain-complete) marks the loop closed
+            # and fails anything that raced into the inbox — submit/join
+            # re-check _closed, so nothing can queue work into a dead
+            # loop and hang its consumer
+            self._closed.set()
+            self._drain_inbox("iteration loop closed (voice unloaded)")
+
+    def _admit_inbox(self) -> bool:
+        """Iteration boundary: admit queued submits/retires.  Blocks on
+        the inbox only when no work is pending (the persistent loop is
+        idle-blocked, not spinning).  Returns whether any stream has
+        pending rows."""
+        block = not self._has_pending()
+        first = True
+        while True:
+            try:
+                entry = (self._inbox.get(timeout=self._idle_poll)
+                         if block and first else self._inbox.get_nowait())
+            except queue.Empty:
+                break
+            first = False
+            if entry is None:
+                continue
+            kind, handle, item = entry
+            with self._lock:
+                slot = self._streams.get(handle)
+            if kind == "work":
+                if slot is None or slot.retired:
+                    try_set_exception(item.future, OperationError(
+                        "stream is not resident in the iteration loop"))
+                    continue
+                if item.tctx is None:
+                    item.tctx = slot.tctx
+                slot.pending.append(item)
+                self._bump("requests")
+            else:  # retire
+                if slot is not None:
+                    slot.retired = True
+        self._reap_retired()
+        return self._has_pending()
+
+    def _has_pending(self) -> bool:
+        with self._lock:
+            return any(s.pending for s in self._streams.values())
+
+    def _reap_retired(self) -> None:
+        with self._lock:
+            gone = [h for h, s in self._streams.items() if s.retired]
+            for h in gone:
+                slot = self._streams.pop(h)
+                for item in slot.pending:
+                    item.future.cancel()  # abandoned mid-stream
+        if gone:
+            self._bump("retired", len(gone))
+
+    def _expire_dead(self) -> None:
+        """A stream whose deadline expired fails — alone.  Its pending
+        rows fail typed before the next dispatch; every other resident
+        stream keeps riding."""
+        with self._lock:
+            dead = [(h, s) for h, s in self._streams.items()
+                    if s.deadline is not None and not s.deadline.alive()]
+            for h, _ in dead:
+                self._streams.pop(h)
+        for _h, slot in dead:
+            err = (OperationError("stream cancelled")
+                   if slot.deadline.cancelled else
+                   DeadlineExceeded("stream deadline expired in the "
+                                    "iteration loop"))
+            for item in slot.pending:
+                try_set_exception(item.future, err)
+            slot.pending.clear()
+            slot.failed = err
+            self._bump("expired")
+            # an expired stream still LEFT the batch: count it retired
+            # too, so joined == retired holds whenever the loop is empty
+            # (the book-balance invariant the smokes assert) — "expired"
+            # records the reason, not a third lifecycle state.  The
+            # consumer's own retire() later finds no slot and no-ops.
+            self._bump("retired")
+
+    def _pick_rows(self):
+        """One iteration's rows: the oldest-waiting key, FIFO across
+        streams, up to ``max_batch``."""
+        with self._lock:
+            heads = [(s.pending[0].t_submit, h)
+                     for h, s in self._streams.items() if s.pending]
+            if not heads:
+                return None, []
+            _, oldest = min(heads)
+            key = self._streams[oldest].pending[0].key
+            rows = []
+            candidates = sorted(
+                ((item.t_submit, h, i, item)
+                 for h, s in self._streams.items()
+                 for i, item in enumerate(s.pending) if item.key == key))
+            taken: "dict[int, list]" = {}
+            for _t, h, _i, item in candidates:
+                if len(rows) >= self._max_batch:
+                    break
+                rows.append((h, item))
+                taken.setdefault(h, []).append(item)
+            for h, items in taken.items():
+                s = self._streams[h]
+                s.pending = [it for it in s.pending if it not in items]
+            return key, rows
+
+    def _iterate(self) -> None:
+        key, rows = self._pick_rows()
+        if not rows:
+            return
+        n = len(rows)
+        # graduated bucket ladder: occupancy pads only to the next batch
+        # bucket (lattice-warmed), not the canonical max — the padding
+        # waste the dispatch-granular wave rule pays is the point of
+        # this mode
+        b = min(bucket_for(n, BATCH_BUCKETS), self._max_batch)
+        items = [item for _h, item in rows]
+        t0 = time.monotonic()
+        attrs: dict = {}
+        err: Optional[Exception] = None
+        results = None
+        try:
+            results, extra = self._dispatch_cb(
+                key, [i.payload for i in items], b)
+            attrs.update(extra or {})
+        except Exception as e:
+            err = e
+        t1 = time.monotonic()
+        try:
+            # bookkeeping + attribution must never strand the dequeued
+            # rows: once picked, the futures below ALWAYS resolve, so a
+            # scope/tracing-plane fault costs observability, not a
+            # consumer blocked forever in fut.result()
+            self._bump("iterations")
+            self._bump("dispatches")
+            self._bump("rows", n)
+            self._bump("padded_rows", b - n)
+            traced = [i for i in items if i.tctx is not None]
+            attrs.update(self._attrs)
+            attrs.update(
+                mode="iteration", batch_bucket=b, rows=n,
+                padding_rows=b - n, padding_ratio=round((b - n) / b, 3))
+            if traced:
+                attrs.setdefault("dispatch_id", tracing.new_id())
+                attrs["batch_size"] = n
+                attrs["request_ids"] = [i.tctx[0].request_id
+                                        for i in traced]
+            if err is not None:
+                attrs["error"] = f"{type(err).__name__}: {err}"
+            else:
+                # per-iteration dispatch-efficiency accounting: one
+                # iteration counts once, with the same attribution its
+                # trace span carries (the PR-7 never-disagree invariant)
+                scope.note_dispatch(t1 - t0, attrs)
+            # spans BEFORE resolving futures: a rider may export its
+            # trace the instant its future resolves, and the iteration
+            # attribution must already be there
+            for item in traced:
+                trace, parent = item.tctx
+                trace.new_span("queue-wait", parent=parent,
+                               start=item.t_submit, end=t0)
+                trace.new_span("dispatch", parent=parent, start=t0,
+                               end=t1, attrs=attrs)
+        except Exception:
+            log.exception("iteration attribution failed (rows still "
+                          "resolve)")
+        if err is not None or results is None or len(results) != n:
+            if err is None:
+                err = OperationError(
+                    f"iteration dispatch returned "
+                    f"{0 if results is None else len(results)} results "
+                    f"for {n} rows (shape corrupted)")
+            for item in items:
+                try_set_exception(item.future, err)
+            return
+        for item, out in zip(items, results):
+            try_set_result(item.future, out)

@@ -14,6 +14,13 @@ deadline, future) tuples; a worker collects up to ``max_batch`` sentences
 throughput approaches full-batch efficiency; idle, a lone request pays
 only the wait window.
 
+Since the batching-core unification this class is a thin owner over
+:class:`~sonata_tpu.synth.batching.BatchingCore` — the queueing, gather,
+deadline-drop-before-pack, crash-containment, and drain contracts live
+there (shared with the streaming coalescers); this module keeps only the
+scheduler's policy: request validation, the model call with its
+trace/scope attribution, and the watchdog conviction handling.
+
 Serving-runtime integration (:mod:`sonata_tpu.serving`):
 
 - the queue is **bounded** (``max_queue``, default
@@ -33,11 +40,8 @@ forwards both per row, so coalescing never flattens per-request settings.
 
 from __future__ import annotations
 
-import contextvars
 import logging
 import os
-import queue
-import threading
 import time
 from concurrent.futures import Future
 from typing import Optional
@@ -45,9 +49,20 @@ from typing import Optional
 from ..audio import Audio
 from ..core import Model, OperationError
 from ..serving import degradation, faults, scope, tracing
-from ..serving.admission import Overloaded
 from ..serving.deadlines import Deadline, DeadlineExceeded
 from ..utils.profiling import QUEUE_WAIT_BUCKETS_S, Histogram
+from .batching import (
+    BatchingCore,
+    DispatchStuck,
+    DispatchSupervisor,
+    SchedulerCrashed,
+    WorkItem,
+    try_set_exception,
+    try_set_result,
+)
+
+__all__ = ["BatchScheduler", "DispatchStuck", "SchedulerCrashed",
+           "MAX_QUEUE_ENV", "DISPATCH_TIMEOUT_ENV"]
 
 log = logging.getLogger("sonata.serving")
 
@@ -58,34 +73,6 @@ DEFAULT_MAX_QUEUE = 1024
 #: dispatch, so operators must size this past their worst cold compile
 #: or pair it with --prewarm + the persistent compile cache)
 DISPATCH_TIMEOUT_ENV = "SONATA_DISPATCH_TIMEOUT_S"
-
-
-class DispatchStuck(OperationError):
-    """A device dispatch exceeded the watchdog; its worker thread was
-    quarantined and the batch's futures failed (a wedged chip raises
-    nothing — only wall clock can convict it)."""
-
-
-class SchedulerCrashed(OperationError):
-    """The scheduler worker loop died on an unexpected exception; every
-    pending/queued item fails with this instead of hanging forever."""
-
-
-class _Item:
-    __slots__ = ("phonemes", "speaker", "scales", "deadline", "future",
-                 "t_submit", "tctx")
-
-    def __init__(self, phonemes, speaker, scales, deadline, future,
-                 tctx=None):
-        self.phonemes = phonemes
-        self.speaker = speaker
-        self.scales = scales
-        self.deadline = deadline
-        self.future = future
-        self.t_submit = time.monotonic()
-        #: (trace, parent span) captured at submit time — spans recorded
-        #: by the worker thread land in the submitting request's trace
-        self.tctx = tctx
 
 
 class BatchScheduler:
@@ -128,24 +115,12 @@ class BatchScheduler:
         #: hung-dispatch watchdog bound (seconds); <= 0 disables, and the
         #: disabled path is exactly the pre-watchdog direct call
         self._dispatch_timeout_s = dispatch_timeout_s
-        #: lazily-built helper thread for supervised dispatches; replaced
-        #: only when the watchdog quarantines it (see _DispatchHelper)
-        self._dispatch_helper: Optional["_DispatchHelper"] = None
+        self._supervisor = DispatchSupervisor()
         #: a ReplicaPool's _BreakerModel owns the dispatch failpoint so
         #: injected errors count toward the breaker; bare models get the
         #: hook here
         self._fire_dispatch_failpoint = not getattr(
             model, "owns_dispatch_failpoint", False)
-        #: per-dispatch observability, same shape as the stream
-        #: coalescers': coalescing ratio = requests / dispatches; plus the
-        #: serving-runtime drop counters (shed = queue full at submit,
-        #: expired/cancelled = dropped by the gather loop pre-dispatch)
-        #: and stuck = dispatches killed by the watchdog.
-        #: submit() counters race with the worker's, so increments go
-        #: through _bump (dict += is not atomic under concurrency)
-        self.stats = {"requests": 0, "dispatches": 0, "shed": 0,
-                      "expired": 0, "cancelled": 0, "stuck": 0}
-        self._stats_lock = threading.Lock()
         #: time-in-queue (submit → gather) per item, including items the
         #: gather loop dropped — the queue-wait half of the coalescing
         #: latency story the aggregate shed/expired counters cannot tell.
@@ -161,22 +136,45 @@ class BatchScheduler:
             device = getattr(model, "device", None)
             if device is not None:
                 self._trace_attrs["device"] = str(device)
-        # maxsize counts the sentinel too, but one slot of slack on a
-        # 1024-deep bound is noise; <= 0 means unbounded (tests only)
-        self._queue: "queue.Queue" = queue.Queue(maxsize=max(max_queue, 0))
-        self._closed = threading.Event()
-        self._worker = threading.Thread(target=self._run,
-                                        name="sonata_batcher", daemon=True)
-        self._worker.start()
+        self._core = BatchingCore(
+            dispatch=self._dispatch,
+            max_batch=max_batch,
+            max_wait_s=self._max_wait,
+            max_queue=max_queue,
+            name="sonata_batcher",
+            drop_dead=True,
+            degradation_scaled=True,
+            failpoint_site="scheduler.gather",
+            on_drop=self._on_drop,
+            on_crash=self._on_crash,
+            closed_reason="scheduler shut down",
+            shed_reason=(f"scheduler queue full ({max_queue} items); "
+                         "shedding"))
+        #: per-dispatch observability, same shape as the stream
+        #: coalescers': coalescing ratio = requests / dispatches; plus the
+        #: serving-runtime drop counters (shed = queue full at submit,
+        #: expired/cancelled = dropped by the gather loop pre-dispatch)
+        #: and stuck = dispatches killed by the watchdog.  The dict is
+        #: the core's (one set of counters, no mirroring).
+        self.stats = self._core.stats
+
+    # the submit/shutdown race pin replaces the scheduler's queue with a
+    # wrapper; the property aliases the core's so both sides see it
+    @property
+    def _queue(self):
+        return self._core._queue
+
+    @_queue.setter
+    def _queue(self, q) -> None:
+        self._core._queue = q
 
     def _bump(self, key: str, n: int = 1) -> None:
-        with self._stats_lock:
-            self.stats[key] += n
+        self._core.bump(key, n)
 
     # -- public API ----------------------------------------------------------
     def queue_depth(self) -> int:
         """Items currently waiting (approximate; for metrics)."""
-        return self._queue.qsize()
+        return self._core.queue_depth()
 
     def set_dispatch_timeout(self, seconds: Optional[float]) -> None:
         """(Re)arm the hung-dispatch watchdog at runtime (<= 0 or None
@@ -188,8 +186,7 @@ class BatchScheduler:
         """Stats snapshot plus the derived coalescing ratio (requests per
         device dispatch; 1.0 = no coalescing) — the one place the ratio
         formula lives for every consumer (server log line, benches)."""
-        with self._stats_lock:
-            s = dict(self.stats)
+        s = self._core.stats_snapshot()
         s["coalescing_ratio"] = round(
             s["requests"] / max(s["dispatches"], 1), 3)
         return s
@@ -202,7 +199,7 @@ class BatchScheduler:
         """``trace_ctx``: (trace, parent span) for callers submitting off
         the request thread (the replica pool's resubmit path); defaults
         to the ambient :func:`tracing.current` context."""
-        if self._closed.is_set():
+        if self._core.closed:
             raise OperationError("scheduler is shut down")
         if deadline is not None and not deadline.alive():
             # no point occupying a queue slot for work that is already
@@ -232,27 +229,11 @@ class BatchScheduler:
                 if not isinstance(value, numbers.Real):
                     raise OperationError(
                         f"scales.{attr} missing or non-numeric")
-        fut: "Future[Audio]" = Future()
-        item = _Item(phonemes, speaker, scales, deadline, fut,
-                     tctx=trace_ctx if trace_ctx is not None
-                     else tracing.current())
-        try:
-            self._queue.put_nowait(item)
-        except queue.Full:
-            self._bump("shed")
-            degradation.note_shed()
-            raise Overloaded(
-                f"scheduler queue full ({self._max_queue} items); "
-                "shedding") from None
-        # shutdown race: a submit that passed the _closed check above can
-        # interleave with shutdown()'s drain and land its item *after*
-        # the drain emptied the queue — that future would never resolve.
-        # Re-check after the put and fail the future ourselves; if the
-        # drain (or the worker) already handled it, the set_exception is
-        # a tolerated no-op.
-        if self._closed.is_set():
-            _try_set_exception(fut, OperationError("scheduler shut down"))
-        return fut
+        item = WorkItem((phonemes, speaker, scales), deadline=deadline,
+                        tctx=trace_ctx if trace_ctx is not None
+                        else tracing.current())
+        self._core.put(item)
+        return item.future
 
     def speak(self, phonemes: str, timeout: Optional[float] = None,
               speaker: Optional[int] = None, scales=None,
@@ -261,143 +242,35 @@ class BatchScheduler:
                            deadline=deadline).result(timeout)
 
     def shutdown(self) -> None:
-        self._closed.set()
-        try:
-            self._queue.put_nowait(None)  # wake the worker
-        except queue.Full:
-            pass  # worker will observe _closed on its next loop anyway
-        self._worker.join(timeout=5.0)
-        helper, self._dispatch_helper = self._dispatch_helper, None
-        if helper is not None:
-            helper.retire()
-            helper.thread.join(timeout=1.0)
-        # fail anything still enqueued so no caller blocks forever
-        while True:
-            try:
-                item = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            if item is not None:
-                _try_set_exception(item.future,
-                                   OperationError("scheduler shut down"))
+        self._core.shutdown()
+        self._supervisor.shutdown()
 
-    # -- worker --------------------------------------------------------------
-    def _run(self) -> None:
-        while not self._closed.is_set():
-            batch: list = []
-            try:
-                try:
-                    item = self._queue.get(timeout=0.5)
-                except queue.Empty:
-                    continue  # re-check _closed: a full queue can eat the
-                    # shutdown sentinel, so the worker must not block
-                    # forever
-                if item is None:
-                    continue
-                batch = [item]
-                # a degraded process (level >= 1) collapses the gather
-                # window to zero: no *waiting* for coalescing — but items
-                # already sitting in the queue still ride along for free
-                # (get_nowait below), otherwise a zero window would force
-                # batch-1 dispatches exactly when the queue is deepest
-                # and throughput matters most
-                wait = self._max_wait * degradation.gather_scale()
-                deadline = time.monotonic() + wait
-                while len(batch) < self._max_batch:
-                    remaining = deadline - time.monotonic()
-                    try:
-                        nxt = (self._queue.get(timeout=remaining)
-                               if remaining > 0
-                               else self._queue.get_nowait())
-                    except queue.Empty:
-                        break
-                    if nxt is None:
-                        break
-                    batch.append(nxt)
-                faults.fire("scheduler.gather")
-                batch = self._drop_dead(batch)
-                if batch:
-                    self._dispatch(batch)
-            except Exception as e:
-                # an unexpected exception escaping the loop used to
-                # strand every queued future forever (the worker died,
-                # nothing resolved them); contain it: fail the gathered
-                # batch and everything still queued with a typed error,
-                # mark the scheduler closed, and tell the owner (a
-                # replica recycles itself)
-                self._worker_crashed(e, batch)
-                return
+    # -- hooks from the core -------------------------------------------------
+    def _on_drop(self, item: WorkItem, outcome: str, now: float) -> None:
+        # a dropped item still spent real time in the queue: both the
+        # histogram and the trace must say so, or the slowest traces
+        # would be exactly the ones with a hole where the wait went.
+        # The core records this span BEFORE resolving the future (same
+        # invariant as _dispatch): the waiter may export the trace the
+        # instant its future resolves
+        self.queue_wait.observe(now - item.t_submit)
+        if item.tctx is not None:
+            trace, parent = item.tctx
+            trace.new_span("queue-wait", parent=parent,
+                           start=item.t_submit, end=now,
+                           attrs={"outcome": outcome})
 
-    def _worker_crashed(self, exc: Exception, batch: list) -> None:
-        log.exception("scheduler worker crashed; failing %d gathered and "
-                      "all queued items", len(batch))
-        self._closed.set()
-        err = SchedulerCrashed(
-            f"scheduler worker crashed: {type(exc).__name__}: {exc}")
-        now = time.monotonic()
-        items = list(batch)
-        while True:
-            try:
-                queued = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            if queued is not None:
-                items.append(queued)
-        for item in items:
-            if item.tctx is not None:
-                trace, parent = item.tctx
-                trace.new_span("scheduler-crash", parent=parent,
-                               start=now, end=now,
-                               attrs={"error": str(err)})
-            _try_set_exception(item.future, err)
+    def _on_crash(self, err: Exception, items: list) -> None:
         # a pool replica rebuilds itself (breaker trip + drain + probe)
         report = getattr(self._model, "report_scheduler_fault", None)
         if report is not None:
-            try:
-                report(err)
-            except Exception:
-                log.exception("scheduler-crash report hook failed")
+            report(err)
 
-    def _drop_dead(self, batch: list) -> list:
-        """Filter expired/cancelled items out of a gathered batch *before*
-        it is packed into a device dispatch — the whole point of deadline
-        propagation: a backed-up queue sheds dead work instead of
-        synthesizing audio nobody is waiting for."""
-        live = []
-        now = time.monotonic()
-        for item in batch:
-            dl = item.deadline
-            if dl is None or dl.alive():
-                live.append(item)
-                continue
-            # a dropped item still spent real time in the queue: both the
-            # histogram and the trace must say so, or the slowest traces
-            # would be exactly the ones with a hole where the wait went.
-            # Span BEFORE resolving the future (same invariant as
-            # _dispatch): the waiter may export the trace the instant
-            # its future resolves
-            self.queue_wait.observe(now - item.t_submit)
-            outcome = "cancelled" if dl.cancelled else "expired"
-            if item.tctx is not None:
-                trace, parent = item.tctx
-                trace.new_span("queue-wait", parent=parent,
-                               start=item.t_submit, end=now,
-                               attrs={"outcome": outcome})
-            if dl.cancelled:
-                self._bump("cancelled")
-                item.future.cancel()  # nobody is reading the result
-            else:
-                self._bump("expired")
-                _try_set_exception(
-                    item.future,
-                    DeadlineExceeded("deadline expired in scheduler queue "
-                                     "before device dispatch"))
-        return live
-
-    def _dispatch(self, batch) -> None:
-        sentences = [i.phonemes for i in batch]
-        speakers = [i.speaker for i in batch]
-        scales = [i.scales for i in batch]
+    # -- dispatch ------------------------------------------------------------
+    def _dispatch(self, batch: list) -> None:
+        sentences = [i.payload[0] for i in batch]
+        speakers = [i.payload[1] for i in batch]
+        scales = [i.payload[2] for i in batch]
         futures = [i.future for i in batch]
         self._bump("requests", len(batch))
         self._bump("dispatches")
@@ -463,10 +336,10 @@ class BatchScheduler:
                                               "error": str(err)})
         if err is not None:
             for fut in futures:
-                _try_set_exception(fut, err)
+                try_set_exception(fut, err)
         else:
             for fut, audio in zip(futures, audios):
-                _try_set_result(fut, audio)
+                try_set_result(fut, audio)
 
     def _call_model(self, sentences, speakers, scales):
         """One device call, with the dispatch failpoint for bare models
@@ -481,30 +354,13 @@ class BatchScheduler:
 
     def _supervised_call(self, sentences, speakers, scales,
                          timeout: float):
-        """Run the device call under the hung-dispatch watchdog.
+        """Run the device call under the hung-dispatch watchdog
+        (:class:`~sonata_tpu.synth.batching.DispatchSupervisor`): on
+        conviction the helper thread is quarantined, the batch's futures
+        fail typed :class:`DispatchStuck` instead of hanging, the
+        breaker counts the fault, and the pool resubmits."""
 
-        The call runs on the scheduler's long-lived helper thread (with
-        the worker's context copied per call, so dispatch attribution
-        and failpoints behave identically); the worker waits out the
-        wall-clock bound.  On timeout the helper is quarantined — left
-        running, renamed, its eventual result discarded, a replacement
-        built on the next dispatch — and :class:`DispatchStuck` raises
-        so the batch's futures fail typed instead of hanging, the
-        breaker counts the fault, and the pool resubmits.  One helper
-        serves every supervised dispatch: spawning a thread per dispatch
-        would tax the whole hot path (create/start plus allocator churn
-        per coalesced batch) to guard against the rare wedge.
-        """
-        helper = self._dispatch_helper
-        if helper is None or not helper.thread.is_alive():
-            helper = self._dispatch_helper = _DispatchHelper()
-        ctx = contextvars.copy_context()
-        box, done = helper.submit(
-            ctx, lambda: self._call_model(sentences, speakers, scales))
-        if not done.wait(timeout):
-            helper.thread.name = "sonata_dispatch_quarantined"
-            self._dispatch_helper = None
-            helper.retire()  # exits after the wedged call (if ever) ends
+        def on_stuck(helper) -> None:
             self._bump("stuck")
             degradation.note_watchdog()
             # a convicted wedge is an incident: ship the flight
@@ -519,70 +375,7 @@ class BatchScheduler:
                     report()
                 except Exception:
                     log.exception("dispatch-stuck report hook failed")
-            raise DispatchStuck(
-                f"device dispatch exceeded the {timeout:g}s watchdog "
-                f"({DISPATCH_TIMEOUT_ENV}); worker thread quarantined")
-        if "err" in box:
-            raise box["err"]
-        return box["audios"]
 
-
-class _DispatchHelper:
-    """The watchdog path's long-lived device-call thread.
-
-    Each job carries its own context copy, result box, and done event,
-    so a quarantined call's late result lands in a box nobody reads —
-    discarded naturally, exactly like the old thread-per-dispatch
-    design, without paying a thread spawn on every supervised dispatch.
-    Only the scheduler worker submits, one job at a time.
-    """
-
-    __slots__ = ("_jobs", "thread")
-
-    def __init__(self):
-        self._jobs: "queue.SimpleQueue" = queue.SimpleQueue()
-        self.thread = threading.Thread(target=self._loop,
-                                       name="sonata_dispatch",
-                                       daemon=True)
-        self.thread.start()
-
-    def _loop(self) -> None:
-        while True:
-            job = self._jobs.get()
-            if job is None:
-                return
-            ctx, fn, box, done = job
-            try:
-                box["audios"] = ctx.run(fn)
-            except Exception as e:
-                box["err"] = e
-            finally:
-                done.set()
-
-    def submit(self, ctx, fn):
-        box: dict = {}
-        done = threading.Event()
-        self._jobs.put((ctx, fn, box, done))
-        return box, done
-
-    def retire(self) -> None:
-        """Stop the loop once the in-flight job (if any) returns: a
-        quarantined thread that finally unwedges drains this sentinel
-        and exits instead of blocking forever on an abandoned queue."""
-        self._jobs.put(None)
-
-
-def _try_set_result(fut: Future, value) -> None:
-    """Resolve a future, tolerating a concurrent cancel (a cancelled-then-set
-    InvalidStateError must never kill the worker thread)."""
-    try:
-        fut.set_result(value)
-    except Exception:
-        pass
-
-
-def _try_set_exception(fut: Future, exc: Exception) -> None:
-    try:
-        fut.set_exception(exc)
-    except Exception:
-        pass
+        return self._supervisor.call(
+            lambda: self._call_model(sentences, speakers, scales),
+            timeout, timeout_env=DISPATCH_TIMEOUT_ENV, on_stuck=on_stuck)

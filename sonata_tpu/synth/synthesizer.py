@@ -176,12 +176,19 @@ class SpeechSynthesizer:
         self, text: str,
         output_config: Optional[AudioOutputConfig] = None,
         chunk_size: int = 45, chunk_padding: int = 3,
+        deadline=None,
     ) -> "RealtimeSpeechStream":
+        """``deadline``: optional per-request
+        :class:`~sonata_tpu.serving.deadlines.Deadline`, carried down to
+        the model's streaming path — in iteration mode
+        (``SONATA_BATCH_MODE``) the resident stream rides it, so expiry
+        fails this stream alone at an iteration boundary."""
         self._check_output_config(output_config)
         if not self.model.supports_streaming_output():
             raise OperationError("model does not support streamed synthesis")
         return RealtimeSpeechStream(self, self.phonemize_text(text),
-                                    output_config, chunk_size, chunk_padding)
+                                    output_config, chunk_size, chunk_padding,
+                                    deadline=deadline)
 
     def synthesize_to_file(
         self, path: Union[str, Path], text: str,
@@ -304,7 +311,7 @@ class RealtimeSpeechStream(_StageTimestamps):
 
     def __init__(self, synth: SpeechSynthesizer, phonemes: Phonemes,
                  output_config: Optional[AudioOutputConfig],
-                 chunk_size: int, chunk_padding: int):
+                 chunk_size: int, chunk_padding: int, deadline=None):
         super().__init__()
         self._queue: "queue.Queue" = queue.Queue()
         self._synth = synth
@@ -322,8 +329,26 @@ class RealtimeSpeechStream(_StageTimestamps):
                     chunks_done = 1
                     for sentence in phonemes:
                         size = min(chunk_size * chunks_done, 1024)
-                        for chunk in synth.model.stream_synthesis(
-                                sentence, size, chunk_padding):
+                        if deadline is None:
+                            # the pre-deadline call shape: models still
+                            # implementing the legacy 3-parameter
+                            # protocol signature keep working untouched
+                            stream = synth.model.stream_synthesis(
+                                sentence, size, chunk_padding)
+                        else:
+                            try:
+                                stream = synth.model.stream_synthesis(
+                                    sentence, size, chunk_padding,
+                                    deadline)
+                            except TypeError:
+                                # legacy model with a deadline set:
+                                # drop it (no resident-stream state for
+                                # it to govern); the frontends' own
+                                # between-chunk checks still bound the
+                                # request
+                                stream = synth.model.stream_synthesis(
+                                    sentence, size, chunk_padding)
+                        for chunk in stream:
                             if self._cancelled.is_set():
                                 return
                             chunk = synth._post_process(chunk,

@@ -153,7 +153,8 @@ class FakeModel(BaseModel):
         return True
 
     def stream_synthesis(self, phonemes: str, chunk_size: int,
-                         chunk_padding: int) -> Iterator[Audio]:
+                         chunk_padding: int,
+                         deadline=None) -> Iterator[Audio]:
         self.calls.append(("stream_synthesis", phonemes, chunk_size,
                            chunk_padding))
         audio = self._synthesize(phonemes)

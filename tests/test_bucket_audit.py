@@ -1,0 +1,120 @@
+"""Bucket-lattice audit tool (tools/bucket_audit.py, PR-10 satellite).
+
+The audit reads a ``/debug/buckets`` waste-table snapshot and recommends
+a smaller bucket set under a projected-extra-waste budget.  These tests
+pin the projection model and the safety rails on synthetic snapshots.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # `pytest` invoked without `python -m`
+    sys.path.insert(0, str(REPO))
+
+from tools.bucket_audit import audit, axis_usage, recommend_axis  # noqa: E402
+from sonata_tpu.utils.buckets import FRAME_BUCKETS, TEXT_BUCKETS  # noqa: E402
+
+
+def _row(b, t, f, dispatches=1, rows=1, seconds=1.0, waste=0.0):
+    return {"batch_bucket": b, "text_bucket": t, "frame_bucket": f,
+            "dispatches": dispatches, "rows": rows, "padding_rows": 0,
+            "seconds": seconds, "waste_seconds": waste,
+            "cold_compiles": 0}
+
+
+def test_unobserved_buckets_drop_free_majority_kept():
+    """Traffic lives in text buckets 32 and 512: both survive any
+    budget; every unobserved bucket drops for free."""
+    rows = [_row(8, 32, 128, seconds=10.0),
+            _row(8, 512, 1024, seconds=30.0)]
+    usage = axis_usage(rows, "text_bucket")
+    rec = recommend_axis(TEXT_BUCKETS, usage, max_extra_waste_pct=0.0)
+    # zero budget: nothing observed may re-route, but unobserved
+    # buckets cost no projection and all drop
+    assert 32 in rec["kept"] and 512 in rec["kept"]
+    assert set(rec["dropped"]) == set(TEXT_BUCKETS) - {32, 512}
+    assert rec["projected_extra_waste_seconds"] == 0.0
+
+
+def test_projection_is_linear_reroute_cost():
+    """Dropping bucket 96 re-routes its seconds to 128 at cost
+    seconds * (128 - 96) / 128."""
+    rows = [_row(8, 96, 128, seconds=8.0),
+            _row(8, 128, 128, seconds=100.0)]
+    usage = axis_usage(rows, "text_bucket")
+    # budget exactly the 96->128 projection: 8 * 32/128 = 2.0 s of
+    # 108 s observed = ~1.852%
+    rec = recommend_axis(TEXT_BUCKETS, usage, max_extra_waste_pct=1.9)
+    assert 96 in rec["dropped"]
+    assert abs(rec["projected_extra_waste_seconds"] - 2.0) < 1e-9
+    tight = recommend_axis(TEXT_BUCKETS, usage, max_extra_waste_pct=1.8)
+    assert 96 in tight["kept"]  # under budget it stays
+
+
+def test_cascaded_drop_reprices_earlier_reroutes():
+    """Review-pass pin: dropping a bucket that was an earlier drop's
+    re-route target must re-price the earlier drop against the new
+    target — the accumulated-cost shortcut understated the projection
+    and could blow the budget under the tool's own model."""
+    table = (100, 200, 400)
+    rows = [_row(8, 100, 64, seconds=1.0), _row(8, 200, 64, seconds=4.0)]
+    usage = axis_usage(rows, "text_bucket")
+    # step 1 drops 100 (cheapest: 1*(200-100)/200 = 0.5 s).  Dropping
+    # 200 next re-prices 100's re-route to 400: true total =
+    # 1*(400-100)/400 + 4*(400-200)/400 = 0.75 + 2.0 = 2.75 s.  The
+    # old accumulated shortcut scored it 0.5 + 2.0 = 2.5 s.  Budget
+    # 2.6 s (52% of 5 s observed) sits between: 200 must be KEPT.
+    rec = recommend_axis(table, usage, max_extra_waste_pct=52.0)
+    assert rec["dropped"] == [100]
+    assert 200 in rec["kept"]
+    assert rec["projected_extra_waste_seconds"] <= 2.6
+
+
+def test_axis_top_never_dropped():
+    rows = [_row(8, TEXT_BUCKETS[-1], FRAME_BUCKETS[-1], seconds=5.0)]
+    rec = recommend_axis(TEXT_BUCKETS,
+                         axis_usage(rows, "text_bucket"), 100.0)
+    assert TEXT_BUCKETS[-1] in rec["kept"]
+
+
+def test_iteration_rows_excluded_from_text_axis():
+    """Iteration-mode window decodes carry text_bucket 0 — they must
+    not vouch for (or distort) the text axis."""
+    rows = [_row(4, 0, 256, seconds=50.0), _row(8, 64, 256, seconds=1.0)]
+    usage = axis_usage(rows, "text_bucket")
+    assert set(usage) == {64}
+
+
+def test_audit_end_to_end_report(tmp_path):
+    rows = [_row(8, 32, 128, seconds=10.0, waste=1.0),
+            _row(8, 64, 256, seconds=2.0, waste=0.5),
+            _row(1, 512, 2048, seconds=20.0)]
+    snapshot = {"dispatches_total": 3,
+                "padding_waste_seconds_total": 1.5,
+                "buckets": rows}
+    report = audit(snapshot, max_extra_waste_pct=10.0)
+    assert report["text_buckets"]["current"] == list(TEXT_BUCKETS)
+    assert report["warmup_shape_delta"]["observed_shapes"] == 3
+    # shapes collapse onto kept buckets; never more shapes than before
+    assert (report["warmup_shape_delta"]["projected_shapes"]
+            <= report["warmup_shape_delta"]["observed_shapes"])
+    # the report round-trips as JSON (the committed artifact contract)
+    json.loads(json.dumps(report))
+
+
+def test_committed_artifacts_are_consistent():
+    """The committed dump and report agree: re-running the audit on the
+    dump reproduces the committed recommendation."""
+    dump = REPO / "BUCKET_WASTE_r11.json"
+    committed = REPO / "BUCKET_AUDIT_r01.json"
+    snapshot = json.loads(dump.read_text())
+    report = audit(snapshot, max_extra_waste_pct=10.0)
+    prior = json.loads(committed.read_text())
+    assert report["text_buckets"]["kept"] == \
+        prior["text_buckets"]["kept"]
+    assert report["frame_buckets"]["kept"] == \
+        prior["frame_buckets"]["kept"]

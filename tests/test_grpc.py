@@ -609,7 +609,8 @@ def test_disconnect_mid_stream_stops_realtime_producer(tmp_path_factory):
     produced = []
     info_audio = v.voice.audio_output_info()
 
-    def endless_stream(phonemes, chunk_size, chunk_padding):
+    def endless_stream(phonemes, chunk_size, chunk_padding,
+                       deadline=None):
         # a pathological voice that would stream forever: only the
         # producer's cancel flag can stop it
         while True:

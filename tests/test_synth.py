@@ -55,6 +55,41 @@ def test_realtime_stream_drains(synth):
     assert all(len(c.samples) > 0 for c in chunks)
 
 
+def test_realtime_stream_legacy_model_signature_with_deadline():
+    """Review-pass pin: a model still implementing the pre-PR-10
+    3-parameter ``stream_synthesis(phonemes, chunk, padding)`` protocol
+    keeps serving realtime streams even when the caller sets a deadline
+    (the deadline is dropped for legacy models; the frontends' own
+    between-chunk checks still bound the request)."""
+    import numpy as np
+
+    from sonata_tpu.audio import Audio, AudioSamples
+    from sonata_tpu.core import AudioInfo, Phonemes
+    from sonata_tpu.serving import Deadline
+
+    class Legacy:
+        def phonemize_text(self, text):
+            return Phonemes(["x"])
+
+        def supports_streaming_output(self):
+            return True
+
+        def stream_synthesis(self, phonemes, chunk_size, chunk_padding):
+            yield Audio(AudioSamples(np.zeros(64, dtype=np.float32)),
+                        AudioInfo(sample_rate=16000), inference_ms=0.1)
+
+        def audio_output_info(self):
+            return AudioInfo(sample_rate=16000)
+
+    s = SpeechSynthesizer(Legacy())
+    chunks = list(s.synthesize_streamed("hi",
+                                        deadline=Deadline.after(30)))
+    assert len(chunks) == 1 and len(chunks[0].samples) == 64
+    # and without a deadline the legacy call shape is untouched
+    chunks = list(s.synthesize_streamed("hi"))
+    assert len(chunks) == 1
+
+
 def test_realtime_stream_forwards_errors():
     from sonata_tpu.core import OperationError
 

@@ -86,6 +86,12 @@ def main() -> None:
     ap.add_argument("--streaming-out", default="BENCH_STREAMING_CPU_r06.json")
     ap.add_argument("--skip-streaming", action="store_true")
     ap.add_argument("--skip-batch", action="store_true")
+    ap.add_argument("--streaming-configs", default=None,
+                    help="comma-separated subset of the streaming config "
+                         "names to run (default: all).  The in-bench "
+                         "iteration-vs-dispatch A/B runs inside every "
+                         "config, so a default_policy-only artifact "
+                         "still carries the batch-mode comparison.")
     args = ap.parse_args()
 
     note = ("host-CPU regression numbers (TPU tunnel down; absolute values "
@@ -118,15 +124,20 @@ def main() -> None:
 
     if args.skip_streaming:
         return
+    streaming_configs = STREAMING_CONFIGS
+    if args.streaming_configs:
+        wanted = {w.strip() for w in args.streaming_configs.split(",")}
+        streaming_configs = tuple(
+            (n, e) for n, e in STREAMING_CONFIGS if n in wanted)
     streaming = {"platform": "cpu", "note": note,
                  "cpu_count": os.cpu_count(), "configs": {}}
-    for name, env in STREAMING_CONFIGS:
+    for name, env in streaming_configs:
         print(f"[bench_cpu] streaming config {name} ...", flush=True)
         streaming["configs"][name] = {
             "env": env, **run_bench("bench_streaming.py", env)}
 
     def metric(cfg, name):
-        for r in streaming["configs"][cfg]["results"]:
+        for r in streaming["configs"].get(cfg, {}).get("results", ()):
             if r.get("metric") == name:
                 return r.get("value")
         return None

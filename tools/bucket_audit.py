@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""Bucket-lattice audit: recommend a smaller bucket set from live waste
+tables (the ROADMAP leftover from PR 9).
+
+The serving stack compiles one executable per (batch, text, frame)
+bucket triple, and the boot warmup (``serving/warmup.py``) compiles the
+whole enumerated lattice before readiness.  Every bucket in
+:mod:`sonata_tpu.utils.buckets` therefore costs twice: padding waste on
+every dispatch that rounds up to it, and warmup shapes on every boot.
+The PR-7 scope plane already *measures* both — the per-bucket
+hit/rows/padding/seconds/waste tables at ``GET /debug/buckets`` — so the
+bucket set should be a data-driven artifact, not a guess.
+
+This tool reads a waste-table snapshot (live URL or a committed dump),
+scores each text/frame bucket by observed traffic, and greedily drops
+low-traffic buckets whose removal keeps the *projected* extra padding
+waste under a budget:
+
+- dropping bucket ``X`` re-routes its rows to the next kept bucket
+  ``Y`` up; padded compute/transfer scales roughly linearly with the
+  bucket, so the projected extra cost of those dispatches is
+  ``seconds_X * (Y - X) / Y``;
+- a bucket that is the axis top (or whose traffic is the axis's
+  majority) is never dropped;
+- the report states, per axis: kept set, dropped set, projected extra
+  waste (seconds and % of observed dispatch seconds), and the
+  warmup-shape delta over the observed shape set (every observed
+  (b, t, f) triple collapses onto kept buckets; the deduplicated
+  difference is shapes a boot no longer compiles).
+
+Usage::
+
+    python tools/bucket_audit.py --dump BUCKET_WASTE_rNN.json \
+        [--out BUCKET_AUDIT_rNN.json] [--max-extra-waste-pct 10]
+    python tools/bucket_audit.py --url http://127.0.0.1:9100/debug/buckets
+
+The recommendation is advisory: applying it means editing
+``sonata_tpu/utils/buckets.py`` and re-measuring (the next ``/debug/
+buckets`` dump then validates the projection).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from sonata_tpu.utils.buckets import FRAME_BUCKETS, TEXT_BUCKETS  # noqa: E402
+
+
+def load_snapshot(url: str | None, dump: str | None) -> dict:
+    if url:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return json.loads(resp.read().decode())
+    with open(dump, encoding="utf-8") as fh:
+        return json.loads(fh.read())
+
+
+def axis_usage(rows: list, axis: str) -> dict:
+    """Per-bucket observed traffic on one axis: dispatches, rows,
+    seconds (attributed whole — a dispatch's cost rides its bucket on
+    every axis), waste_seconds."""
+    usage: dict = {}
+    for r in rows:
+        b = r.get(axis)
+        if not b:  # 0/None = rows without that axis (iteration-mode
+            continue  # window decodes carry no text bucket)
+        acc = usage.setdefault(b, {"dispatches": 0, "rows": 0,
+                                   "seconds": 0.0, "waste_seconds": 0.0})
+        acc["dispatches"] += r.get("dispatches", 0)
+        acc["rows"] += r.get("rows", 0)
+        acc["seconds"] += r.get("seconds", 0.0)
+        acc["waste_seconds"] += r.get("waste_seconds", 0.0)
+    return usage
+
+
+def recommend_axis(table: tuple, usage: dict,
+                   max_extra_waste_pct: float) -> dict:
+    """Greedy drop, cheapest-projection first, under the waste budget.
+
+    Projection model: rows using a dropped bucket X pad up to the next
+    kept bucket Y; padded compute/transfer is ~linear in the bucket, so
+    the extra cost is ``seconds_X * (Y - X) / Y``.  Unobserved buckets
+    drop for free (their projection is 0 — they only cost warmup
+    shapes and cache entries today).
+    """
+    total_seconds = sum(u["seconds"] for u in usage.values())
+    budget_s = total_seconds * max_extra_waste_pct / 100.0
+    kept = list(table)
+    dropped: list = []
+    extra_s = 0.0
+    majority = {b for b, u in usage.items()
+                if total_seconds > 0
+                and u["seconds"] > 0.5 * total_seconds}
+
+    def projection(bucket: int, kept_now: list) -> float:
+        u = usage.get(bucket)
+        if u is None:
+            return 0.0
+        ups = [k for k in kept_now if k > bucket]
+        if not ups:
+            return float("inf")  # axis top: re-routing has no target
+        y = min(ups)
+        return u["seconds"] * (y - bucket) / y
+
+    def total_projection(kept_now: list) -> float:
+        """Projected extra waste of the WHOLE dropped set against this
+        kept set — recomputed from scratch each step, because dropping a
+        bucket that was itself an earlier drop's re-route target raises
+        that earlier drop's true cost (100 re-routes to 200; drop 200
+        later and 100's rows now pad to 400)."""
+        return sum(projection(b, kept_now)
+                   for b in table if b not in kept_now)
+
+    while True:
+        candidates = []
+        for b in kept[:-1]:  # the axis top is never droppable
+            if b in majority:
+                continue
+            kept_minus = [k for k in kept if k != b]
+            candidates.append((total_projection(kept_minus), b))
+        candidates.sort()
+        picked = None
+        for cost, b in candidates:
+            if cost <= budget_s:
+                picked = (cost, b)
+                break
+        if picked is None:
+            break
+        extra_s, b = picked
+        kept.remove(b)
+        dropped.append(b)
+    return {
+        "kept": kept,
+        "dropped": sorted(dropped),
+        "observed_seconds": round(total_seconds, 6),
+        "projected_extra_waste_seconds": round(extra_s, 6),
+        "projected_extra_waste_pct": round(
+            100.0 * extra_s / total_seconds, 3) if total_seconds else 0.0,
+    }
+
+
+def shape_delta(rows: list, kept_text: list, kept_frame: list) -> dict:
+    """Warmup-shape delta over the observed shape set: every observed
+    (b, t, f) collapses onto the kept buckets; the deduplicated
+    difference is shapes a boot stops compiling."""
+
+    def up(v, table):
+        for b in sorted(table):
+            if v <= b:
+                return b
+        return sorted(table)[-1]
+
+    before, after = set(), set()
+    for r in rows:
+        t, f = r.get("text_bucket"), r.get("frame_bucket")
+        b = r.get("batch_bucket")
+        if not t or not f:
+            continue
+        before.add((b, t, f))
+        after.add((b, up(t, kept_text), up(f, kept_frame)))
+    return {"observed_shapes": len(before),
+            "projected_shapes": len(after),
+            "shapes_saved": len(before) - len(after)}
+
+
+def audit(snapshot: dict, max_extra_waste_pct: float = 10.0) -> dict:
+    rows = snapshot.get("buckets", [])
+    text_usage = axis_usage(rows, "text_bucket")
+    frame_usage = axis_usage(rows, "frame_bucket")
+    text_rec = recommend_axis(TEXT_BUCKETS, text_usage,
+                              max_extra_waste_pct)
+    frame_rec = recommend_axis(FRAME_BUCKETS, frame_usage,
+                               max_extra_waste_pct)
+    return {
+        "source_dispatches_total": snapshot.get("dispatches_total"),
+        "source_padding_waste_seconds_total":
+            snapshot.get("padding_waste_seconds_total"),
+        "max_extra_waste_pct": max_extra_waste_pct,
+        "text_buckets": {
+            "current": list(TEXT_BUCKETS),
+            "usage": {str(k): {kk: (round(vv, 6)
+                                    if isinstance(vv, float) else vv)
+                               for kk, vv in v.items()}
+                      for k, v in sorted(text_usage.items())},
+            **text_rec},
+        "frame_buckets": {
+            "current": list(FRAME_BUCKETS),
+            "usage": {str(k): {kk: (round(vv, 6)
+                                    if isinstance(vv, float) else vv)
+                               for kk, vv in v.items()}
+                      for k, v in sorted(frame_usage.items())},
+            **frame_rec},
+        "warmup_shape_delta": shape_delta(
+            rows, text_rec["kept"], frame_rec["kept"]),
+    }
+
+
+def render(report: dict) -> str:
+    lines = ["# Bucket-lattice audit", ""]
+    lines.append(f"source: {report['source_dispatches_total']} dispatches, "
+                 f"{report['source_padding_waste_seconds_total']}s "
+                 f"padding waste observed")
+    for axis in ("text_buckets", "frame_buckets"):
+        a = report[axis]
+        lines += [
+            "", f"## {axis}",
+            f"current : {a['current']}",
+            f"kept    : {a['kept']}",
+            f"dropped : {a['dropped']}",
+            f"projected extra waste: "
+            f"{a['projected_extra_waste_seconds']}s "
+            f"({a['projected_extra_waste_pct']}% of "
+            f"{a['observed_seconds']}s observed)",
+        ]
+    d = report["warmup_shape_delta"]
+    lines += ["", "## warmup-shape delta (observed shape set)",
+              f"{d['observed_shapes']} observed -> "
+              f"{d['projected_shapes']} projected "
+              f"({d['shapes_saved']} shapes saved per boot)"]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default=None,
+                    help="live /debug/buckets endpoint")
+    ap.add_argument("--dump", default=None,
+                    help="committed buckets-snapshot JSON")
+    ap.add_argument("--out", default=None,
+                    help="write the full report JSON here")
+    ap.add_argument("--max-extra-waste-pct", type=float, default=10.0,
+                    help="padding-waste budget the recommendation may "
+                         "spend to shrink the bucket set (default 10%%)")
+    args = ap.parse_args(argv)
+    if not args.url and not args.dump:
+        ap.error("one of --url / --dump is required")
+    snapshot = load_snapshot(args.url, args.dump)
+    report = audit(snapshot, args.max_extra_waste_pct)
+    print(render(report))
+    if args.out:
+        Path(args.out).write_text(
+            json.dumps(report, indent=1, sort_keys=True) + "\n",
+            encoding="utf-8")
+        print(f"\nwrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

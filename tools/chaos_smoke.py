@@ -65,6 +65,12 @@ from pathlib import Path
 parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
 parser.add_argument("--seed", type=int, default=1,
                     help="deterministic chaos seed (CI pins 1 and 2)")
+parser.add_argument("--batch-mode", default=None,
+                    choices=("dispatch", "iteration"),
+                    help="arm SONATA_BATCH_MODE process-wide for the "
+                         "whole schedule (CI runs seed 2 with "
+                         "iteration: the continuous-batching loop must "
+                         "compose with every fault path)")
 args = parser.parse_args()
 
 # all knobs must be in the environment BEFORE sonata_tpu imports: the
@@ -72,6 +78,10 @@ args = parser.parse_args()
 # read them at construction
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ["SONATA_FAILPOINT_SEED"] = str(args.seed)
+if args.batch_mode:
+    # armed before imports like every other knob; iteration mode routes
+    # realtime streams through the persistent decode loop (phase E2)
+    os.environ["SONATA_BATCH_MODE"] = args.batch_mode
 # probes are expedited by hand (next_probe_at rewind) so the prober can
 # never race a zero-healthy assertion
 os.environ["SONATA_REPLICA_PROBE_INTERVAL_S"] = "600"
@@ -426,6 +436,44 @@ def main() -> int:
     check("schedule outcomes accounted",
           sum(outcomes.values()) == 14, f"({outcomes})")
     check("pool healthy after the schedule", heal_pool())
+
+    # ---- phase E2 (--batch-mode iteration only): the persistent
+    # iteration loop serves concurrent realtime streams in the SAME
+    # armed process the schedule just battered — the continuous-batching
+    # mode must compose with the whole chaos surface, and the loop's
+    # join/retire books must balance when the streams end ----
+    if args.batch_mode == "iteration":
+        realtime_rpc = channel.unary_stream(
+            "/sonata_grpc.sonata_grpc/SynthesizeUtteranceRealtime",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=pb.WaveSamples.decode)
+        stream_chunks: list = [None, None]
+
+        def run_stream(j: int) -> None:
+            try:
+                stream_chunks[j] = list(realtime_rpc(
+                    pb.Utterance(voice_id=voice_id, text=TEXTS[0]),
+                    timeout=RPC_TIMEOUT_S,
+                    metadata=(("x-request-id",
+                               f"iter-{args.seed}-{j}"),)))
+            except grpc.RpcError:
+                stream_chunks[j] = None
+
+        st_threads = [threading.Thread(target=run_stream, args=(j,))
+                      for j in range(2)]
+        for t in st_threads:
+            t.start()
+        for t in st_threads:
+            t.join(timeout=BUDGET_S * 2)
+        check("iteration-mode realtime streams produce audio post-chaos",
+              all(c and all(len(x.wav_samples) > 0 for x in c)
+                  for c in stream_chunks))
+        it_stats = (service._voices[voice_id].synth.dispatch_stats()
+                    or {}).get("iteration") or {}
+        check("iteration loop joined and retired both streams",
+              it_stats.get("joined", 0) >= 2
+              and it_stats.get("retired") == it_stats.get("joined"),
+              f"({it_stats})")
 
     # deterministic sweep: every registered site fires at least once per
     # run, whatever the random draw skipped (warmup fired in phase B)

@@ -89,10 +89,10 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     python -m pytest tests/test_parallel.py -q 2>&1 | tee -a "$LOG"
 rc_parallel=${PIPESTATUS[0]}
 
-echo "-- step 6/7: chaos smoke (failpoints/watchdog/degradation, seeds 1+2)" | tee -a "$LOG"
+echo "-- step 6/7: chaos smoke (failpoints/watchdog/degradation, seeds 1+2; seed 2 under SONATA_BATCH_MODE=iteration)" | tee -a "$LOG"
 JAX_PLATFORMS=cpu python tools/chaos_smoke.py --seed 1 2>&1 | tee -a "$LOG"
 rc_chaos1=${PIPESTATUS[0]}
-JAX_PLATFORMS=cpu python tools/chaos_smoke.py --seed 2 2>&1 | tee -a "$LOG"
+JAX_PLATFORMS=cpu python tools/chaos_smoke.py --seed 2 --batch-mode iteration 2>&1 | tee -a "$LOG"
 rc_chaos2=${PIPESTATUS[0]}
 
 echo "-- step 7/7: bench trend (reported, non-blocking)" | tee -a "$LOG"

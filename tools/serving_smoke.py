@@ -142,6 +142,104 @@ def warm_restart_boot() -> int:
     return 0
 
 
+def iteration_boot() -> int:
+    """Subprocess entry for the iteration-mode phase (PR 10): one full
+    server boot with ``SONATA_BATCH_MODE=iteration`` + the full warmup
+    lattice (which now enumerates the iteration-mode window-decoder
+    ladder), concurrent realtime streams as traffic, reporting one
+    ``ITERBOOT {json}`` line: readiness, per-iteration attribution
+    (dispatch spans with ``mode=iteration`` + peers, scope bucket rows),
+    and the cold-compile count — which must be ZERO, proving the
+    graduated-ladder iterations are recompile-free under the smoke mix.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from sonata_tpu.utils.jax_cache import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache(0.0)
+    import json
+    import threading
+
+    import grpc
+
+    from sonata_tpu.frontends import grpc_messages as pb
+    from sonata_tpu.frontends.grpc_server import create_server
+    from sonata_tpu.serving import parse_prometheus_text
+
+    cfg = os.environ["SMOKE_VOICE_CFG"]
+    server, port = create_server(0, continuous_batching=True,
+                                 metrics_port=0, request_timeout_s=60.0)
+    server.start()
+    runtime = server.sonata_runtime
+    base = f"http://127.0.0.1:{runtime.http_port}"
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    load = channel.unary_unary(
+        "/sonata_grpc.sonata_grpc/LoadVoice",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.VoiceInfo.decode)
+    realtime = channel.unary_stream(
+        "/sonata_grpc.sonata_grpc/SynthesizeUtteranceRealtime",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.WaveSamples.decode)
+    info = load(pb.VoicePath(config_path=cfg))
+    server.sonata_service.warmup_and_mark_ready()
+    ready_code, _ = http_get(base + "/readyz")
+
+    text = "Iteration mode serves concurrent streams from one batch."
+    stream_ok = [False] * 4
+
+    def run_stream(i: int) -> None:
+        chunks = list(realtime(
+            pb.Utterance(voice_id=info.voice_id, text=text),
+            metadata=(("x-request-id", f"iter-smoke-{i}"),)))
+        stream_ok[i] = bool(chunks) and all(
+            len(c.wav_samples) > 0 for c in chunks)
+
+    for _wave in range(2):
+        threads = [threading.Thread(target=run_stream, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    # per-iteration attribution: the stream's trace carries dispatch
+    # spans with mode=iteration, peer request ids, and padding ratio
+    code, body = http_get(base + "/debug/traces")
+    traces = json.loads(body).get("traces", []) if code == 200 else []
+    it_spans = [s for t in traces for s in t.get("spans", [])
+                if s["name"] == "dispatch"
+                and s.get("attrs", {}).get("mode") == "iteration"]
+    attributed = bool(it_spans) and all(
+        {"batch_bucket", "padding_ratio", "request_ids",
+         "dispatch_id"} <= set(s.get("attrs", {})) for s in it_spans)
+    shared = any(len(s["attrs"].get("request_ids", [])) > 1
+                 for s in it_spans)
+    code, body = http_get(base + "/debug/buckets")
+    bdoc = json.loads(body) if code == 200 else {}
+    iter_rows = [r for r in bdoc.get("buckets", [])
+                 if r.get("text_bucket") == 0]
+    parsed = parse_prometheus_text(http_get(base + "/metrics")[1])
+    colds = sum(v for _lbl, v in parsed.get(
+        "sonata_runtime_cold_compiles_total", []))
+    stats = server.sonata_service._voices[
+        info.voice_id].synth.dispatch_stats() or {}
+    report = {"ready": ready_code == 200,
+              "streams_ok": all(stream_ok),
+              "runtime_cold_compiles": int(colds),
+              "iteration_spans": len(it_spans),
+              "spans_attributed": attributed,
+              "spans_share_iterations": shared,
+              "bucket_rows_iteration": len(iter_rows),
+              "batch_mode": stats.get("batch_mode"),
+              "iteration_stats": stats.get("iteration")}
+    print("ITERBOOT " + json.dumps(report))
+    server.stop(grace=None)
+    server.sonata_service.shutdown()
+    return 0
+
+
 def main(args=None) -> int:
     import jax
 
@@ -412,6 +510,62 @@ def main(args=None) -> int:
     server.stop(grace=None)
     server.sonata_service.shutdown()
 
+    # ---- iteration-mode phase (PR 10): continuous batching ----
+    # A real SUBPROCESS boot (the mode + full-lattice env must be set
+    # before the process's first compile) with SONATA_BATCH_MODE=
+    # iteration: concurrent realtime streams must ride shared
+    # iterations with per-iteration attribution, and the full lattice
+    # (which enumerates the graduated window-decoder ladder) must leave
+    # ZERO post-warmup cold compiles under the smoke mix — the PR-9
+    # containment proving the loop recompile-free.
+    import json
+    import subprocess
+    import time
+
+    iter_cache = tempfile.mkdtemp(prefix="smoke_iter_cache")
+    iter_env = dict(os.environ,
+                    SONATA_BATCH_MODE="iteration",
+                    SONATA_DISPATCH_POLICY="on",
+                    SONATA_WARMUP_LATTICE="full",
+                    SONATA_JAX_CACHE_DIR=iter_cache,
+                    JAX_PLATFORMS="cpu",
+                    SMOKE_VOICE_CFG=cfg)
+    p = subprocess.run(
+        [sys.executable, __file__, "--iteration-boot"],
+        env=iter_env, capture_output=True, text=True, timeout=900)
+    check("iteration: boot subprocess exits 0", p.returncode == 0,
+          f"(rc {p.returncode}: "
+          f"{p.stderr.strip().splitlines()[-3:] if p.stderr else ''})")
+    lines = [line for line in p.stdout.splitlines()
+             if line.startswith("ITERBOOT ")]
+    rep = json.loads(lines[-1][len("ITERBOOT "):]) if lines else {}
+    check("iteration: readyz 200 after full-lattice warmup",
+          rep.get("ready") is True, f"({rep})")
+    check("iteration: batch mode resolved to iteration",
+          rep.get("batch_mode") == "iteration")
+    check("iteration: concurrent realtime streams all produced audio",
+          rep.get("streams_ok") is True)
+    check("iteration: dispatch spans carry per-iteration attribution",
+          rep.get("spans_attributed") is True,
+          f"({rep.get('iteration_spans')} spans)")
+    it_stats_early = rep.get("iteration_stats") or {}
+    check("iteration: streams shared iterations (peer request ids "
+          "or rows > dispatches)",
+          rep.get("spans_share_iterations") is True
+          or it_stats_early.get("dispatches", 0)
+          < it_stats_early.get("requests", 0))
+    check("iteration: scope bucket rows account per-iteration padding",
+          rep.get("bucket_rows_iteration", 0) >= 1)
+    it_stats = rep.get("iteration_stats") or {}
+    check("iteration: loop stats joined/retired balance",
+          it_stats.get("joined", 0) >= 8
+          and it_stats.get("retired") == it_stats.get("joined"),
+          f"({it_stats})")
+    check("iteration: sonata_runtime_cold_compiles_total == 0 "
+          "(recompile-free under the smoke mix)",
+          rep.get("runtime_cold_compiles") == 0,
+          f"({rep.get('runtime_cold_compiles')})")
+
     # ---- warm-restart phase (ISSUE 9): lattice + persistent cache ----
     # Each boot is a real SUBPROCESS: a rolling restart is a new
     # process, and the JAX persistent compile cache only engages when
@@ -526,7 +680,11 @@ if __name__ == "__main__":
                          "omitted in CI so the artifact never churns")
     ap.add_argument("--warm-restart-boot", action="store_true",
                     help=argparse.SUPPRESS)  # subprocess entry
+    ap.add_argument("--iteration-boot", action="store_true",
+                    help=argparse.SUPPRESS)  # subprocess entry
     cli_args = ap.parse_args()
     if cli_args.warm_restart_boot:
         sys.exit(warm_restart_boot())
+    if cli_args.iteration_boot:
+        sys.exit(iteration_boot())
     sys.exit(main(cli_args))
