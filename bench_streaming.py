@@ -19,6 +19,16 @@ SENTENCE = ("Streaming synthesis should deliver the first chunk quickly "
 
 
 def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-ab", action="store_true",
+                    help="skip the in-bench batch-mode/pipeline A/B "
+                         "(three extra voices; the precision-arm "
+                         "configs in bench_cpu only need the headline "
+                         "metrics)")
+    args = ap.parse_args()
+
     from bench import accelerator_ready_with_retries
 
     if accelerator_ready_with_retries() is None:
@@ -230,37 +240,55 @@ def main() -> None:
         }))
 
     # ----------------------------------------------------------------
-    # iteration-vs-dispatch A/B (SONATA_BATCH_MODE): same host, fresh
-    # voice per arm, coalescing forced ON for both (the modes differ in
-    # HOW a batch forms, not whether; the CPU default policy would give
-    # both arms per-request dispatch and measure nothing), interleaved
-    # runs at 1/4/8 streams so host noise hits both arms equally.
-    # Primary metric on this 2-vCPU host: the per-iteration padding
-    # ratio (deterministic, above noise); TTFB p50s are reported but
-    # carry the documented 2x run-to-run swing under oversubscription.
+    # iteration-vs-dispatch AND pipelined-vs-sync A/B: same host, fresh
+    # voice per arm, coalescing forced ON for all (the arms differ in
+    # HOW a batch forms/fetches, not whether; the CPU default policy
+    # would give every arm per-request dispatch and measure nothing),
+    # interleaved runs at 1/4/8 streams so host noise hits all arms
+    # equally.  Three arms:
+    #   dispatch        — PR-1 wave batching
+    #   iteration       — persistent loop, pipelined fetch (the default:
+    #                     SONATA_ITER_PIPELINE=1)
+    #   iteration_sync  — persistent loop, synchronous fetch
+    #                     (SONATA_ITER_PIPELINE=0)
+    # Primary metrics on this 2-vCPU host: the per-iteration padding
+    # ratio and the fetch-overlap fraction (both deterministic engine
+    # accounting, above noise); TTFB p50s are reported but carry the
+    # documented 2x run-to-run swing under oversubscription.
     # ----------------------------------------------------------------
+    if args.skip_ab:
+        return
     import os as _os
 
+    AB_ARMS = {
+        "dispatch": {"SONATA_BATCH_MODE": "dispatch"},
+        "iteration": {"SONATA_BATCH_MODE": "iteration",
+                      "SONATA_ITER_PIPELINE": "1"},
+        "iteration_sync": {"SONATA_BATCH_MODE": "iteration",
+                           "SONATA_ITER_PIPELINE": "0"},
+    }
     _saved_env = {k: _os.environ.get(k)
-                  for k in ("SONATA_BATCH_MODE", "SONATA_DISPATCH_POLICY")}
+                  for k in ("SONATA_BATCH_MODE", "SONATA_DISPATCH_POLICY",
+                            "SONATA_ITER_PIPELINE")}
     _os.environ["SONATA_DISPATCH_POLICY"] = "on"
 
-    def _set_mode(mode: str) -> None:
-        _os.environ["SONATA_BATCH_MODE"] = mode
+    def _set_arm(arm: str) -> None:
+        for k, v in AB_ARMS[arm].items():
+            _os.environ[k] = v
 
     ab_voices = {}
     try:
-        for mode in ("dispatch", "iteration"):
-            _set_mode(mode)
+        for arm in AB_ARMS:
+            _set_arm(arm)
             vm = PiperVoice.random(seed=0, audio={"sample_rate": 22050,
                                                   "quality": "high"})
             vm.prewarm(texts=[SENTENCE], streaming=True, chunk_size=55,
                        chunk_padding=3)
-            ab_voices[mode] = vm
+            ab_voices[arm] = vm
 
-        def _one_run(mode: str, n: int) -> float:
-            _set_mode(mode)
-            vm = ab_voices[mode]
+        def _one_run(arm: str, n: int) -> float:
+            _set_arm(arm)
+            vm = ab_voices[arm]
             sm = SpeechSynthesizer(vm)
 
             def first_chunk(i: int) -> float:
@@ -279,21 +307,36 @@ def main() -> None:
                 return statistics.median(ex.map(first_chunk, range(n)))
 
         RUNS_PER_ARM = 3
+        ab_p50s: dict = {}
         for n in (1, 4, 8):
-            p50s = {"dispatch": [], "iteration": []}
+            p50s = {arm: [] for arm in AB_ARMS}
             for _rep in range(RUNS_PER_ARM):
-                for mode in ("dispatch", "iteration"):  # interleaved
-                    p50s[mode].append(_one_run(mode, n))
-            for mode in ("dispatch", "iteration"):
+                for arm in AB_ARMS:  # interleaved
+                    p50s[arm].append(_one_run(arm, n))
+            for arm in AB_ARMS:
+                ab_p50s[(arm, n)] = statistics.median(p50s[arm])
                 print(json.dumps({
                     "metric": f"batch_mode_ab_ttfb_p50_at_{n}_streams_"
-                              f"{mode}",
-                    "value": round(
-                        statistics.median(p50s[mode]) * 1000.0, 2),
+                              f"{arm}",
+                    "value": round(ab_p50s[(arm, n)] * 1000.0, 2),
                     "unit": "ms",
                     "vs_baseline": None,
                     "runs": RUNS_PER_ARM,
                 }))
+        for n in (4, 8):
+            print(json.dumps({
+                # name avoids the trend tool's direction fragments:
+                # this is a report-only ratio (sync-fetch p50 over
+                # pipelined p50 — above 1.0 means pipelining won)
+                "metric": f"iter_pipeline_ab_sync_over_pipelined_"
+                          f"at_{n}_streams",
+                "value": round(ab_p50s[("iteration_sync", n)]
+                               / max(ab_p50s[("iteration", n)], 1e-9), 4),
+                "unit": "ratio_sync_over_pipelined",
+                "vs_baseline": None,
+                "note": "supporting evidence on a 2-vCPU host "
+                        "(documented 2x oversubscription swings)",
+            }))
 
         def _padding_ratio(stats: dict) -> float:
             rows = stats.get("rows", 0)
@@ -301,14 +344,14 @@ def main() -> None:
             return round(padded / max(rows + padded, 1), 4)
 
         ratios = {}
-        for mode in ("dispatch", "iteration"):
-            st = ab_voices[mode].dispatch_stats()
-            s = st["iteration"] if mode == "iteration" \
+        for arm in AB_ARMS:
+            st = ab_voices[arm].dispatch_stats()
+            s = st["iteration"] if arm.startswith("iteration") \
                 else st["stream_decode"]
-            ratios[mode] = _padding_ratio(s or {})
+            ratios[arm] = _padding_ratio(s or {})
             print(json.dumps({
-                "metric": f"window_decode_padding_ratio_{mode}",
-                "value": ratios[mode],
+                "metric": f"window_decode_padding_ratio_{arm}",
+                "value": ratios[arm],
                 "unit": "padding_rows_over_total_rows",
                 "vs_baseline": None,
                 "engine_stats": s,
@@ -321,6 +364,25 @@ def main() -> None:
             "unit": "ratio_iteration_over_dispatch",
             "vs_baseline": None,
         }))
+        # fetch-overlap fraction: of the iterations each loop ran, how
+        # many dispatched while the previous iteration's fetch was
+        # still in flight — deterministic engine accounting, the
+        # pipelined arm's above-noise headline (sync arm is 0 by
+        # construction)
+        for arm in ("iteration", "iteration_sync"):
+            s = ab_voices[arm].dispatch_stats()["iteration"] or {}
+            overlap = round(s.get("fetch_overlapped", 0)
+                            / max(s.get("iterations", 0), 1), 4)
+            suffix = "" if arm == "iteration" else "_sync"
+            print(json.dumps({
+                "metric": f"iter_fetch_overlap{suffix}",
+                "value": overlap,
+                "unit": "overlapped_iterations_over_iterations",
+                "vs_baseline": None,
+                "engine_stats": {k: s.get(k) for k in
+                                 ("iterations", "fetch_overlapped",
+                                  "rows", "padded_rows")},
+            }))
     finally:
         for vm in ab_voices.values():
             vm.close()

@@ -66,7 +66,7 @@ from ..utils.dispatch_policy import (
     resolve_policy,
     should_donate,
 )
-from . import vits
+from . import decode_opts, vits
 from .chunker import CROSSFADE_SAMPLES, plan_chunks
 from .config import ModelConfig, SynthesisConfig, default_phoneme_id_map
 from .serialization import load_params
@@ -78,9 +78,32 @@ class PiperVoice(BaseModel):
     def __init__(self, config: ModelConfig, params, *, seed: int = 0,
                  tashkeel: Optional[TashkeelEngine] = None, mesh=None,
                  compute_dtype: Optional[str] = None,
-                 dispatch_policy: "Optional[DispatchPolicy]" = None):
+                 dispatch_policy: "Optional[DispatchPolicy]" = None,
+                 fused_epilogue: Optional[str] = None,
+                 decode_quant: Optional[str] = None):
         self.config = config
         self.hp = config.hyper
+        # int8 weight-only decoder arm (SONATA_DECODE_QUANT=int8):
+        # per-channel symmetric quantization of the HiFi-GAN conv
+        # weights at load, dequantized inside the jitted decode
+        # (vits.decode_with) — activations stay f32/bf16.  Parity-gated
+        # by the spectral-distance test in tests/test_decode_opts.py.
+        self.decode_quant = decode_opts.resolve_decode_quant(decode_quant)
+        if self.decode_quant == "int8":
+            if mesh is not None:
+                raise OperationError(
+                    "SONATA_DECODE_QUANT=int8 does not compose with a "
+                    "device mesh (param shardings assume f32 leaves)")
+            if not decode_opts.decoder_is_quantized(params["dec"]):
+                params = dict(params)
+                params["dec"] = decode_opts.quantize_decoder(
+                    params["dec"])
+        # fused decode epilogue (SONATA_FUSED_EPILOGUE=pallas|lax|off,
+        # default lax): streaming window decode + crossfade taper +
+        # peak-scaled i16 quantize run as ONE device program per
+        # (width, batch rung) — see _decode_windows_fused_fn.
+        self.fused_epilogue = decode_opts.resolve_fused_epilogue(
+            fused_epilogue)
         self.params = params
         self.mesh = mesh  # jax.sharding.Mesh → batch rides the data axis
         # Reduced-precision policy for the HiFi-GAN conv stack (the FLOPs):
@@ -264,7 +287,9 @@ class PiperVoice(BaseModel):
             tashkeel=self._tashkeel,
             compute_dtype=("bfloat16" if self.compute_dtype is not None
                            else None),
-            dispatch_policy=self._dispatch_policy)
+            dispatch_policy=self._dispatch_policy,
+            fused_epilogue=self.fused_epilogue,
+            decode_quant=self.decode_quant or "off")
         replica.device = device
         replica.scope_voice = self.scope_voice
         return replica
@@ -427,11 +452,12 @@ class PiperVoice(BaseModel):
 
         with self._jit_lock:
             seen = [k for k in self._dec_cache if isinstance(k, tuple)
-                    and k and k[0] == "wbatch"]
+                    and k and k[0] in ("wbatch", "wfused")]
             enc_seen = [k for k in self._enc_cache]
             aco_seen = list(self._aco_cache)
         co = self._stream_decoder
         c = self.hp.inter_channels
+        hop = self.hp.hop_length
         thunks = []
         # every width must be warm at BOTH canonical batch sizes: the
         # sequential drain itself coalesces its look-ahead windows, so a
@@ -444,14 +470,25 @@ class PiperVoice(BaseModel):
             batch_set = {b for b in BATCH_BUCKETS if b <= co._max_batch}
         else:
             batch_set = {1, co._max_batch}
-        widths = {(k[1], k[3]) for k in seen}
-        for (width, has_sid) in widths:
+        # each variant (fused vs plain) warms wherever it was seen — a
+        # fused-default voice drains streams through wfused shapes while
+        # direct decode() callers may still touch wbatch ones
+        widths = {(k[1], k[3], k[0] == "wfused") for k in seen}
+        for (width, has_sid, fused) in widths:
             for b in batch_set:
 
-                def warm_dec(width=width, b=b, has_sid=has_sid):
-                    fn = self._decode_windows_batch_fn(width, b, has_sid)
+                def warm_dec(width=width, b=b, has_sid=has_sid,
+                             fused=fused):
                     args = [self.params, jnp.zeros((b, width, c),
                                                    jnp.float32)]
+                    if fused:
+                        fn = self._decode_windows_fused_fn(width, b,
+                                                           has_sid)
+                        args += [jnp.zeros((b,), jnp.int32),
+                                 jnp.full((b,), width * hop, jnp.int32)]
+                    else:
+                        fn = self._decode_windows_batch_fn(width, b,
+                                                           has_sid)
                     if has_sid:
                         args.append(jnp.zeros((b,), jnp.int32))
                     jax.block_until_ready(fn(*args))
@@ -680,10 +717,22 @@ class PiperVoice(BaseModel):
         """
         if shape and shape[0] == "wdec":
             _tag, width, b, has_sid = shape
-            fn = self._decode_windows_batch_fn(width, b, has_sid)
+            # warm the variant real traffic dispatches through: the
+            # fused decode+epilogue program when SONATA_FUSED_EPILOGUE
+            # is on (the default), the plain window decoder otherwise —
+            # warming the wrong one would leave every live iteration
+            # cold and trip the PR-9 containment
+            fused = self.fused_epilogue != "off"
             args = [self.params,
                     jnp.zeros((b, width, self.hp.inter_channels),
                               jnp.float32)]
+            if fused:
+                fn = self._decode_windows_fused_fn(width, b, has_sid)
+                hop = self.hp.hop_length
+                args += [jnp.zeros((b,), jnp.int32),
+                         jnp.full((b,), width * hop, jnp.int32)]
+            else:
+                fn = self._decode_windows_batch_fn(width, b, has_sid)
             if has_sid:
                 args.append(jnp.zeros((b,), jnp.int32))
             jax.block_until_ready(fn(*args))
@@ -737,7 +786,7 @@ class PiperVoice(BaseModel):
                  repr(sorted(vars(self.hp).items())),
                  self.config.num_symbols, self.config.num_speakers,
                  str(self.compute_dtype), bool(self.multi_speaker),
-                 tuple(shape))
+                 str(self.decode_quant), tuple(shape))
         return hashlib.blake2b(repr(parts).encode(),
                                digest_size=16).hexdigest()
 
@@ -1240,6 +1289,52 @@ class PiperVoice(BaseModel):
                 self._dec_cache[key] = fn
         return fn
 
+    def _decode_windows_fused_fn(self, width: int, b: int, has_sid: bool):
+        """Fused-epilogue variant of :meth:`_decode_windows_batch_fn`
+        (``SONATA_FUSED_EPILOGUE=lax|pallas``): window decode +
+        crossfade taper + peak-scaled i16 quantize as ONE device
+        program.
+
+        Extra args ``lo``/``hi`` [B] are each row's emitted sample range
+        (value-dynamic, shape-static — the executable set stays one per
+        (width, batch rung), exactly like the unfused fn, so the warmup
+        lattice covers it).  Returns (i16 [B, width*hop], peak [B]); the
+        host dequantizes and slices instead of tapering — the per-chunk
+        epilogue leaves the TTFB path, and the result transfer halves
+        (i16 + per-row peak instead of f32)."""
+        mode = self.fused_epilogue
+        key = ("wfused", width, b, has_sid, mode)
+        with self._jit_lock:
+            fn = self._dec_cache.get(key)
+            if fn is None:
+                hp = self.hp
+                cdt = self.compute_dtype
+
+                def run(params, windows, lo, hi, sid=None):
+                    g = (params["emb_g"][sid][:, None, :]
+                         if sid is not None else None)
+                    wav = vits.decode(params, hp, windows, g=g,
+                                      compute_dtype=cdt)
+                    return decode_opts.fused_epilogue(
+                        wav, lo, hi, CROSSFADE_SAMPLES, mode=mode)
+
+                fn = jax.jit(run)
+                self._dec_cache[key] = fn
+        return fn
+
+    def _wdec_cache_key(self, width: int, b: int, has_sid: bool,
+                        fused: Optional[bool] = None) -> tuple:
+        """The decode-cache key live window-decode traffic dispatches
+        through for this (width, batch, sid) shape — fused when the
+        epilogue arm is on (the default), the plain batch decoder
+        otherwise.  The single place warmup, attribution, and tests
+        resolve the active variant."""
+        if fused is None:
+            fused = self.fused_epilogue != "off"
+        if fused:
+            return ("wfused", width, b, has_sid, self.fused_epilogue)
+        return ("wbatch", width, b, has_sid, should_donate())
+
     @property
     def dispatch_policy(self) -> DispatchPolicy:
         """The resolved backend-adaptive dispatch policy (lazy, cached).
@@ -1592,26 +1687,42 @@ class PiperVoice(BaseModel):
         LOOKAHEAD = 3
         plans = list(plan_chunks(total_frames, chunk_size, chunk_padding))
 
+        # fused decode epilogue (default): the crossfade taper and the
+        # i16 quantize ride the decode's device program — the host only
+        # dequantizes and slices, so the per-chunk epilogue leaves the
+        # TTFB path and the D2H transfer halves
+        fused = self.fused_epilogue != "off"
+
         def submit(plan):
             width = bucket_for(plan.width, FRAME_BUCKETS)
             start = min(plan.win_start, max(f - width, 0))
-            return (plan, start, width,
+            shift = plan.win_start - start  # window moved left by pad
+            lo = (shift + plan.trim_left) * hop
+            hi = (shift + plan.width - plan.trim_right) * hop
+            return (plan, start, width, lo, hi,
                     decoder.submit(z_row, start, width, sid0,
-                                   stream=handle))
+                                   stream=handle,
+                                   epilogue=(lo, hi) if fused else None))
 
         try:
             submitted = [submit(p) for p in plans[:LOOKAHEAD]]
             next_i = len(submitted)
             while submitted:
-                plan, start, width, fut = submitted.pop(0)
+                plan, start, width, lo, hi, fut = submitted.pop(0)
                 t0 = time.perf_counter()
                 with tracing.span("decode-window", width=width):
-                    wav = fut.result()
-                shift = plan.win_start - start  # window moved left by pad
-                lo = (shift + plan.trim_left) * hop
-                hi = (shift + plan.width - plan.trim_right) * hop
-                samples = AudioSamples(wav[lo:hi])
-                samples.crossfade(CROSSFADE_SAMPLES)  # edge taper (:838)
+                    out = fut.result()
+                if fused:
+                    q, peak = out
+                    # slice BEFORE dequantizing: the device zeroed
+                    # everything outside [lo, hi), so the float work
+                    # stays proportional to the emitted chunk
+                    samples = AudioSamples(
+                        decode_opts.dequantize_chunk(q[lo:hi], peak))
+                    # taper already applied on device
+                else:
+                    samples = AudioSamples(out[lo:hi])
+                    samples.crossfade(CROSSFADE_SAMPLES)  # taper (:838)
                 ms = (time.perf_counter() - t0) * 1000.0 + enc_ms
                 enc_ms = 0.0  # encoder cost attributed to the first chunk
                 if next_i < len(plans):  # top up look-ahead before yield
@@ -1630,6 +1741,41 @@ class PiperVoice(BaseModel):
 # rest of the gather/dispatch machinery; re-exported here because the
 # coalescer drain contract is pinned against this module
 _drain_pending_futures = drain_pending_futures
+
+
+def _assemble_window_dispatch(v: "PiperVoice", key, payloads: list,
+                              b: int):
+    """Build one window-decode group's (fn, args) padded to ``b`` rows —
+    the ONE place the (window, sid[, lo, hi]) payload layout is
+    consumed, shared by both engines so the fused contract cannot
+    desynchronize between them."""
+    width, has_sid, fused = key
+    pad = b - len(payloads)
+    windows = jnp.stack([p[0] for p in payloads]
+                        + [payloads[0][0]] * pad)
+    args = [v.params, windows]
+    if fused:
+        args += [jnp.asarray([p[2] for p in payloads]
+                             + [payloads[0][2]] * pad, jnp.int32),
+                 jnp.asarray([p[3] for p in payloads]
+                             + [payloads[0][3]] * pad, jnp.int32)]
+    if has_sid:
+        args.append(jnp.asarray(
+            [p[1] for p in payloads] + [payloads[0][1]] * pad,
+            dtype=jnp.int32))
+    fn = (v._decode_windows_fused_fn(width, b, has_sid) if fused
+          else v._decode_windows_batch_fn(width, b, has_sid))
+    return fn, args
+
+
+def _fetch_window_results(out, n: int, fused: bool) -> list:
+    """The finisher-side twin: blocking fetch + per-row unpack.  Fused
+    results are (i16 row, peak) pairs; plain results f32 rows."""
+    if fused:
+        q, peaks = jax.device_get(out)
+        q, peaks = np.asarray(q), np.asarray(peaks)
+        return [(q[i], float(peaks[i])) for i in range(n)]
+    return list(np.asarray(jax.device_get(out))[:n])
 
 
 class _StreamDecodeCoalescer:
@@ -1690,19 +1836,26 @@ class _StreamDecodeCoalescer:
         self._core.shutdown(join_timeout_s=10.0)
 
     def submit(self, z_row, start: int, width: int, sid: "Optional[int]",
-               stream=None):
+               stream=None, epilogue=None):
         """Enqueue a window decode; returns a Future of the [width*hop]
-        waveform.  ``z_row``: [F, C] device array.  ``stream`` is the
+        waveform — or, with ``epilogue=(lo, hi)`` (the fused-epilogue
+        arm), of an ``(i16 samples, peak)`` pair already tapered on
+        device.  ``z_row``: [F, C] device array.  ``stream`` is the
         iteration-mode join handle — ignored here (dispatch mode has no
         resident-stream state).
 
         The window is sliced out of ``z_row`` here, eagerly (a tiny
         on-device op), so everything behind the queue handles fixed
         [width, C] windows regardless of the utterance's frame bucket —
-        see :meth:`PiperVoice._decode_windows_batch_fn`."""
+        see :meth:`PiperVoice._decode_windows_batch_fn`.  Fused and
+        plain submissions carry distinct keys (different executables
+        AND result types), so they never share a dispatch group."""
         window = jax.lax.dynamic_slice_in_dim(
             z_row, jnp.int32(start), width, axis=0)
-        item = WorkItem((window, sid), key=(width, sid is not None))
+        fused = epilogue is not None
+        payload = ((window, sid, epilogue[0], epilogue[1]) if fused
+                   else (window, sid))
+        item = WorkItem(payload, key=(width, sid is not None, fused))
         if self._core.closed:
             try_set_exception(item.future, OperationError(self._reason))
             return item.future
@@ -1727,33 +1880,24 @@ class _StreamDecodeCoalescer:
         # (Iteration mode walks the graduated ladder instead — and warms
         # every rung through the lattice; see _IterationStreamDecoder.)
         b = self._max_batch if n > 1 else 1
-        pad = b - n
-        windows = jnp.stack([item.payload[0] for item in group]
-                            + [group[0].payload[0]] * pad)
-        width, has_sid = group[0].key
-        args = [v.params, windows]
-        if has_sid:
-            args.append(jnp.asarray(
-                [item.payload[1] for item in group]
-                + [group[0].payload[1]] * pad, dtype=jnp.int32))
-        fn = v._decode_windows_batch_fn(width, b, has_sid)
+        fused = group[0].key[2]
+        fn, args = _assemble_window_dispatch(
+            v, group[0].key, [item.payload for item in group], b)
         out = fn(*args)  # async dispatch
-        try:
-            out.copy_to_host_async()
-        except (AttributeError, RuntimeError):
-            pass
+        PiperVoice._prefetch_to_host(out)
         self._core.bump("requests", n)
         self._core.bump("dispatches")
         # padding accounting, same keys as the iteration loop's stats —
         # the bench's iteration-vs-dispatch A/B compares these directly
         self._core.bump("rows", n)
-        self._core.bump("padded_rows", pad)
-        return out
+        self._core.bump("padded_rows", b - n)
+        return (out, fused)
 
-    def _finish(self, group: list, out) -> None:
-        wavs = np.asarray(jax.device_get(out))
-        for item, wav in zip(group, wavs):
-            try_set_result(item.future, wav)
+    def _finish(self, group: list, ticket) -> None:
+        out, fused = ticket
+        results = _fetch_window_results(out, len(group), fused)
+        for item, res in zip(group, results):
+            try_set_result(item.future, res)
 
 
 class _IterationStreamDecoder:
@@ -1782,8 +1926,13 @@ class _IterationStreamDecoder:
         device = getattr(voice, "device", None)
         if device is not None:
             attrs["device"] = str(device)
+        # two-phase: _dispatch enqueues the device program (async D2H
+        # prefetch started), _finish blocks on the result — with
+        # SONATA_ITER_PIPELINE (default on) the loop's finisher thread
+        # fetches iteration k while the worker dispatches k+1
         self._loop = IterationLoop(self._dispatch, max_batch=max_batch,
-                                   name="sonata_iter_decode", attrs=attrs)
+                                   name="sonata_iter_decode", attrs=attrs,
+                                   finish=self._finish)
         self.stats = self._loop.stats
 
     # -- stream lifecycle (stream_synthesis drives this) -----------------
@@ -1801,15 +1950,19 @@ class _IterationStreamDecoder:
         return self._loop.resident_streams
 
     def submit(self, z_row, start: int, width: int, sid: "Optional[int]",
-               stream=None):
-        """Same eager-slice contract as the dispatch-mode coalescer.
-        Without a ``stream`` handle (direct callers, tools) the row rides
-        as a one-iteration stream that retires when its future resolves."""
+               stream=None, epilogue=None):
+        """Same eager-slice contract as the dispatch-mode coalescer
+        (incl. the fused-epilogue ``epilogue=(lo, hi)`` arm).  Without a
+        ``stream`` handle (direct callers, tools) the row rides as a
+        one-iteration stream that retires when its future resolves."""
         window = jax.lax.dynamic_slice_in_dim(
             z_row, jnp.int32(start), width, axis=0)
-        key = (width, sid is not None)
+        fused = epilogue is not None
+        payload = ((window, sid, epilogue[0], epilogue[1]) if fused
+                   else (window, sid))
+        key = (width, sid is not None, fused)
         if stream is not None:
-            return self._loop.submit(stream, key, (window, sid))
+            return self._loop.submit(stream, key, payload)
         try:
             handle = self._loop.join()
         except OperationError as e:
@@ -1820,7 +1973,7 @@ class _IterationStreamDecoder:
             fut: Future = Future()
             fut.set_exception(e)
             return fut
-        fut = self._loop.submit(handle, key, (window, sid))
+        fut = self._loop.submit(handle, key, payload)
         fut.add_done_callback(lambda _f: self._loop.retire(handle))
         return fut
 
@@ -1832,40 +1985,37 @@ class _IterationStreamDecoder:
     def close(self) -> None:
         self._loop.close()
 
-    # -- one iteration's device call --------------------------------------
+    # -- one iteration's device call (two-phase) ---------------------------
     def _dispatch(self, key, payloads, b: int):
+        """DISPATCH phase: enqueue the iteration's device program and
+        start the async D2H copy, without blocking on the result — the
+        loop's finisher (``_finish``) fetches while the next iteration
+        dispatches (``SONATA_ITER_PIPELINE``)."""
         v = self._voice_ref()
         if v is None:
             raise OperationError("voice was garbage-collected")
-        width, has_sid = key
+        width, has_sid, fused = key
         n = len(payloads)
-        pad = b - n
-        windows = jnp.stack([p[0] for p in payloads]
-                            + [payloads[0][0]] * pad)
-        args = [v.params, windows]
-        if has_sid:
-            args.append(jnp.asarray(
-                [p[1] for p in payloads] + [payloads[0][1]] * pad,
-                dtype=jnp.int32))
-        cache_key = ("wbatch", width, b, has_sid, should_donate())
+        cache_key = v._wdec_cache_key(width, b, has_sid, fused)
         with v._jit_lock:
             cached = cache_key in v._dec_cache
-        fn = v._decode_windows_batch_fn(width, b, has_sid)
-        wavs = self._run_and_fetch(fn, args)
+        fn, args = _assemble_window_dispatch(v, key, payloads, b)
+        out = fn(*args)  # async dispatch
+        PiperVoice._prefetch_to_host(out)
         attrs = {"frame_bucket": width, "text_bucket": 0,
                  "compile": "cached" if cached else "cold"}
         voice_label = getattr(v, "scope_voice", None)
         if voice_label is not None:
             attrs["voice"] = voice_label
-        return list(wavs[:n]), attrs
+        return (out, n, fused), attrs
 
     @staticmethod
-    def _run_and_fetch(fn, args) -> np.ndarray:
-        """Dispatch + blocking fetch: the loop is synchronous per
-        iteration by design (the next iteration's occupancy depends on
-        which rows resolved), so there is no later pipeline stage for an
-        async copy to overlap with."""
-        return np.asarray(jax.device_get(fn(*args)))
+    def _finish(ticket):
+        """FINISH phase: the blocking fetch — the only host sync on the
+        iteration path, and it runs on the finisher thread so iteration
+        k+1's dispatch overlaps it."""
+        out, n, fused = ticket
+        return _fetch_window_results(out, n, fused)
 
 
 class _StreamStageCoalescer:

@@ -389,7 +389,12 @@ def decode_with(p: Params, hp: VitsHyperParams, z, g=None, conv=None,
     tconv = tconv or (lambda x, p_, *, stride, padding:
                       m.conv_transpose1d(x, p_, stride=stride,
                                          padding=padding))
-    pd = p["dec"]
+    from .decode_opts import dequantize_decoder
+
+    # int8 weight-only arm (SONATA_DECODE_QUANT): quantized conv weights
+    # rescale to f32 here, inside the device program — a plain f32 tree
+    # passes through untouched
+    pd = dequantize_decoder(p["dec"])
     if compute_dtype is not None:
         # on-device cast of the decoder weights: pure HBM traffic (~0.1 ms
         # for the full stack), repaid many times over by MXU-native convs

@@ -40,6 +40,7 @@ every dispatch gathering from scratch.  See :func:`resolve_batch_mode`.
 
 from __future__ import annotations
 
+import heapq
 import logging
 import os
 import queue
@@ -87,6 +88,29 @@ def resolve_batch_mode(policy=None, env: Optional[dict] = None) -> str:
     if policy is not None and getattr(policy, "coalesce", False):
         return "iteration"
     return "dispatch"
+
+
+#: Pipelined iteration fetch: with a two-phase owner (``finish=``), the
+#: loop's worker dispatches iteration k+1's device program while a
+#: finisher thread blocks on iteration k's result fetch — the same
+#: two-thread trick the wave coalescers already use, carried to the
+#: persistent loop so remote-chip links overlap transfer with compute.
+#: ``0`` forces the synchronous shape (the bench A/B arm).
+ITER_PIPELINE_ENV = "SONATA_ITER_PIPELINE"
+
+
+def resolve_iter_pipeline(env: Optional[dict] = None) -> bool:
+    """``SONATA_ITER_PIPELINE=0|1`` (default 1).  A typo fails loudly —
+    the SONATA_BATCH_MODE contract: a fleet silently running the
+    synchronous fetch is a latency regression nobody would see."""
+    env = os.environ if env is None else env
+    raw = env.get(ITER_PIPELINE_ENV, "").strip()
+    if raw == "":
+        return True
+    if raw in ("0", "1"):
+        return raw == "1"
+    raise OperationError(
+        f"{ITER_PIPELINE_ENV}={raw!r} is not 0 or 1")
 
 
 def effective_batch_mode(policy=None, env: Optional[dict] = None) -> str:
@@ -574,6 +598,27 @@ class StreamSlot:
         self.joined_at = time.monotonic()
 
 
+class _Flight:
+    """One dispatched iteration crossing the dispatch→finish boundary.
+
+    ``attrs`` is the single attribution dict both the trace span and
+    ``scope.note_dispatch`` consume — frozen at the dispatch phase so
+    the two surfaces cannot disagree across the thread split."""
+
+    __slots__ = ("items", "n", "b", "attrs", "t0", "err", "ticket",
+                 "results")
+
+    def __init__(self, items: list, n: int, b: int):
+        self.items = items
+        self.n = n
+        self.b = b
+        self.attrs: dict = {}
+        self.t0 = 0.0
+        self.err: Optional[Exception] = None
+        self.ticket = None
+        self.results = None
+
+
 class IterationLoop:
     """Orca-style persistent per-device decode loop.
 
@@ -590,13 +635,26 @@ class IterationLoop:
     shapes), so occupancy-sized dispatches stay recompile-free where the
     wave path had to overpad to the canonical max.
 
-    Owner hook: ``dispatch(key, payloads, batch_bucket) ->
-    (results, attrs)`` — run one iteration's device call for
-    ``len(payloads)`` live rows padded to ``batch_bucket``, returning
-    one result per live row plus attribution attrs (``frame_bucket``,
-    ``compile``, ``voice``...).  Failures fail only that iteration's
-    rows; the affected streams surface the error through their futures
-    and retire through their consumers' normal teardown.
+    Owner hooks (one- or two-phase):
+
+    - ``dispatch(key, payloads, batch_bucket) -> (results, attrs)`` —
+      one-phase: run one iteration's device call for ``len(payloads)``
+      live rows padded to ``batch_bucket``, returning one result per
+      live row plus attribution attrs (``frame_bucket``, ``compile``,
+      ``voice``...).  Failures fail only that iteration's rows; the
+      affected streams surface the error through their futures and
+      retire through their consumers' normal teardown.
+    - with ``finish=`` (two-phase): ``dispatch`` instead *enqueues* the
+      device program and returns ``(ticket, attrs)`` without blocking
+      on results; ``finish(ticket) -> results`` performs the blocking
+      fetch.  When pipelining is on (:func:`resolve_iter_pipeline`),
+      the worker dispatches iteration k+1 while a finisher thread
+      blocks on iteration k's fetch — at most one iteration runs ahead
+      of the fetch, so occupancy decisions stay at most one boundary
+      stale.  Attribution attrs and padding accounting are frozen at
+      the *dispatch* phase (the scope/span never-disagree contract
+      survives the thread split); spans and ``scope.note_dispatch``
+      land at the *finish* boundary, where the duration is known.
 
     Serving-plane composition: every iteration records a shared
     ``dispatch`` span (``mode=iteration``, peer request ids, padding
@@ -607,11 +665,20 @@ class IterationLoop:
     deadline expiry mid-flight fails only the expired stream's rows.
     """
 
+    #: iterations allowed past the one being fetched: 1 dispatched-ahead
+    #: + 1 in fetch.  Deeper pipelining would dispatch the whole pending
+    #: backlog before the first fetch resolves, making every occupancy
+    #: decision stale.
+    PIPELINE_DEPTH = 2
+
     def __init__(self, dispatch: Callable, *, max_batch: int,
                  name: str = "sonata_iteration",
                  attrs: Optional[dict] = None,
-                 idle_poll_s: float = 0.5):
+                 idle_poll_s: float = 0.5,
+                 finish: Optional[Callable] = None,
+                 pipeline: Optional[bool] = None):
         self._dispatch_cb = dispatch
+        self._finish_cb = finish
         self._max_batch = max(int(max_batch), 1)
         self._attrs = dict(attrs or {})
         self._idle_poll = idle_poll_s
@@ -625,8 +692,30 @@ class IterationLoop:
         self._draining = threading.Event()
         self.stats = {"requests": 0, "dispatches": 0, "iterations": 0,
                       "joined": 0, "retired": 0, "expired": 0,
-                      "rows": 0, "padded_rows": 0}
+                      "rows": 0, "padded_rows": 0, "fetch_overlapped": 0}
         self._stats_lock = threading.Lock()
+        # pipelined fetch (two-phase owners only): the finisher thread
+        # blocks on iteration k's result fetch while the worker
+        # dispatches k+1; the semaphore bounds how far dispatch runs
+        # ahead.  _unsettled counts dispatched-but-unfinished
+        # iterations (the fetch_overlapped accounting).
+        self._pipeline = (finish is not None
+                          and (resolve_iter_pipeline()
+                               if pipeline is None else bool(pipeline)))
+        self._fetch_q: "Optional[queue.Queue]" = None
+        self._finisher: Optional[threading.Thread] = None
+        self._inflight_sem = threading.Semaphore(self.PIPELINE_DEPTH)
+        self._unsettled = 0
+        #: set (before the crash drain) when the finisher died — the
+        #: worker re-checks it after every fetch-queue put, so a flight
+        #: racing the crash drain can never sit in a queue nobody reads
+        self._finisher_dead = False
+        if self._pipeline:
+            self._fetch_q = queue.Queue()
+            self._finisher = threading.Thread(
+                target=self._finish_loop, name=f"{name}_fetch",
+                daemon=True)
+            self._finisher.start()
         self._thread = threading.Thread(target=self._run, name=name,
                                         daemon=True)
         self._thread.start()
@@ -705,12 +794,20 @@ class IterationLoop:
         self._inbox.put(None)
 
     def close(self, join_timeout_s: float = 10.0) -> None:
-        """Terminal: fail everything pending typed and stop the loop."""
+        """Terminal: fail everything pending typed and stop the loop.
+
+        Iterations already handed to the finisher resolve normally (the
+        BatchingCore.shutdown contract); only if the finisher cannot
+        drain (a wedged fetch) are its remaining entries failed typed."""
         self._closed.set()
         self._draining.set()
         self._inbox.put(None)
         self._thread.join(timeout=join_timeout_s)
         reason = "iteration loop closed (voice unloaded)"
+        if self._finisher is not None:
+            self._fetch_q.put(None)  # wake for the closed re-check
+            self._finisher.join(timeout=join_timeout_s)
+            self._fail_unsettled(OperationError(reason))
         with self._lock:
             slots = list(self._streams.values())
             self._streams.clear()
@@ -719,6 +816,21 @@ class IterationLoop:
                 try_set_exception(item.future, OperationError(reason))
             slot.pending.clear()
         self._drain_inbox(reason)
+
+    def _fail_unsettled(self, err: Exception) -> None:
+        """Fail every dispatched-but-unfetched iteration still sitting
+        in the fetch queue (finisher gone or wedged)."""
+        if self._fetch_q is None:
+            return
+        while True:
+            try:
+                entry = self._fetch_q.get_nowait()
+            except queue.Empty:
+                return
+            if entry is None:
+                continue
+            for item in entry.items:
+                try_set_exception(item.future, err)
 
     def _drain_inbox(self, reason: str) -> None:
         drain_pending_futures(
@@ -753,9 +865,24 @@ class IterationLoop:
             # EVERY exit (close, drain-complete) marks the loop closed
             # and fails anything that raced into the inbox — submit/join
             # re-check _closed, so nothing can queue work into a dead
-            # loop and hang its consumer
+            # loop and hang its consumer.  Resident slots' pending rows
+            # fail too (close() normally drains them, but a
+            # finisher-crash exit has no close() to rely on).  Rows
+            # already dispatched keep their finish boundary: the
+            # finisher drains its queue before exiting, so in-flight
+            # fetches resolve with real results even across a drain.
             self._closed.set()
-            self._drain_inbox("iteration loop closed (voice unloaded)")
+            reason = "iteration loop closed (voice unloaded)"
+            with self._lock:
+                slots = list(self._streams.values())
+            for slot in slots:
+                for item in slot.pending:
+                    try_set_exception(item.future, OperationError(reason))
+                slot.pending.clear()
+            self._drain_inbox(reason)
+            if self._finisher_dead:
+                self._fail_unsettled(SchedulerCrashed(
+                    "iteration finisher crashed"))
 
     def _admit_inbox(self) -> bool:
         """Iteration boundary: admit queued submits/retires.  Blocks on
@@ -833,61 +960,129 @@ class IterationLoop:
 
     def _pick_rows(self):
         """One iteration's rows: the oldest-waiting key, FIFO across
-        streams, up to ``max_batch``."""
+        streams, up to ``max_batch``.
+
+        Selection is a k-way merge by head timestamp: per-slot pending
+        is FIFO (t_submit monotone within a slot), so each slot's
+        key-matching subsequence is already time-sorted and the
+        globally-oldest selection emerges from a size-S heap of slot
+        cursors — O(S + B log S + skipped) instead of materializing and
+        sorting every resident stream's whole pending deque each
+        iteration.  Pinned equivalent to the sort-based selection by
+        tests/test_batching.py on randomized workloads."""
         with self._lock:
-            heads = [(s.pending[0].t_submit, h)
-                     for h, s in self._streams.items() if s.pending]
-            if not heads:
+            oldest_h, oldest_t = None, None
+            for h, s in self._streams.items():
+                p = s.pending
+                if p and (oldest_t is None or p[0].t_submit < oldest_t):
+                    oldest_t, oldest_h = p[0].t_submit, h
+            if oldest_h is None:
                 return None, []
-            _, oldest = min(heads)
-            key = self._streams[oldest].pending[0].key
-            rows = []
-            candidates = sorted(
-                ((item.t_submit, h, i, item)
-                 for h, s in self._streams.items()
-                 for i, item in enumerate(s.pending) if item.key == key))
-            taken: "dict[int, list]" = {}
-            for _t, h, _i, item in candidates:
-                if len(rows) >= self._max_batch:
-                    break
-                rows.append((h, item))
-                taken.setdefault(h, []).append(item)
-            for h, items in taken.items():
+            key = self._streams[oldest_h].pending[0].key
+
+            def next_match(p: list, start: int) -> int:
+                for j in range(start, len(p)):
+                    if p[j].key == key:
+                        return j
+                return -1
+
+            heap = []
+            for h, s in self._streams.items():
+                j = next_match(s.pending, 0)
+                if j >= 0:
+                    heap.append((s.pending[j].t_submit, h, j))
+            heapq.heapify(heap)
+            rows: list = []
+            taken: "dict[int, set]" = {}
+            while heap and len(rows) < self._max_batch:
+                _t, h, j = heapq.heappop(heap)
+                p = self._streams[h].pending
+                rows.append((h, p[j]))
+                taken.setdefault(h, set()).add(j)
+                nj = next_match(p, j + 1)
+                if nj >= 0:
+                    heapq.heappush(heap, (p[nj].t_submit, h, nj))
+            for h, idxs in taken.items():
                 s = self._streams[h]
-                s.pending = [it for it in s.pending if it not in items]
+                s.pending = [it for j, it in enumerate(s.pending)
+                             if j not in idxs]
             return key, rows
+
+    def _acquire_slot(self) -> bool:
+        """Bound how far dispatch runs ahead of the fetch; stays
+        responsive to close (a wedged fetch must not wedge close)."""
+        while not self._inflight_sem.acquire(timeout=self._idle_poll):
+            if self._closed.is_set():
+                return False
+        return True
 
     def _iterate(self) -> None:
         key, rows = self._pick_rows()
         if not rows:
             return
+        items = [item for _h, item in rows]
+        try:
+            self._iterate_picked(key, rows, items)
+        except Exception as e:
+            # worker-crash containment: once rows are picked they leave
+            # their slots, so an infrastructure fault past this point
+            # (not a dispatch error — those are handled inside) must
+            # fail them typed instead of stranding their consumers in
+            # fut.result(); already-resolved futures no-op.  The loop
+            # itself survives (the _run catch logs and continues).
+            err = SchedulerCrashed(
+                f"iteration worker crashed: {type(e).__name__}: {e}")
+            for item in items:
+                try_set_exception(item.future, err)
+            raise
+
+    def _iterate_picked(self, key, rows: list, items: list) -> None:
         n = len(rows)
         # graduated bucket ladder: occupancy pads only to the next batch
         # bucket (lattice-warmed), not the canonical max — the padding
         # waste the dispatch-granular wave rule pays is the point of
         # this mode
         b = min(bucket_for(n, BATCH_BUCKETS), self._max_batch)
-        items = [item for _h, item in rows]
-        t0 = time.monotonic()
-        attrs: dict = {}
-        err: Optional[Exception] = None
-        results = None
+        pipelined = self._pipeline
+        if pipelined and not self._acquire_slot():
+            # closed while waiting for pipeline headroom: the picked
+            # rows must still resolve
+            err = OperationError("iteration loop closed (voice unloaded)")
+            for item in items:
+                try_set_exception(item.future, err)
+            return
+        with self._stats_lock:
+            overlapped = self._unsettled > 0
+        flight = _Flight(items, n, b)
+        flight.t0 = time.monotonic()
         try:
-            results, extra = self._dispatch_cb(
-                key, [i.payload for i in items], b)
-            attrs.update(extra or {})
+            if self._finish_cb is not None:
+                flight.ticket, extra = self._dispatch_cb(
+                    key, [i.payload for i in items], b)
+            else:
+                flight.results, extra = self._dispatch_cb(
+                    key, [i.payload for i in items], b)
+            flight.attrs.update(extra or {})
         except Exception as e:
-            err = e
-        t1 = time.monotonic()
+            flight.err = e
         try:
-            # bookkeeping + attribution must never strand the dequeued
-            # rows: once picked, the futures below ALWAYS resolve, so a
-            # scope/tracing-plane fault costs observability, not a
-            # consumer blocked forever in fut.result()
+            # DISPATCH-phase accounting: the stats counters and the
+            # attribution attrs (padding fields included) freeze here,
+            # on the worker thread — the finish phase reuses this exact
+            # dict for the span AND scope.note_dispatch, so per-
+            # iteration scope/bucket rows can never disagree with the
+            # span attrs even when dispatch and finish run on
+            # different threads (the PR-7 never-disagree invariant)
             self._bump("iterations")
             self._bump("dispatches")
             self._bump("rows", n)
             self._bump("padded_rows", b - n)
+            if pipelined and overlapped and flight.err is None:
+                # this dispatch was issued while a previous iteration's
+                # fetch was still in flight: the overlap the pipeline
+                # exists for (bench row `iter_fetch_overlap`)
+                self._bump("fetch_overlapped")
+            attrs = flight.attrs
             traced = [i for i in items if i.tctx is not None]
             attrs.update(self._attrs)
             attrs.update(
@@ -898,22 +1093,64 @@ class IterationLoop:
                 attrs["batch_size"] = n
                 attrs["request_ids"] = [i.tctx[0].request_id
                                         for i in traced]
+        except Exception:
+            log.exception("iteration attribution failed (rows still "
+                          "resolve)")
+        if pipelined and flight.err is None:
+            with self._stats_lock:
+                self._unsettled += 1
+            self._fetch_q.put(flight)
+            # put-vs-finisher-crash race: the crash containment may have
+            # drained the fetch queue BEFORE this put landed — with the
+            # finisher dead nobody would ever settle this flight, so
+            # re-check and drain (idempotent: resolved futures no-op)
+            if self._finisher_dead:
+                self._fail_unsettled(SchedulerCrashed(
+                    "iteration finisher crashed"))
+            return
+        try:
+            self._settle(flight)
+        finally:
+            if pipelined:
+                self._inflight_sem.release()
+
+    def _settle(self, flight: "_Flight") -> None:
+        """The FINISH boundary: run the blocking fetch (two-phase
+        owners), record spans + scope accounting with the dispatch-phase
+        attrs, resolve the futures.  Runs on the finisher thread when
+        pipelined, inline on the worker otherwise."""
+        items, n = flight.items, flight.n
+        err, results = flight.err, flight.results
+        if err is None and self._finish_cb is not None:
+            try:
+                results = self._finish_cb(flight.ticket)
+            except Exception as e:
+                err = e
+        t1 = time.monotonic()
+        attrs = flight.attrs
+        try:
+            # bookkeeping + attribution must never strand the dequeued
+            # rows: once picked, the futures below ALWAYS resolve, so a
+            # scope/tracing-plane fault costs observability, not a
+            # consumer blocked forever in fut.result()
             if err is not None:
                 attrs["error"] = f"{type(err).__name__}: {err}"
             else:
                 # per-iteration dispatch-efficiency accounting: one
-                # iteration counts once, with the same attribution its
-                # trace span carries (the PR-7 never-disagree invariant)
-                scope.note_dispatch(t1 - t0, attrs)
+                # iteration counts once, with the same attrs dict its
+                # trace span carries (never-disagree, across threads)
+                scope.note_dispatch(t1 - flight.t0, attrs)
             # spans BEFORE resolving futures: a rider may export its
             # trace the instant its future resolves, and the iteration
             # attribution must already be there
-            for item in traced:
+            for item in items:
+                if item.tctx is None:
+                    continue
                 trace, parent = item.tctx
                 trace.new_span("queue-wait", parent=parent,
-                               start=item.t_submit, end=t0)
-                trace.new_span("dispatch", parent=parent, start=t0,
-                               end=t1, attrs=attrs)
+                               start=item.t_submit, end=flight.t0)
+                trace.new_span("dispatch", parent=parent,
+                               start=flight.t0, end=t1, attrs=attrs)
         except Exception:
             log.exception("iteration attribution failed (rows still "
                           "resolve)")
@@ -928,3 +1165,46 @@ class IterationLoop:
             return
         for item, out in zip(items, results):
             try_set_result(item.future, out)
+
+    # -- finisher (pipelined fetch) ------------------------------------------
+    def _finish_loop(self) -> None:
+        flight: "Optional[_Flight]" = None
+        try:
+            while True:
+                try:
+                    flight = self._fetch_q.get(timeout=self._idle_poll)
+                except queue.Empty:
+                    if self._closed.is_set():
+                        return  # drained: every dispatched row settled
+                    continue
+                if flight is None:
+                    continue
+                try:
+                    self._settle(flight)
+                finally:
+                    with self._stats_lock:
+                        self._unsettled -= 1
+                    self._inflight_sem.release()
+                flight = None
+        except Exception as e:
+            self._finisher_crashed(e, flight)
+
+    def _finisher_crashed(self, exc: Exception,
+                          flight: "Optional[_Flight]") -> None:
+        """Finisher-crash containment: with the fetch thread gone, BOTH
+        in-flight iterations (the one mid-finish and the one dispatched
+        behind it) fail typed instead of stranding their consumers; the
+        loop closes and the worker exits through its own finally."""
+        log.exception("iteration finisher crashed; failing in-flight "
+                      "iterations")
+        self._finisher_dead = True  # BEFORE the drain: the worker's
+        # post-put re-check must see it (either side then drains)
+        self._closed.set()
+        err = SchedulerCrashed(
+            f"iteration finisher crashed: {type(exc).__name__}: {exc}")
+        if flight is not None:
+            for item in flight.items:
+                try_set_exception(item.future, err)
+        self._fail_unsettled(err)
+        self._inbox.put(None)   # wake the worker so it exits promptly
+        self._inflight_sem.release()  # unblock a worker awaiting headroom

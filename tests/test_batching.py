@@ -411,6 +411,392 @@ def test_iteration_dispatch_error_fails_rows_loop_survives():
 
 
 # ---------------------------------------------------------------------------
+# pipelined iteration fetch (SONATA_ITER_PIPELINE, ISSUE 11)
+# ---------------------------------------------------------------------------
+
+def test_iter_pipeline_env_resolution():
+    from sonata_tpu.synth.batching import resolve_iter_pipeline
+
+    assert resolve_iter_pipeline(env={}) is True  # default: pipelined
+    assert resolve_iter_pipeline(
+        env={"SONATA_ITER_PIPELINE": "0"}) is False
+    assert resolve_iter_pipeline(
+        env={"SONATA_ITER_PIPELINE": "1"}) is True
+    with pytest.raises(OperationError, match="SONATA_ITER_PIPELINE"):
+        resolve_iter_pipeline(env={"SONATA_ITER_PIPELINE": "yes"})
+
+
+def _two_phase_loop(*, pipeline, dispatched=None, finish_gate=None,
+                    finish_fail=(), max_batch=8):
+    """Loop whose dispatch phase records and returns a ticket; finish
+    optionally blocks on ``finish_gate`` and fails tickets whose key is
+    in ``finish_fail``."""
+    dispatched = dispatched if dispatched is not None else []
+
+    def dispatch(key, payloads, b):
+        dispatched.append((key, len(payloads), b))
+        return (key, list(payloads)), {"frame_bucket": key}
+
+    def finish(ticket):
+        key, payloads = ticket
+        if finish_gate is not None:
+            assert finish_gate.wait(10)
+        if key in finish_fail:
+            raise RuntimeError(f"fetch failed for {key}")
+        return payloads
+
+    return IterationLoop(dispatch, finish=finish, max_batch=max_batch,
+                         name="test_iter_pipe", pipeline=pipeline,
+                         idle_poll_s=0.05)
+
+
+def test_pipelined_fetch_overlaps_next_dispatch():
+    """THE pipelining contract: iteration k+1's dispatch is issued while
+    k's fetch is still blocked in the finisher — observable as the
+    second dispatch landing before the first finish completes, and as
+    the loop's `fetch_overlapped` counter."""
+    dispatched = []
+    gate = threading.Event()
+    loop = _two_phase_loop(pipeline=True, dispatched=dispatched,
+                           finish_gate=gate)
+    try:
+        h = loop.join()
+        f1 = loop.submit(h, "k", "row-k")
+        # wait until iteration k is dispatched and parked in the fetch
+        deadline = time.monotonic() + 5
+        while len(dispatched) < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert dispatched == [("k", 1, 1)]
+        f2 = loop.submit(h, "k+1", "row-k1")
+        # k+1 must DISPATCH while k's fetch is still gated
+        deadline = time.monotonic() + 5
+        while len(dispatched) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert len(dispatched) == 2, "k+1 did not dispatch during k's fetch"
+        assert not f1.done()  # k still fetching
+        gate.set()
+        assert f1.result(timeout=10) == "row-k"
+        assert f2.result(timeout=10) == "row-k1"
+        assert loop.stats["fetch_overlapped"] >= 1
+    finally:
+        gate.set()
+        loop.close()
+
+
+def test_sync_arm_never_overlaps():
+    """SONATA_ITER_PIPELINE=0 (the bench A/B arm): same two-phase owner
+    hooks, fetch inline on the worker — zero overlap by construction."""
+    loop = _two_phase_loop(pipeline=False)
+    try:
+        h = loop.join()
+        futs = [loop.submit(h, "w", i) for i in range(6)]
+        assert [f.result(timeout=10) for f in futs] == list(range(6))
+        assert loop.stats["fetch_overlapped"] == 0
+        assert loop._finisher is None  # no fetch thread in the sync arm
+    finally:
+        loop.close()
+
+
+def test_pipelined_fetch_error_fails_only_k_while_k1_resolves():
+    """Failure surface: a fetch error in iteration k fails only k's
+    rows; iteration k+1 — already dispatched behind it — still resolves
+    with real results."""
+    gate = threading.Event()
+    dispatched = []
+    loop = _two_phase_loop(pipeline=True, dispatched=dispatched,
+                           finish_gate=gate, finish_fail={"bad"})
+    try:
+        h = loop.join()
+        f_bad = loop.submit(h, "bad", "doomed")
+        deadline = time.monotonic() + 5
+        while len(dispatched) < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        f_good = loop.submit(h, "good", "fine")
+        deadline = time.monotonic() + 5
+        while len(dispatched) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert len(dispatched) == 2  # k+1 dispatched before k finished
+        gate.set()
+        with pytest.raises(RuntimeError, match="fetch failed"):
+            f_bad.result(timeout=10)
+        assert f_good.result(timeout=10) == "fine"
+        # the loop survived the fetch error and keeps serving
+        f_next = loop.submit(h, "good", "still serving")
+        assert f_next.result(timeout=10) == "still serving"
+    finally:
+        gate.set()
+        loop.close()
+
+
+def test_pipelined_deadline_expiry_lands_at_finish_boundary():
+    """A stream whose deadline expires while its row is IN FLIGHT: the
+    dispatched row still resolves with its real result at the finish
+    boundary; only rows still pending fail typed."""
+    gate = threading.Event()
+    dispatched = []
+    loop = _two_phase_loop(pipeline=True, dispatched=dispatched,
+                           finish_gate=gate, max_batch=1)
+    try:
+        h = loop.join(deadline=Deadline.after(0.15))
+        f_inflight = loop.submit(h, "w", "made it")
+        deadline = time.monotonic() + 5
+        while len(dispatched) < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        time.sleep(0.3)  # stream deadline expires; fetch still gated
+        # submitted AFTER expiry: admitted at the boundary, then the
+        # expiry check fails it before it can dispatch
+        f_pending = loop.submit(h, "w", "too late")
+        gate.set()
+        # the in-flight row keeps its finish boundary
+        assert f_inflight.result(timeout=10) == "made it"
+        with pytest.raises(DeadlineExceeded):
+            f_pending.result(timeout=10)
+        assert loop.stats["expired"] == 1
+        assert loop.stats["retired"] == loop.stats["joined"] == 1
+    finally:
+        gate.set()
+        loop.close()
+
+
+def test_pipelined_drain_lands_at_finish_boundary():
+    """Drain with a fetch in flight: the loop exits at the boundary and
+    the in-flight iteration still resolves with its REAL result — drain
+    must never turn a dispatched row into an error."""
+    gate = threading.Event()
+    dispatched = []
+    loop = _two_phase_loop(pipeline=True, dispatched=dispatched,
+                           finish_gate=gate)
+    h = loop.join()
+    fut = loop.submit(h, "w", "drained row")
+    deadline = time.monotonic() + 5
+    while len(dispatched) < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    loop.retire(h)
+    loop.start_draining()
+    assert not fut.done()  # still fetching across the drain
+    gate.set()
+    assert fut.result(timeout=10) == "drained row"
+    loop._thread.join(timeout=10)
+    assert not loop._thread.is_alive()
+    loop._finisher.join(timeout=10)
+    assert not loop._finisher.is_alive()
+    with pytest.raises(OperationError, match="draining|closed"):
+        loop.join()
+    loop.close()
+
+
+def test_finisher_crash_fails_both_inflight_iterations_typed():
+    """Finisher-crash containment: with the fetch thread gone, BOTH
+    in-flight iterations (mid-finish + dispatched-behind) fail typed
+    SchedulerCrashed instead of stranding their consumers."""
+    gate = threading.Event()
+    dispatched = []
+    loop = _two_phase_loop(pipeline=True, dispatched=dispatched)
+    real_settle = loop._settle
+
+    def crashing_settle(flight):
+        assert gate.wait(10)  # hold until BOTH iterations are in flight
+        raise RuntimeError("settle machinery exploded")
+
+    loop._settle = crashing_settle
+    try:
+        h = loop.join()
+        f1 = loop.submit(h, "a", "x")
+        deadline = time.monotonic() + 5
+        while len(dispatched) < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        f2 = loop.submit(h, "b", "y")
+        deadline = time.monotonic() + 5
+        while len(dispatched) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        gate.set()
+        with pytest.raises(SchedulerCrashed):
+            f1.result(timeout=10)
+        with pytest.raises(SchedulerCrashed):
+            f2.result(timeout=10)
+        # containment closed the loop; late submits fail fast
+        fut = loop.submit(h, "a", "late")
+        assert isinstance(fut.exception(timeout=5), OperationError)
+    finally:
+        gate.set()
+        loop._settle = real_settle
+        loop.close()
+
+
+def test_finisher_crash_racing_worker_put_fails_flight_typed():
+    """Review-pass pin (the put-vs-crash-drain race): the finisher
+    crashes and drains the fetch queue while the worker is still inside
+    its dispatch — the worker's subsequent put lands in a queue nobody
+    reads, so its post-put re-check must drain it typed, never leaving
+    the consumer blocked forever in fut.result()."""
+    crash_done = threading.Event()
+
+    def dispatch(key, payloads, b):
+        if key == "b":
+            # hold iteration 2's dispatch open until the finisher's
+            # crash containment has finished its (empty-queue) drain
+            assert crash_done.wait(10)
+        return (key, list(payloads)), {}
+
+    loop = IterationLoop(dispatch, finish=lambda t: t[1], max_batch=8,
+                         name="test_iter_race", pipeline=True,
+                         idle_poll_s=0.05)
+    orig_crashed = loop._finisher_crashed
+
+    def crashed(exc, flight):
+        orig_crashed(exc, flight)
+        crash_done.set()
+
+    loop._finisher_crashed = crashed
+    loop._settle = lambda flight: (_ for _ in ()).throw(
+        RuntimeError("settle machinery exploded"))
+    try:
+        h = loop.join()
+        f1 = loop.submit(h, "a", "x")  # crashes the finisher
+        f2 = loop.submit(h, "b", "y")  # put lands after the crash drain
+        with pytest.raises(SchedulerCrashed):
+            f1.result(timeout=10)
+        with pytest.raises(SchedulerCrashed):
+            f2.result(timeout=10)
+    finally:
+        crash_done.set()
+        loop.close()
+
+
+def test_worker_crash_fails_picked_rows_typed():
+    """Worker-side containment: an infrastructure fault AFTER rows are
+    picked (here: the pipeline-headroom acquire) fails those rows typed
+    — never a consumer blocked forever in fut.result()."""
+    loop = _two_phase_loop(pipeline=True)
+    loop._acquire_slot = lambda: (_ for _ in ()).throw(
+        RuntimeError("acquire exploded"))
+    try:
+        h = loop.join()
+        fut = loop.submit(h, "w", "row")
+        with pytest.raises(SchedulerCrashed):
+            fut.result(timeout=10)
+    finally:
+        loop.close()
+
+
+def test_pipelined_attribution_never_disagrees_across_threads():
+    """The ISSUE-11 accounting fix, extending the PR-7 exactly-equal
+    pin: padding attrs freeze at the DISPATCH phase (worker thread),
+    and the finish phase (finisher thread) feeds the SAME dict to both
+    the trace span and scope.note_dispatch — waste == span duration x
+    the span's own padding_ratio, exactly, across the thread split."""
+    from sonata_tpu.serving import scope as scope_mod
+    from sonata_tpu.serving import tracing
+
+    noted = []
+
+    class _Scope:
+        def note_dispatch(self, duration_s, attrs):
+            noted.append((duration_s, attrs))
+
+    sc = _Scope()
+    scope_mod.install(sc)
+    gate = threading.Event()
+    loop = _two_phase_loop(pipeline=True, finish_gate=gate)
+    tracer = tracing.Tracer(enabled=True, recent=8, slowest=4)
+    try:
+        trace = tracer.start_trace("req", request_id="pipe-pin")
+        with tracing.use_trace(trace):
+            h = loop.join()
+            futs = [loop.submit(h, "w", i) for i in range(3)]
+        gate.set()
+        for f in futs:
+            f.result(timeout=10)
+        trace.finish("ok")
+        spans = [s for s in trace.spans_snapshot() if s.name == "dispatch"]
+        assert spans and noted
+        span = spans[0]
+        duration, attrs = noted[0]
+        # one frozen dict feeds both surfaces (Span copies it): every
+        # attribution field — padding included — is exactly equal
+        assert span.attrs == attrs
+        assert attrs["mode"] == "iteration"
+        assert duration == pytest.approx(span.end - span.start)
+        waste = duration * attrs["padding_ratio"]
+        assert waste == (span.end - span.start) * span.attrs["padding_ratio"]
+    finally:
+        gate.set()
+        scope_mod.uninstall(sc)
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# _pick_rows: head-timestamp k-way merge == the old sorted selection
+# ---------------------------------------------------------------------------
+
+def _old_pick_rows(streams, max_batch):
+    """The pre-ISSUE-11 selection, verbatim (materialize + sort the full
+    candidate list): the equivalence reference."""
+    heads = [(s["pending"][0].t_submit, h)
+             for h, s in streams.items() if s["pending"]]
+    if not heads:
+        return None, []
+    _, oldest = min(heads)
+    key = streams[oldest]["pending"][0].key
+    rows = []
+    candidates = sorted(
+        ((item.t_submit, h, i, item)
+         for h, s in streams.items()
+         for i, item in enumerate(s["pending"]) if item.key == key))
+    taken = {}
+    for _t, h, _i, item in candidates:
+        if len(rows) >= max_batch:
+            break
+        rows.append((h, item))
+        taken.setdefault(h, []).append(item)
+    for h, items in taken.items():
+        s = streams[h]
+        s["pending"] = [it for it in s["pending"] if it not in items]
+    return key, rows
+
+
+def test_pick_rows_equivalent_to_old_sorted_selection():
+    """Randomized workloads (random slot counts, per-slot FIFO pending,
+    mixed keys incl. ties): draining the loop's k-way-merge selection
+    iteration by iteration picks EXACTLY the rows, in exactly the
+    order, of the old sort-everything selection."""
+    import random
+
+    from sonata_tpu.synth.batching import StreamSlot
+
+    rng = random.Random(1234)
+    for trial in range(50):
+        max_batch = rng.choice([1, 2, 4, 8])
+        n_slots = rng.randint(1, 6)
+        keys = [16, 32, 64]
+        loop = IterationLoop(lambda *a: ([], {}), max_batch=max_batch,
+                             name="test_pick", pipeline=False)
+        loop.close()  # worker gone: _pick_rows drives the state directly
+        t = 0.0
+        mirror = {}
+        for h in range(1, n_slots + 1):
+            slot = StreamSlot(None, None)
+            for _ in range(rng.randint(0, 7)):
+                item = WorkItem(f"p{h}-{t}", key=rng.choice(keys))
+                # controlled timestamps: FIFO-monotone per slot, with
+                # occasional cross-slot ties
+                t += rng.choice([0.0, 1.0, 2.0])
+                item.t_submit = t
+                slot.pending.append(item)
+            loop._streams[h] = slot
+            mirror[h] = {"pending": list(slot.pending)}
+        # drain both selections to empty; sequences must match exactly
+        while True:
+            key_new, rows_new = loop._pick_rows()
+            key_old, rows_old = _old_pick_rows(mirror, max_batch)
+            assert key_new == key_old, trial
+            assert [(h, it.payload) for h, it in rows_new] == \
+                [(h, it.payload) for h, it in rows_old], trial
+            if not rows_new:
+                break
+
+
+# ---------------------------------------------------------------------------
 # piper integration: the real streaming path in iteration mode
 # ---------------------------------------------------------------------------
 
@@ -644,14 +1030,13 @@ def test_lattice_grows_iteration_shapes(iteration_env):
         assert {s[2] for s in wdec_min} == {1}
         assert set(wdec_min) <= set(wdec_full)
         # warm_shape understands the tagged tuples: the executable lands
-        # in the decode cache real iterations dispatch through
+        # in the decode cache real iterations dispatch through — the
+        # FUSED program when the epilogue arm is on (the default), via
+        # the same _wdec_cache_key live dispatches resolve
         shape = wdec_full[0]
         v.warm_shape(shape)
         _tag, width, b, has_sid = shape
-        from sonata_tpu.utils.dispatch_policy import should_donate
-
-        assert ("wbatch", width, b, has_sid,
-                should_donate()) in v._dec_cache
+        assert v._wdec_cache_key(width, b, has_sid) in v._dec_cache
     finally:
         v.close()
 

@@ -43,7 +43,7 @@ _REV_RE = re.compile(r"^((?:BENCH|WARMUP)[A-Z_]*)_r(\d+)\.json$")
 _LOWER_IS_BETTER = ("ttfb", "rtf", "overhead", "latency", "wall",
                     "time_to_ready", "cold_compiles", "padding_ratio")
 _HIGHER_IS_BETTER = ("audio_s_per_s", "audio_seconds_per_second",
-                     "throughput", "speedup")
+                     "throughput", "speedup", "fetch_overlap")
 
 
 def direction(metric: str) -> Optional[str]:

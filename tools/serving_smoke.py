@@ -523,8 +523,12 @@ def main(args=None) -> int:
     import time
 
     iter_cache = tempfile.mkdtemp(prefix="smoke_iter_cache")
+    # SONATA_ITER_PIPELINE=1 pinned explicitly (it is the default): the
+    # smoke's attribution/books/cold-compile checks below must hold with
+    # the dispatch and finish phases on different threads
     iter_env = dict(os.environ,
                     SONATA_BATCH_MODE="iteration",
+                    SONATA_ITER_PIPELINE="1",
                     SONATA_DISPATCH_POLICY="on",
                     SONATA_WARMUP_LATTICE="full",
                     SONATA_JAX_CACHE_DIR=iter_cache,
