@@ -144,4 +144,7 @@ class HealthStatus(Message):
         "ready": Field(2, "bool"),
         "reason": Field(3, "string"),
         "version": Field(4, "string"),
+        # stable node identity (SONATA_NODE_ID, default host:port) so a
+        # fleet router health-checking over gRPC names the backend
+        "node_id": Field(5, "string"),
     }

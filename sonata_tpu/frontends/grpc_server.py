@@ -425,6 +425,18 @@ class SonataGrpcService:
                         rt.drain.raise_if_draining()
                         stack.enter_context(rt.admission.admit())
                     rt.requests.labels(rpc=rpc).inc()
+                    # name this backend in the response trailers so the
+                    # sonata-mesh router (and any client) can log WHICH
+                    # node served the stream, not an opaque channel
+                    if rt.node_id:
+                        set_tm = getattr(context, "set_trailing_metadata",
+                                         None)
+                        if set_tm is not None:
+                            try:
+                                set_tm((("x-sonata-node-id",
+                                         rt.node_id),))
+                            except Exception:
+                                pass  # terminated context / test double
                     yield from body(request, context)
         except (Draining, Overloaded) as e:
             self._abort_sonata(context, rpc, e)
@@ -752,7 +764,8 @@ class SonataGrpcService:
         load balancers that health-check over the serving protocol."""
         h = self.runtime.health.snapshot()
         return pb.HealthStatus(live=h["live"], ready=h["ready"],
-                               reason=h["reason"], version=__version__)
+                               reason=h["reason"], version=__version__,
+                               node_id=h.get("node_id") or "")
 
     def warmup_and_mark_ready(self) -> None:
         """Warm every loaded voice, then flip readiness.
@@ -950,6 +963,11 @@ def create_server(port: Optional[int] = None, *, mesh=None, seed: int = 0,
         raise OperationError(f"cannot bind {host}:{port}")
     server.sonata_service = service  # for startup hooks (e.g. prewarm)
     server.sonata_runtime = runtime
+    # stable node identity for the fleet tier: SONATA_NODE_ID beats the
+    # bind address; surfaced on /readyz, /metrics, CheckHealth, and in
+    # gRPC trailing metadata (see serving/mesh.py)
+    from ..serving.mesh import resolve_node_id
+    runtime.set_node_id(resolve_node_id(f"{host}:{bound}"))
     # metrics/health HTTP plane: explicit port > SONATA_METRICS_PORT >
     # disabled (0 binds an ephemeral port, runtime.http_port has it)
     http_port = runtime.start_http(metrics_port)

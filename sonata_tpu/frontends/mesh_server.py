@@ -1,0 +1,599 @@
+"""sonata-mesh router frontend: one gRPC endpoint over N backend nodes.
+
+The fleet tier (``serving/mesh.py``) made concrete: this server speaks
+the exact sonata gRPC surface (same service path, same
+:mod:`.grpc_messages` codec — existing clients point at the router
+unchanged) and forwards every RPC to the backend sonata servers named by
+``SONATA_MESH_BACKENDS`` / ``--backend``, with health-gated membership,
+per-node breakers, least-outstanding routing, deadline propagation, and
+drain/kill-safe rerouting supplied by
+:class:`~sonata_tpu.serving.mesh.MeshRouter`.
+
+Design points specific to the hop:
+
+- **Streaming payloads are forwarded as raw bytes** — the router
+  decodes the (tiny) request to learn the voice id but never touches
+  the audio frames: backend chunks pass through byte-for-byte, which is
+  most of why the router-hop TTFB overhead stays inside the MESH_r01
+  budget.
+- **The trace crosses the hop**: the router accepts (or generates) the
+  ``x-request-id``, records its own span tree (admission →
+  mesh-dispatch → stream-emit, with ``mesh-reroute`` spans on
+  failover), and forwards the id to the backend — the backend's trace
+  carries the same id, so one Perfetto load of both ``/debug/traces``
+  shows router queue → node dispatch end to end.
+- **Unary surface**: voice management (``LoadVoice`` / ``UnloadVoice``
+  / ``SetSynthesisOptions``) fans out to every reachable node (a fleet
+  where only one node holds the voice would break routing); lookups
+  (``GetVoiceInfo`` / ``GetSynthesisOptions`` / ``ListVoices``) forward
+  to any routable node; ``CheckHealth`` / ``GetSonataVersion`` answer
+  for the router itself.
+- **The router drains like a node**: SIGTERM runs the same pinned
+  ``DRAIN_PHASES`` order (readiness off first, typed refusals, bounded
+  in-flight wait) — the "voices" phase closes mesh membership probing
+  instead of voices.
+
+Binds ``127.0.0.1:$SONATA_MESH_PORT`` (default 49315, one above the
+backend default so a laptop runs both).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Iterator, Optional
+
+import grpc
+
+from .. import __version__
+from ..core import OperationError, SonataError
+from ..serving import (
+    DeadlineExceeded,
+    Draining,
+    Overloaded,
+    ServingRuntime,
+    faults,
+    tracing,
+)
+from ..serving.logs import configure_logging
+from ..serving.mesh import MeshRouter, parse_backends, resolve_node_id
+from ..serving.replicas import OPEN
+from . import grpc_messages as pb
+from .grpc_server import _METHODS, _SERVICE_PATH, _status_for
+
+log = logging.getLogger("sonata.mesh")
+
+DEFAULT_PORT = 49315
+PORT_ENV = "SONATA_MESH_PORT"
+
+#: router-side metric families, loop-registered like the scope's
+#: GAUGE_FAMILIES so the sonata-lint metricsdoc pass resolves the names
+MESH_COUNTER_FAMILIES = (
+    ("sonata_mesh_routed_total", "routed",
+     "Streaming requests routed into the mesh."),
+    ("sonata_mesh_rerouted_total", "rerouted",
+     "Requests rerouted to another node (route-class failure, "
+     "draining refusal, or first-chunk hedge) before any audio "
+     "streamed."),
+    ("sonata_mesh_rerouted_draining_total", "rerouted_draining",
+     "Of the reroutes, those caused by a typed draining refusal "
+     "(rolling-deploy traffic, not faults)."),
+    ("sonata_mesh_hedged_total", "hedged",
+     "Of the reroutes, those fired by the first-chunk hedge budget "
+     "(SONATA_MESH_HEDGE_MS)."),
+    ("sonata_mesh_failed_total", "failed",
+     "Requests that failed out of the mesh (typed to the client)."),
+    ("sonata_mesh_breaker_opens_total", "breaker_opens",
+     "Node circuit-breaker trips."),
+    ("sonata_mesh_recovered_total", "recovered",
+     "Node breakers closed again by a successful trial request."),
+    ("sonata_mesh_probe_failures_total", "probe_failures",
+     "Node health probes that failed (unreachable health plane)."),
+)
+
+MESH_NODE_GAUGES = (
+    ("sonata_mesh_node_outstanding", "outstanding",
+     "Router-side in-flight requests, per backend node."),
+    ("sonata_mesh_node_breaker_state", "state",
+     "Node breaker: 0 closed, 1 half-open, 2 open."),
+    ("sonata_mesh_node_draining", "draining",
+     "1 while the node reports draining (evicted from membership), "
+     "else 0."),
+    ("sonata_mesh_node_reported_outstanding", "reported_outstanding",
+     "Backend-scraped occupancy (sonata_replica_outstanding sum, "
+     "fallback sonata_in_flight), per node."),
+)
+
+
+def _classify_rpc_error(exc: BaseException) -> str:
+    """gRPC-aware failure classes for the router's retry contract."""
+    if isinstance(exc, Draining):
+        return "draining"
+    if isinstance(exc, faults.InjectedFault):
+        return "route"
+    code = getattr(exc, "code", None)
+    code = code() if callable(code) else None
+    if code == grpc.StatusCode.UNAVAILABLE:
+        details = ""
+        det = getattr(exc, "details", None)
+        if callable(det):
+            try:
+                details = det() or ""
+            except Exception:
+                details = ""
+        # a PR-9 draining refusal is a deploy (evict + immediate
+        # reroute); every other UNAVAILABLE is a connect/route fault
+        return "draining" if "draining" in details else "route"
+    if code in (grpc.StatusCode.CANCELLED, grpc.StatusCode.INTERNAL):
+        # CANCELLED: our own hedge/cleanup cancel (a client hangup
+        # surfaces as GeneratorExit on the router generator, never as
+        # this).  INTERNAL: how a SIGKILLed peer surfaces to streams
+        # caught mid-handshake (RST_STREAM) — route_stream only ever
+        # retries pre-first-chunk, so a genuine INTERNAL from a live
+        # node still fails typed after the bounded retry.
+        return "route"
+    return "fatal"
+
+
+class SonataMeshService:
+    """RPC implementations over a :class:`MeshRouter` membership."""
+
+    def __init__(self, router: MeshRouter,
+                 runtime: Optional[ServingRuntime] = None):
+        self.router = router
+        self.runtime = runtime if runtime is not None else ServingRuntime()
+        self._channels: dict = {}
+        #: (addr, method) -> stream multicallable: building one per
+        #: request costs real TTFB on the hop (measured by bench_mesh)
+        self._stream_stubs: dict = {}
+        self._chan_lock = threading.Lock()
+        #: (metric, labels) pairs created by _register_metrics, so the
+        #: teardown removes exactly what was registered (the per-voice
+        #: series idiom from ServingRuntime.register_voice)
+        self._node_series: list = []
+        rt = self.runtime
+        #: zero routable nodes must flip the router's /readyz — the
+        #: fleet balancer routes around this router until a backend
+        #: rejoins (probes flip it back with no restart)
+        rt.health.add_readiness_gate(
+            "mesh:nodes", lambda: self.router.routable_count() > 0)
+        rt.health.set_ready(
+            f"mesh router over {len(router.nodes)} node(s)")
+        self._register_metrics()
+
+    def _register_metrics(self) -> None:
+        r = self.runtime.registry
+        router = self.router
+        r.gauge(
+            "sonata_mesh_nodes",
+            "Backend nodes configured in the mesh."
+        ).set_function(lambda: float(len(router.nodes)))
+        r.gauge(
+            "sonata_mesh_healthy_nodes",
+            "Backend nodes currently routable (breaker not open, ready, "
+            "not draining) — the router's readiness gate."
+        ).set_function(lambda: float(router.routable_count()))
+        for name, key, help_text in MESH_COUNTER_FAMILIES:
+            r.counter(name, help_text).set_function(
+                lambda k=key: float(router.stats.get(k, 0)))
+        for name, attr, help_text in MESH_NODE_GAUGES:
+            metric = r.gauge(name, help_text)
+            for node in router.nodes:
+                labels = {"node": node.spec.addr}
+                metric.labels(**labels).set_function(
+                    lambda n=node, a=attr: float(getattr(n, a)))
+                self._node_series.append((metric, labels))
+
+    def unregister_node_series(self) -> None:
+        """Drop the per-node labeled series (teardown twin of
+        :meth:`_register_metrics`), releasing the closures that would
+        otherwise pin the router's nodes past shutdown."""
+        for metric, labels in self._node_series:
+            metric.remove(**labels)
+        self._node_series = []
+
+    # -- channels -------------------------------------------------------------
+    def _channel(self, node) -> grpc.Channel:
+        with self._chan_lock:
+            ch = self._channels.get(node.spec.addr)
+            if ch is None:
+                # one cached channel per node; gRPC reconnects through
+                # backend restarts, so membership rejoin needs no churn
+                ch = grpc.insecure_channel(node.spec.addr)
+                self._channels[node.spec.addr] = ch
+            return ch
+
+    def _stream_stub(self, node, name: str):
+        key = (node.spec.addr, name)
+        with self._chan_lock:
+            stub = self._stream_stubs.get(key)
+        if stub is None:
+            channel = self._channel(node)
+            stub = channel.unary_stream(
+                f"/{_SERVICE_PATH}/{name}",
+                request_serializer=None,
+                response_deserializer=None)
+            with self._chan_lock:
+                self._stream_stubs[key] = stub
+        return stub
+
+    def _call_unary(self, node, name: str, request, resp_cls,
+                    timeout_s: float):
+        fn = self._channel(node).unary_unary(
+            f"/{_SERVICE_PATH}/{name}",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=resp_cls.decode)
+        return fn(request, timeout=timeout_s)
+
+    def _routable_node(self, context):
+        node = next((n for n in self.router.nodes
+                     if n.state != OPEN and n.ready and not n.draining),
+                    None)
+        if node is None:
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          f"mesh {self.router.name!r}: no routable "
+                          "backend node")
+        return node
+
+    # -- unary RPCs -----------------------------------------------------------
+    def GetSonataVersion(self, request: pb.Empty, context) -> pb.Version:
+        return pb.Version(version=__version__)
+
+    def CheckHealth(self, request: pb.Empty, context) -> pb.HealthStatus:
+        h = self.runtime.health.snapshot()
+        return pb.HealthStatus(live=h["live"], ready=h["ready"],
+                               reason=h["reason"], version=__version__,
+                               node_id=h.get("node_id") or "")
+
+    def _fanout(self, name: str, request, resp_cls, context,
+                timeout_s: float):
+        """Voice management reaches every reachable node; the last
+        response is returned (they agree — same voice config path ⇒
+        same voice id on every node).  Any node failing fails the call
+        typed: a half-loaded fleet is worse than a failed load."""
+        self.runtime.drain.raise_if_draining()
+        nodes = [n for n in self.router.nodes
+                 if n.state != OPEN and not n.draining]
+        if not nodes:
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          f"mesh {self.router.name!r}: no reachable "
+                          "backend node")
+        last = None
+        for node in nodes:
+            try:
+                last = self._call_unary(node, name, request, resp_cls,
+                                        timeout_s)
+            except grpc.RpcError as e:
+                context.abort(
+                    e.code() if callable(getattr(e, "code", None))
+                    and e.code() is not None else grpc.StatusCode.UNKNOWN,
+                    f"node {node.node_id}: {e.details() or ''}")
+        return last
+
+    def LoadVoice(self, request: pb.VoicePath, context) -> pb.VoiceInfo:
+        # generous bound: each node's load may compile cold executables
+        return self._fanout("LoadVoice", request, pb.VoiceInfo, context,
+                            timeout_s=600.0)
+
+    def UnloadVoice(self, request: pb.VoiceIdentifier,
+                    context) -> pb.Empty:
+        return self._fanout("UnloadVoice", request, pb.Empty, context,
+                            timeout_s=60.0)
+
+    def SetSynthesisOptions(self, request: pb.VoiceSynthesisOptions,
+                            context) -> pb.SynthesisOptions:
+        return self._fanout("SetSynthesisOptions", request,
+                            pb.SynthesisOptions, context, timeout_s=30.0)
+
+    def _forward_one(self, name: str, request, resp_cls, context,
+                     timeout_s: float = 15.0):
+        node = self._routable_node(context)
+        try:
+            return self._call_unary(node, name, request, resp_cls,
+                                    timeout_s)
+        except grpc.RpcError as e:
+            context.abort(
+                e.code() if callable(getattr(e, "code", None))
+                and e.code() is not None else grpc.StatusCode.UNKNOWN,
+                f"node {node.node_id}: {e.details() or ''}")
+
+    def GetVoiceInfo(self, request: pb.VoiceIdentifier,
+                     context) -> pb.VoiceInfo:
+        return self._forward_one("GetVoiceInfo", request, pb.VoiceInfo,
+                                 context)
+
+    def GetSynthesisOptions(self, request: pb.VoiceIdentifier,
+                            context) -> pb.SynthesisOptions:
+        return self._forward_one("GetSynthesisOptions", request,
+                                 pb.SynthesisOptions, context)
+
+    def ListVoices(self, request: pb.Empty, context) -> pb.VoiceList:
+        return self._forward_one("ListVoices", request, pb.VoiceList,
+                                 context)
+
+    # -- streaming RPCs -------------------------------------------------------
+    def SynthesizeUtterance(self, request: pb.Utterance,
+                            context) -> Iterator[bytes]:
+        return self._routed_stream("SynthesizeUtterance", request,
+                                   context)
+
+    def SynthesizeUtteranceRealtime(self, request: pb.Utterance,
+                                    context) -> Iterator[bytes]:
+        return self._routed_stream("SynthesizeUtteranceRealtime",
+                                   request, context)
+
+    def _abort(self, context, rpc: str, code, detail: str) -> None:
+        self.runtime.failures.labels(rpc=rpc, code=code.name).inc()
+        context.abort(code, detail)
+
+    def _routed_stream(self, name: str, request: pb.Utterance,
+                       context) -> Iterator[bytes]:
+        """Route one synthesis stream across the fleet, yielding the
+        backend's chunks as raw bytes.  The admission slot, request
+        trace, and deadline are the router's own; the per-node retry
+        contract (reroute before first chunk, typed after) lives in
+        :meth:`MeshRouter.route_stream`."""
+        from contextlib import ExitStack
+
+        rt = self.runtime
+        rid = tracing.request_id_from_context(context) \
+            or tracing.new_request_id()
+        t0 = time.monotonic()
+        try:
+            with rt.tracer.trace_request(
+                    f"mesh.{name}", request_id=rid,
+                    voice=request.voice_id or ""):
+                with ExitStack() as stack:
+                    with tracing.span("admission"):
+                        rt.drain.raise_if_draining()
+                        stack.enter_context(rt.admission.admit())
+                    rt.requests.labels(rpc=name).inc()
+                    deadline = rt.deadline_for(context)
+                    payload = request.encode()
+                    md = (("x-request-id", rid),)
+                    served = [None]
+
+                    def start(node, timeout_s):
+                        served[0] = node
+                        # raw-bytes forward via a cached stub: no codec
+                        # and no per-request stub build on the hot path
+                        fn = self._stream_stub(node, name)
+                        return fn(payload, timeout=timeout_s,
+                                  metadata=md)
+
+                    first = True
+                    with tracing.span("stream-emit") as emit_sp:
+                        n_chunks = 0
+                        for chunk in self.router.route_stream(
+                                start, deadline=deadline,
+                                request_id=rid,
+                                classify=_classify_rpc_error):
+                            n_chunks += 1
+                            if first:
+                                first = False
+                                ttfb = time.monotonic() - t0
+                                rt.ttfb.observe(ttfb)
+                                emit_sp.annotate(
+                                    ttfb_ms=round(ttfb * 1e3, 3))
+                            yield chunk
+                        emit_sp.annotate(chunks=n_chunks)
+                    rt.synth_latency.observe(time.monotonic() - t0)
+                    if served[0] is not None:
+                        # forward the serving node's identity to OUR
+                        # client, like the backend does for us — a
+                        # client of the router learns which process in
+                        # the fleet actually synthesized its audio
+                        set_tm = getattr(context, "set_trailing_metadata",
+                                         None)
+                        if set_tm is not None:
+                            try:
+                                set_tm((("x-sonata-node-id",
+                                         served[0].node_id),))
+                            except Exception:
+                                pass
+        except Overloaded as e:
+            rt.shed.labels(source="mesh").inc()
+            self._abort(context, name, _status_for(e), str(e))
+        except DeadlineExceeded as e:
+            rt.expired.inc()
+            self._abort(context, name, _status_for(e), str(e))
+        except Draining as e:
+            self._abort(context, name, _status_for(e), str(e))
+        except grpc.RpcError as e:
+            # backend failure after the retry budget (or after bytes
+            # streamed): forward the backend's own status typed
+            code = getattr(e, "code", None)
+            code = code() if callable(code) else None
+            det = getattr(e, "details", None)
+            det = (det() if callable(det) else "") or ""
+            self._abort(context, name,
+                        code or grpc.StatusCode.UNKNOWN,
+                        f"backend: {det}")
+        except SonataError as e:
+            self._abort(context, name, _status_for(e), str(e))
+
+    # -- lifecycle ------------------------------------------------------------
+    def drain(self, timeout_s: Optional[float] = None,
+              reason: str = "shutdown") -> bool:
+        """Graceful router drain, same pinned phase order as a node
+        (``DRAIN_PHASES``): readiness off first, new streams refused
+        typed, in-flight streams finish inside the budget, then the
+        "voices" phase closes mesh membership probing and "runtime"
+        tears the metrics plane down.  First caller wins."""
+        rt = self.runtime
+        if not rt.begin_drain(reason):
+            return False
+        d = rt.drain
+        d.note_phase("readiness-off")
+        d.note_phase("reject-admissions", in_flight=rt.admission.in_flight)
+        t0 = time.monotonic()
+        idle_ok = d.wait_idle(lambda: rt.admission.in_flight == 0,
+                              timeout_s)
+        d.note_phase("wait-in-flight", ok=idle_ok,
+                     waited_ms=round((time.monotonic() - t0) * 1e3, 1),
+                     stragglers=rt.admission.in_flight)
+        self.router.close()
+        self.unregister_node_series()
+        d.note_phase("voices", closed=len(self.router.nodes))
+        rt.close()
+        d.note_phase("runtime")
+        d.note_phase("done", stragglers=rt.admission.in_flight)
+        return True
+
+    def shutdown(self) -> None:
+        """Immediate teardown (the abrupt sibling of :meth:`drain`):
+        raced requests still refuse typed via the shared drain flag."""
+        self.runtime.drain.begin("shutdown")
+        self.runtime.health.set_not_ready("shutting down")
+        self.router.close()
+        self.unregister_node_series()
+        with self._chan_lock:
+            channels = list(self._channels.values())
+            self._channels.clear()
+        for ch in channels:
+            try:
+                ch.close()
+            except Exception:
+                pass
+        self.runtime.close()
+
+
+class _MeshHandler(grpc.GenericRpcHandler):
+    """Same method table as the node server; the two streaming
+    synthesis RPCs pass response bytes through unserialized."""
+
+    def __init__(self, service: SonataMeshService):
+        self._service = service
+
+    def service(self, handler_call_details):
+        path = handler_call_details.method
+        prefix = f"/{_SERVICE_PATH}/"
+        if not path.startswith(prefix):
+            return None
+        name = path[len(prefix):]
+        entry = _METHODS.get(name)
+        if entry is None:
+            return None
+        req_cls, resp_cls, streaming = entry
+        method = getattr(self._service, name)
+        if streaming:
+            return grpc.unary_stream_rpc_method_handler(
+                method, request_deserializer=req_cls.decode,
+                response_serializer=None)  # raw backend bytes
+        return grpc.unary_unary_rpc_method_handler(
+            method, request_deserializer=req_cls.decode,
+            response_serializer=lambda m: m.encode())
+
+
+def create_mesh_server(port: Optional[int] = None, *,
+                       backends=None,
+                       host: str = "127.0.0.1",
+                       max_workers: int = 32,
+                       runtime: Optional[ServingRuntime] = None,
+                       router: Optional[MeshRouter] = None,
+                       max_in_flight: Optional[int] = None,
+                       max_queue_depth: Optional[int] = None,
+                       request_timeout_s: Optional[float] = None,
+                       metrics_port: Optional[int] = None,
+                       name: str = "mesh"
+                       ) -> tuple:
+    """Build (server, bound_port) for the router.  ``backends`` is a
+    spec string, a list of specs, or None (``SONATA_MESH_BACKENDS``)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    port = port if port is not None else int(
+        os.environ.get(PORT_ENV, DEFAULT_PORT))
+    if router is None:
+        if isinstance(backends, (list, tuple)):
+            backends = ",".join(backends)
+        specs = parse_backends(backends)
+        router = MeshRouter(specs, name=name)
+    if runtime is None:
+        runtime = ServingRuntime(max_in_flight=max_in_flight,
+                                 max_queue_depth=max_queue_depth,
+                                 request_timeout_s=request_timeout_s)
+    service = SonataMeshService(router, runtime=runtime)
+    server = grpc.server(ThreadPoolExecutor(
+        max_workers=max_workers, thread_name_prefix="sonata_mesh"))
+    server.add_generic_rpc_handlers((_MeshHandler(service),))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        router.close()
+        raise OperationError(f"cannot bind {host}:{port}")
+    server.sonata_service = service
+    server.sonata_runtime = runtime
+    runtime.set_node_id(resolve_node_id(f"{host}:{bound}"))
+    http_port = runtime.start_http(metrics_port)
+    if http_port is not None:
+        log.info("mesh metrics/health plane on http://127.0.0.1:%d",
+                 http_port)
+    return server, bound
+
+
+def main(argv=None) -> int:
+    configure_logging(env_level_var="SONATA_GRPC")
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="sonata-mesh")
+    ap.add_argument("--port", type=int, default=None,
+                    help="router gRPC port (default $SONATA_MESH_PORT "
+                         f"or {DEFAULT_PORT})")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--backend", action="append", default=[],
+                    help="backend node spec host:grpc_port[/metrics_port]"
+                         " (repeatable; default $SONATA_MESH_BACKENDS)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="router /metrics /healthz /readyz HTTP port "
+                         "(0 = ephemeral; default $SONATA_METRICS_PORT "
+                         "or disabled)")
+    ap.add_argument("--request-timeout-s", type=float, default=None,
+                    help="router-side default deadline when the client "
+                         "set none (default $SONATA_REQUEST_TIMEOUT_S "
+                         "or 120; <=0 disables)")
+    ap.add_argument("--max-in-flight", type=int, default=None)
+    ap.add_argument("--max-queue-depth", type=int, default=None)
+    ap.add_argument("--log-level", default=None,
+                    choices=("DEBUG", "INFO", "WARNING", "ERROR",
+                             "CRITICAL"))
+    ap.add_argument("--log-format", default=None,
+                    choices=("text", "json"))
+    args = ap.parse_args(argv)
+    if args.log_level or args.log_format:
+        configure_logging(args.log_level, args.log_format,
+                          env_level_var="SONATA_GRPC")
+    faults.warn_if_armed(log)
+
+    server, port = create_mesh_server(
+        args.port, host=args.host,
+        backends=args.backend or None,
+        metrics_port=args.metrics_port,
+        max_in_flight=args.max_in_flight,
+        max_queue_depth=args.max_queue_depth,
+        request_timeout_s=args.request_timeout_s)
+    server.start()
+    service = server.sonata_service
+    log.info("sonata-mesh v%s listening on %s:%d over %d backend "
+             "node(s): %s", __version__, args.host, port,
+             len(service.router.nodes),
+             [n.spec.addr for n in service.router.nodes])
+    # rolling restarts: the router drains like a node (readiness off
+    # first, in-flight streams finish, typed refusals)
+    from .grpc_server import install_signal_handlers
+
+    install_signal_handlers(server)
+    try:
+        server.wait_for_termination()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop(grace=2.0)
+        service.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -39,6 +39,7 @@ from typing import Optional
 
 from . import degradation as degradation_mod
 from . import faults, tracing
+from . import mesh as mesh_mod
 from . import scope as scope_mod
 from . import warmup as warmup_mod
 from .admission import AdmissionController, Overloaded
@@ -70,6 +71,7 @@ __all__ = [
     "degradation_mod",
     "faults",
     "HealthState",
+    "mesh_mod",
     "MetricsRegistry",
     "parse_prometheus_text",
     "resolve_metrics_port",
@@ -153,6 +155,9 @@ class ServingRuntime:
         r.gauge("sonata_uptime_seconds", "Seconds since runtime start."
                 ).set_function(
             lambda: time.monotonic() - self._started_at)
+        #: stable node identity for the fleet tier (ISSUE 12): set by
+        #: the frontend once it knows its bind address, via set_node_id
+        self.node_id: Optional[str] = None
         #: graceful drain (ISSUE 9): the process-wide drain flag + phase
         #: log + bounded in-flight wait; frontends' admission paths
         #: consult it so new work mid-drain fails typed (UNAVAILABLE,
@@ -215,6 +220,22 @@ class ServingRuntime:
         #: per-voice flight-recorder probes added by register_voice, so
         #: unregister removes exactly what was added
         self._voice_probes: dict = {}
+
+    # -- node identity (fleet tier) ------------------------------------------
+    def set_node_id(self, node_id: str) -> None:
+        """Stable node identity (``SONATA_NODE_ID`` or the bind
+        ``host:port``): exported as ``sonata_node_info{node_id=...}``,
+        appended to ``/readyz``, answered in ``CheckHealth``, and
+        stamped into gRPC trailing metadata — so sonata-mesh router
+        logs/spans name the backend that served each request instead of
+        an opaque channel."""
+        self.node_id = node_id
+        self.health.node_id = node_id
+        self.registry.gauge(
+            "sonata_node_info",
+            "Constant 1, labeled with this process's stable node_id "
+            "(SONATA_NODE_ID, default the gRPC bind host:port)."
+        ).labels(node_id=node_id).set(1.0)
 
     # -- graceful drain ------------------------------------------------------
     def begin_drain(self, reason: str = "shutdown") -> bool:

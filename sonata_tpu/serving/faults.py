@@ -20,7 +20,13 @@ exercised by at least one test):
 - ``pool.route``          — replica-pool routing, request side;
 - ``phonemize``           — the G2P entry every stream mode funnels through;
 - ``warmup``              — the readiness-gating warmup synthesis;
-- ``metrics.scrape``      — the ``/metrics`` exposition handler.
+- ``metrics.scrape``      — the ``/metrics`` exposition handler;
+- ``mesh.route``          — inside every per-node dispatch attempt of the
+  sonata-mesh routing tier (an injected fault counts toward that node's
+  breaker exactly like a real one);
+- ``mesh.health``         — inside every mesh membership health probe
+  (how the chaos lane kills/wedges/partitions a whole node
+  deterministically without owning real processes).
 
 Modes:
 
@@ -80,6 +86,8 @@ SITES = (
     "phonemize",
     "warmup",
     "metrics.scrape",
+    "mesh.route",
+    "mesh.health",
 )
 
 MODES = ("error", "hang", "slow", "corrupt-shape")

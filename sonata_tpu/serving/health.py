@@ -35,6 +35,11 @@ class HealthState:
         self._ready = threading.Event()
         self._reason = "starting: voices not loaded"
         self._ready_at: Optional[float] = None
+        #: stable node identity (SONATA_NODE_ID or host:port), set by
+        #: ServingRuntime.set_node_id once the frontend knows its bind
+        #: address; surfaced on /readyz and CheckHealth so fleet-side
+        #: logs name this process instead of an opaque channel
+        self.node_id: Optional[str] = None
         #: named predicates evaluated at every readiness read: the
         #: process is ready only when the event is set AND every gate
         #: holds.  This is how live conditions (e.g. "this voice's
@@ -121,4 +126,5 @@ class HealthState:
         ready = self.ready
         reason = self.reason
         with self._lock:
-            return {"live": self._live, "ready": ready, "reason": reason}
+            return {"live": self._live, "ready": ready, "reason": reason,
+                    "node_id": self.node_id}

@@ -337,11 +337,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200 if live else 503,
                         b"ok\n" if live else b"unhealthy\n")
         elif path == "/readyz":
+            # the node tag names this process in fleet-side probe logs
+            # (the sonata-mesh router scrapes /readyz for membership)
+            nid = getattr(self.health, "node_id", None)
+            tag = f"node={nid}\n".encode() if nid else b""
             if self.health is None or self.health.ready:
-                self._reply(200, b"ready\n")
+                self._reply(200, b"ready\n" + tag)
             else:
                 reason = (self.health.reason or "not ready").encode()
-                self._reply(503, b"not ready: " + reason + b"\n")
+                self._reply(503, b"not ready: " + reason + b"\n" + tag)
         elif path in ("/debug/traces", "/debug/slowest"):
             self._reply_traces(path, query)
         elif path == "/debug/profile":

@@ -7,7 +7,10 @@ streaming TTFB/throughput/overhead, ``BENCH_CPU_rNN`` lowering A/Bs)
 plus the ``WARMUP_rNN.json`` warm-restart artifact (cold/warm
 time-to-ready from the serving smoke's lattice phase — a warmup-cost
 regression is a deploy-latency regression and gets flagged like any
-other), but nothing reads them *across* revisions — a slow 10% drift
+other) and the ``MESH_rNN.json`` fleet-tier artifact (router-hop TTFB
+overhead + the kill-phase reroute/drop counters from
+tools/bench_mesh.py), but nothing reads them *across* revisions — a
+slow 10% drift
 per PR is invisible until someone diffs artifacts by hand.  This tool:
 
 1. parses every ``BENCH*_r*.json`` / ``WARMUP_r*.json`` at the repo
@@ -37,11 +40,12 @@ REPO = Path(__file__).resolve().parent.parent
 TREND_PATH = REPO / "BENCH_TREND.json"
 REGRESSION_THRESHOLD = 0.20
 
-_REV_RE = re.compile(r"^((?:BENCH|WARMUP)[A-Z_]*)_r(\d+)\.json$")
+_REV_RE = re.compile(r"^((?:BENCH|WARMUP|MESH)[A-Z_]*)_r(\d+)\.json$")
 
 #: metric-name fragments → comparison direction
 _LOWER_IS_BETTER = ("ttfb", "rtf", "overhead", "latency", "wall",
-                    "time_to_ready", "cold_compiles", "padding_ratio")
+                    "time_to_ready", "cold_compiles", "padding_ratio",
+                    "dropped")
 _HIGHER_IS_BETTER = ("audio_s_per_s", "audio_seconds_per_second",
                      "throughput", "speedup", "fetch_overlap")
 
@@ -90,7 +94,8 @@ def collect() -> Dict[str, Dict]:
     """{family: {"revs": [int...], "metrics": {metric: {"rN": value}}}}"""
     families: Dict[str, Dict] = {}
     paths = sorted(list(REPO.glob("BENCH*_r*.json"))
-                   + list(REPO.glob("WARMUP_r*.json")))
+                   + list(REPO.glob("WARMUP_r*.json"))
+                   + list(REPO.glob("MESH_r*.json")))
     for path in paths:
         m = _REV_RE.match(path.name)
         if m is None:

@@ -36,6 +36,13 @@ robustness invariants end to end:
     a late request gets UNAVAILABLE with a ``draining`` detail (never
     RESOURCE_EXHAUSTED, never a hang), and the shutdown-phase log lines
     appear in the pinned DRAIN_PHASES order.
+9.  **Mesh tier survives injected node faults** (ISSUE 12) — an
+    in-process sonata-mesh router fronting this server:
+    ``mesh.route:error`` trips the node breaker (router ``/readyz``
+    503 at zero routable nodes), ``mesh.health:hang`` convicts probes
+    at the hang cap without wedging the prober, and disarm → re-probe
+    → one trial request recovers the breaker end to end with no
+    router restart.
 
 Every site in ``faults.SITES`` fires at least once per run (a
 deterministic sweep tops up whatever the random schedule missed), which
@@ -476,10 +483,12 @@ def main() -> int:
               f"({it_stats})")
 
     # deterministic sweep: every registered site fires at least once per
-    # run, whatever the random draw skipped (warmup fired in phase B)
+    # run, whatever the random draw skipped (warmup fired in phase B;
+    # the mesh.* sites need a router in front of this server — phase M
+    # fires them, and the all-sites check runs after it)
     fired = fires_total()
     for site in faults.SITES:
-        if fired.get(site, 0) > 0:
+        if fired.get(site, 0) > 0 or site.startswith("mesh."):
             continue
         arm_spec(f"{site}:error:1::1")
         if site == "metrics.scrape":
@@ -489,8 +498,9 @@ def main() -> int:
         disarm_all()
         heal_pool()
     fired = fires_total()
-    check("every registered site fired this run",
-          all(fired.get(s, 0) > 0 for s in faults.SITES), f"({fired})")
+    check("every non-mesh site fired this run",
+          all(fired.get(s, 0) > 0 for s in faults.SITES
+              if not s.startswith("mesh.")), f"({fired})")
     _e, _t, results, err = synth(TEXTS[0])
     check("clean request serves after disarm",
           err is None and results and len(results[0].wav_samples) > 0)
@@ -582,6 +592,122 @@ def main() -> int:
     heal_pool()  # belt and braces: readiness needs the pool gate too
     code, _ = http_get(base + "/readyz")
     check("readyz recovers with the ladder", code == 200, f"(code {code})")
+
+    # ---- phase M: mesh routing tier — breaker-open → re-probe →
+    # recovery, end to end against an in-process router fronting this
+    # very server (ISSUE 12).  mesh.route:error must count toward the
+    # node breaker like a real fault and take the router's /readyz with
+    # it at zero routable nodes; mesh.health:hang must fail probes
+    # (bounded by the hang cap) without wedging recovery; disarm +
+    # re-probe + one trial request must close the breaker with no
+    # router restart. ----
+    from sonata_tpu.frontends.mesh_server import create_mesh_server
+    from sonata_tpu.serving import degradation as degradation_mod
+    from sonata_tpu.serving import scope as scope_mod
+
+    mesh_server_obj, mesh_port = create_mesh_server(
+        0, backends=[f"127.0.0.1:{port}/{runtime.http_port}"],
+        metrics_port=0, request_timeout_s=REQUEST_TIMEOUT_S)
+    mesh_server_obj.start()
+    mesh_rt = mesh_server_obj.sonata_runtime
+    mrouter = mesh_server_obj.sonata_service.router
+    mbase = f"http://127.0.0.1:{mesh_rt.http_port}"
+    mesh_channel = grpc.insecure_channel(f"127.0.0.1:{mesh_port}")
+    mesh_synth_rpc = mesh_channel.unary_stream(
+        "/sonata_grpc.sonata_grpc/SynthesizeUtterance",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.SynthesisResult.decode)
+
+    def mesh_synth(text: str):
+        try:
+            call = mesh_synth_rpc(
+                pb.Utterance(voice_id=voice_id, text=text),
+                timeout=RPC_TIMEOUT_S)
+            results = list(call)
+            return results, dict(call.trailing_metadata() or ()), None
+        except grpc.RpcError as e:
+            return None, {}, e
+
+    results, trailers, err = mesh_synth(TEXTS[0])
+    check("mesh: clean request routes through the hop",
+          err is None and results and len(results[0].wav_samples) > 0,
+          f"({err.code().name if err else 'ok'})")
+    check("mesh: trailing metadata names the backend node",
+          trailers.get("x-sonata-node-id") == f"127.0.0.1:{port}",
+          f"({trailers})")
+    code, _ = http_get(mbase + "/readyz")
+    check("mesh: router readyz 200 with the node healthy", code == 200,
+          f"(code {code})")
+
+    # mesh.route:error — three route-class failures trip the node
+    # breaker (threshold 3), taking router readiness with it
+    arm_spec("mesh.route:error:1::9")
+    route_errs = 0
+    for _i in range(3):
+        _r, _t, err = mesh_synth(TEXTS[1])
+        route_errs += 1 if err is not None else 0
+    mnode = mrouter.nodes[0]
+    check("mesh: injected route errors fail typed", route_errs == 3)
+    check("mesh: route errors tripped the node breaker",
+          mnode.state == OPEN and mrouter.stats["breaker_opens"] >= 1,
+          f"({mnode.view()})")
+    # pin the OPEN window: the 0.5 s probe backoff would otherwise race
+    # the readyz check below (a clean probe flips half-open the moment
+    # next_probe_at passes — that recovery is exactly what the phase
+    # verifies later, on its own schedule)
+    with mrouter._lock:
+        if mnode.state == OPEN:
+            mnode.next_probe_at = time.monotonic() + 600.0
+    code, _ = http_get(mbase + "/readyz")
+    check("mesh: router readyz 503 at zero routable nodes", code == 503,
+          f"(code {code})")
+    disarm_all()
+
+    # mesh.health:hang — two probe cycles hang (1.2 s cap, then typed
+    # error): probe failures count, probing itself never wedges
+    pf0 = mrouter.stats["probe_failures"]
+    arm_spec("mesh.health:hang:1:1200:2")
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline and \
+            mrouter.stats["probe_failures"] < pf0 + 2:
+        time.sleep(0.1)
+    check("mesh: hung health probes convicted by the hang cap",
+          mrouter.stats["probe_failures"] >= pf0 + 2,
+          f"({mrouter.stats['probe_failures'] - pf0} failures)")
+    disarm_all()
+
+    # recovery: clean probes flip the breaker half-open once the
+    # (rewound) backoff passes, one trial request closes it
+    with mrouter._lock:
+        mnode.next_probe_at = time.monotonic()
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline and mnode.state == OPEN:
+        time.sleep(0.1)
+    check("mesh: re-probe flips the breaker half-open",
+          mnode.state != OPEN, f"({mnode.view()})")
+    results, trailers, err = mesh_synth(TEXTS[2])
+    check("mesh: trial request closes the breaker end to end",
+          err is None and results and mnode.state == CLOSED
+          and mrouter.stats["recovered"] >= 1,
+          f"({mnode.view()}, {err.code().name if err else 'ok'})")
+    code, _ = http_get(mbase + "/readyz")
+    check("mesh: router readyz recovers with the node", code == 200,
+          f"(code {code})")
+
+    fired = fires_total()
+    check("every registered site fired this run (mesh sites included)",
+          all(fired.get(s, 0) > 0 for s in faults.SITES), f"({fired})")
+
+    mesh_channel.close()
+    mesh_server_obj.stop(grace=None)
+    mesh_server_obj.sonata_service.shutdown()
+    # the mesh runtime's construction installed ITS degradation ladder
+    # and scope process-globally (latest-wins, like any runtime);
+    # shutting it down uninstalled them — re-install the backend's so
+    # the remaining phases observe the same plane the earlier ones did
+    degradation_mod.install(runtime.degradation)
+    if runtime.scope is not None:
+        scope_mod.install(runtime.scope)
 
     # ---- phase G: no request outlived its budget; registry symmetry ----
     check("no request outlived deadline + watchdog budget", not overruns,

@@ -19,7 +19,12 @@
 #      dispatch spans; then the warm-restart phase: two subprocess
 #      boots with the bucket-lattice warmup against one persistent
 #      compile cache — second boot materially faster, zero runtime
-#      cold compiles under the traffic mix (tools/serving_smoke.py)
+#      cold compiles under the traffic mix; then the mesh phase: 2
+#      backend subprocesses + 1 sonata-mesh router — SIGTERM drain and
+#      SIGKILL under concurrent streams lose zero not-yet-streaming
+#      requests, router /readyz tracks healthy-node count, and a
+#      restarted backend rejoins with no router restart
+#      (tools/serving_smoke.py)
 #   5. "Multi-device lane" — test_replicas on a forced 4-device CPU
 #      host (the replica-pool acceptance shape), plus test_parallel on
 #      its 8-device virtual mesh (make_mesh(8) needs all 8)

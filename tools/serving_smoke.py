@@ -71,6 +71,35 @@ def http_get(url: str) -> tuple[int, str]:
         return e.code, e.read().decode()
 
 
+def free_port() -> int:
+    """An OS-assigned free loopback port (mesh nodes need PINNED ports
+    so a restarted backend rejoins at the same address; also reused by
+    tools/bench_mesh.py)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def wait_readyz(metrics_port: int, budget_s: float = 300.0) -> bool:
+    """Poll a node's /readyz until 200 (shared with bench_mesh)."""
+    import time
+
+    deadline = time.monotonic() + budget_s
+    url = f"http://127.0.0.1:{metrics_port}/readyz"
+    while time.monotonic() < deadline:
+        try:
+            if http_get(url)[0] == 200:
+                return True
+        except Exception:
+            pass
+        time.sleep(0.25)
+    return False
+
+
 def warm_restart_boot() -> int:
     """Subprocess entry for the warm-restart phase: one full server
     boot — voice load, calibration + bucket-lattice warmup, the smoke
@@ -139,6 +168,53 @@ def warm_restart_boot() -> int:
     print("WARMBOOT " + json.dumps(report))
     server.stop(grace=None)
     server.sonata_service.shutdown()
+    return 0
+
+
+def mesh_node_boot() -> int:
+    """Subprocess entry for the mesh phase (ISSUE 12): one backend
+    sonata node on pinned ports (``MESH_NODE_GRPC_PORT`` /
+    ``MESH_NODE_METRICS_PORT`` — pinned so a restarted node rejoins the
+    router's membership at the same address), voice loaded + warmed,
+    SIGTERM handlers installed (the drain path IS the phase's subject),
+    reporting one ``MESHNODE {json}`` line and then serving until
+    signalled."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from sonata_tpu.utils.jax_cache import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache(0.0)
+    import json
+
+    import grpc
+
+    from sonata_tpu.frontends import grpc_messages as pb
+    from sonata_tpu.frontends.grpc_server import (
+        create_server,
+        install_signal_handlers,
+    )
+
+    cfg = os.environ["SMOKE_VOICE_CFG"]
+    grpc_port = int(os.environ["MESH_NODE_GRPC_PORT"])
+    metrics_port = int(os.environ["MESH_NODE_METRICS_PORT"])
+    server, port = create_server(grpc_port, continuous_batching=True,
+                                 metrics_port=metrics_port,
+                                 request_timeout_s=60.0)
+    server.start()
+    install_signal_handlers(server)
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    load = channel.unary_unary(
+        "/sonata_grpc.sonata_grpc/LoadVoice",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.VoiceInfo.decode)
+    info = load(pb.VoicePath(config_path=cfg))
+    server.sonata_service.warmup_and_mark_ready()
+    print("MESHNODE " + json.dumps(
+        {"voice_id": info.voice_id, "grpc_port": port,
+         "metrics_port": metrics_port,
+         "node_id": server.sonata_runtime.node_id}), flush=True)
+    server.wait_for_termination()
     return 0
 
 
@@ -667,6 +743,227 @@ def main(args=None) -> int:
             encoding="utf-8")
         print(f"smoke: wrote {args.warmup_artifact}")
 
+    # ---- mesh phase (ISSUE 12): 2 backend subprocesses + 1 router ----
+    # The first subsystem whose unit of failure is a whole PROCESS: the
+    # router must treat a draining node (SIGTERM), a dead node
+    # (SIGKILL), and a restarted node (same address, new pid) as
+    # routing events — zero not-yet-streaming requests lost, router
+    # /readyz tracking the healthy-node count, rejoin with no router
+    # restart.
+    import signal
+    import threading
+
+    from sonata_tpu.frontends.mesh_server import create_mesh_server
+    from sonata_tpu.serving.replicas import CLOSED as NODE_CLOSED
+    from sonata_tpu.serving.replicas import OPEN as NODE_OPEN
+
+    node_ports = [(free_port(), free_port()) for _ in range(2)]
+    mesh_cache = tempfile.mkdtemp(prefix="smoke_mesh_cache")
+    node_logs = [open(os.path.join(mesh_cache, f"node{i}.log"), "w")
+                 for i in range(2)]
+
+    def boot_node(i: int) -> subprocess.Popen:
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   SMOKE_VOICE_CFG=cfg,
+                   SONATA_JAX_CACHE_DIR=mesh_cache,
+                   MESH_NODE_GRPC_PORT=str(node_ports[i][0]),
+                   MESH_NODE_METRICS_PORT=str(node_ports[i][1]))
+        return subprocess.Popen(
+            [sys.executable, __file__, "--mesh-node-boot"],
+            env=env, stdout=node_logs[i], stderr=node_logs[i])
+
+    def wait_node_ready(i: int, budget_s: float = 300.0) -> bool:
+        return wait_readyz(node_ports[i][1], budget_s)
+
+    def wait_exit(p: subprocess.Popen, budget_s: float) -> bool:
+        try:
+            p.wait(timeout=budget_s)
+            return True
+        except subprocess.TimeoutExpired:
+            return False
+
+    procs = [boot_node(0), boot_node(1)]
+    check("mesh: backend node 0 boots ready", wait_node_ready(0))
+    check("mesh: backend node 1 boots ready", wait_node_ready(1))
+
+    specs = [f"127.0.0.1:{g}/{m}" for g, m in node_ports]
+    mesh_server_obj, mesh_port = create_mesh_server(
+        0, backends=specs, metrics_port=0, request_timeout_s=60.0)
+    mesh_server_obj.start()
+    router = mesh_server_obj.sonata_service.router
+    mesh_base = \
+        f"http://127.0.0.1:{mesh_server_obj.sonata_runtime.http_port}"
+    mesh_channel = grpc.insecure_channel(f"127.0.0.1:{mesh_port}")
+    mesh_synth = mesh_channel.unary_stream(
+        "/sonata_grpc.sonata_grpc/SynthesizeUtterance",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.SynthesisResult.decode)
+    mesh_realtime = mesh_channel.unary_stream(
+        "/sonata_grpc.sonata_grpc/SynthesizeUtteranceRealtime",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.WaveSamples.decode)
+    voice_id = info.voice_id  # same config path ⇒ same id on every node
+    code, _ = http_get(mesh_base + "/readyz")
+    check("mesh: router readyz 200 with both nodes up", code == 200,
+          f"(code {code})")
+
+    # the standard traffic mix through the router
+    mesh_mix = ("Mesh routing check.", "Short.",
+                "A medium sentence for the middle text bucket.",
+                "A considerably longer sentence that should land well "
+                "into one of the larger text buckets over the mesh hop.")
+    mix_ok, served_nodes = True, set()
+    for _pass in range(2):
+        for text in mesh_mix:
+            call = mesh_synth(pb.Utterance(voice_id=voice_id, text=text),
+                              timeout=60.0)
+            results = list(call)
+            mix_ok = mix_ok and bool(results) \
+                and len(results[0].wav_samples) > 0
+            trailers = dict(call.trailing_metadata() or ())
+            served_nodes.add(trailers.get("x-sonata-node-id"))
+    check("mesh: traffic mix streams through the router", mix_ok)
+    check("mesh: responses name the serving node in trailing metadata",
+          served_nodes and None not in served_nodes,
+          f"({served_nodes})")
+
+    stream_text = ("A first sentence for the in-flight stream. "
+                   "A second sentence keeps it streaming. "
+                   "A third sentence finishes it off.")
+
+    def run_stream(out: dict, j: int) -> None:
+        chunks, err = 0, None
+        try:
+            for chunk in mesh_realtime(
+                    pb.Utterance(voice_id=voice_id, text=stream_text),
+                    timeout=90.0):
+                if len(chunk.wav_samples) > 0:
+                    chunks += 1
+        except grpc.RpcError as e:
+            err = e
+        out[j] = (chunks, err)
+
+    # SIGTERM drain mid-stream: in-flight streams finish on the
+    # draining node (its listener stays up), the router reroutes new
+    # work, and /readyz stays 200 at one healthy node
+    term_results: dict = {}
+    threads = [threading.Thread(target=run_stream,
+                                args=(term_results, j))
+               for j in range(4)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and \
+            sum(n.outstanding for n in router.nodes) == 0:
+        time.sleep(0.01)
+    procs[0].send_signal(signal.SIGTERM)
+    for t in threads:
+        t.join(timeout=120.0)
+    check("mesh: zero dropped streams across a backend SIGTERM drain",
+          all(j in term_results and term_results[j][1] is None
+              and term_results[j][0] > 0 for j in range(4)),
+          str({j: (r[1].code().name if r[1] else f"{r[0]} chunks")
+               for j, r in term_results.items()}))
+    check("mesh: drained backend exits", wait_exit(procs[0], 90.0))
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline and router.routable_count() != 1:
+        time.sleep(0.1)
+    check("mesh: draining node evicted from membership",
+          router.routable_count() == 1,
+          f"({router.routable_count()} routable)")
+    code, _ = http_get(mesh_base + "/readyz")
+    check("mesh: router readyz stays 200 at one healthy node",
+          code == 200, f"(code {code})")
+    results = list(mesh_synth(pb.Utterance(voice_id=voice_id,
+                                           text="Still serving."),
+                              timeout=60.0))
+    check("mesh: requests keep serving on the surviving node",
+          bool(results) and len(results[0].wav_samples) > 0)
+
+    # restart node 0 on the SAME address: membership rejoin must need
+    # no router restart (probe success flips the breaker half-open,
+    # the next request closes it)
+    procs[0] = boot_node(0)
+    check("mesh: restarted backend boots ready", wait_node_ready(0))
+    deadline = time.monotonic() + 120.0
+    while time.monotonic() < deadline and router.routable_count() != 2:
+        time.sleep(0.2)
+    check("mesh: recovered backend rejoins without a router restart",
+          router.routable_count() == 2,
+          f"({router.routable_count()} routable)")
+    # complete the rejoin: the node is HALF_OPEN until a trial request
+    # closes its breaker — run one so the kill phase below starts from
+    # two fully-closed nodes (a half-open node serves only its single
+    # trial at a time, by breaker discipline)
+    results = list(mesh_synth(pb.Utterance(voice_id=voice_id,
+                                           text="Rejoin trial."),
+                              timeout=60.0))
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline and \
+            any(n.state != NODE_CLOSED for n in router.nodes):
+        results = list(mesh_synth(pb.Utterance(voice_id=voice_id,
+                                               text="Rejoin trial."),
+                                  timeout=60.0))
+        time.sleep(0.1)
+    check("mesh: trial request closes the rejoined node's breaker",
+          bool(results) and all(n.state == NODE_CLOSED for n in router.nodes),
+          f"({[n.view() for n in router.nodes]})")
+
+    # SIGKILL under 8 concurrent streams (the acceptance bar): a dead
+    # process loses ZERO not-yet-streaming requests — they reroute —
+    # and only mid-stream requests may fail (typed)
+    stats_before_kill = dict(router.stats)
+    kill_results: dict = {}
+    threads = [threading.Thread(target=run_stream,
+                                args=(kill_results, j))
+               for j in range(8)]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)  # let some streams start, keep some pre-dispatch
+    procs[1].kill()  # SIGKILL: no drain, no goodbye
+    for t in threads:
+        t.join(timeout=120.0)
+    dropped = {j: (err.code().name if err else "?")
+               for j, (chunks, err) in kill_results.items()
+               if err is not None and chunks == 0}
+    mid_stream_failures = [j for j, (chunks, err) in kill_results.items()
+                           if err is not None and chunks > 0]
+    check("mesh: SIGKILL loses zero not-yet-streaming requests "
+          "(rerouted instead)", len(kill_results) == 8 and not dropped,
+          f"(dropped {dropped}, mid-stream typed failures "
+          f"{mid_stream_failures}, rerouted "
+          f"{router.stats['rerouted'] - stats_before_kill['rerouted']})")
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline and router.routable_count() != 1:
+        time.sleep(0.1)
+    check("mesh: killed node leaves membership (breaker open)",
+          router.routable_count() == 1
+          and any(n.state == NODE_OPEN for n in router.nodes),
+          f"({[n.view() for n in router.nodes]})")
+    code, _ = http_get(mesh_base + "/readyz")
+    check("mesh: router readyz 200 after the kill (one healthy node)",
+          code == 200, f"(code {code})")
+
+    # zero healthy nodes is the line the router's readiness must not
+    # survive
+    procs[0].send_signal(signal.SIGTERM)
+    wait_exit(procs[0], 90.0)
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline and router.routable_count() != 0:
+        time.sleep(0.1)
+    code, _ = http_get(mesh_base + "/readyz")
+    check("mesh: router readyz 503 at zero healthy nodes", code == 503,
+          f"(code {code})")
+
+    mesh_channel.close()
+    mesh_server_obj.stop(grace=None)
+    mesh_server_obj.sonata_service.shutdown()
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for f in node_logs:
+        f.close()
+
     if failures:
         print(f"smoke: {len(failures)} FAILED: {failures}")
         return 1
@@ -686,9 +983,13 @@ if __name__ == "__main__":
                     help=argparse.SUPPRESS)  # subprocess entry
     ap.add_argument("--iteration-boot", action="store_true",
                     help=argparse.SUPPRESS)  # subprocess entry
+    ap.add_argument("--mesh-node-boot", action="store_true",
+                    help=argparse.SUPPRESS)  # subprocess entry
     cli_args = ap.parse_args()
     if cli_args.warm_restart_boot:
         sys.exit(warm_restart_boot())
     if cli_args.iteration_boot:
         sys.exit(iteration_boot())
+    if cli_args.mesh_node_boot:
+        sys.exit(mesh_node_boot())
     sys.exit(main(cli_args))
