@@ -32,6 +32,13 @@ Design points specific to the hop:
   ``DRAIN_PHASES`` order (readiness off first, typed refusals, bounded
   in-flight wait) — the "voices" phase closes mesh membership probing
   instead of voices.
+- **The router is the fleet's observability plane** (ISSUE 13): a
+  :class:`~sonata_tpu.serving.fleetscope.FleetScope` rides the
+  membership probers, scraping each node's ``/debug/scope/export`` and
+  serving fleet-merged quantiles/SLO burn (`sonata_fleet_*` families),
+  the ``/debug/fleet`` scoreboard, stitched cross-host traces at
+  ``/debug/traces/stitched?id=``, and a fleet flight recorder that
+  auto-dumps on node eviction, breaker trips, and fast-burn breaches.
 
 Binds ``127.0.0.1:$SONATA_MESH_PORT`` (default 49315, one above the
 backend default so a laptop runs both).
@@ -57,6 +64,7 @@ from ..serving import (
     faults,
     tracing,
 )
+from ..serving.fleetscope import FleetScope
 from ..serving.logs import configure_logging
 from ..serving.mesh import MeshRouter, parse_backends, resolve_node_id
 from ..serving.replicas import OPEN
@@ -162,6 +170,14 @@ class SonataMeshService:
         rt.health.set_ready(
             f"mesh router over {len(router.nodes)} node(s)")
         self._register_metrics()
+        #: sonata-fleetscope (ISSUE 13): fleet-merged quantiles/burn,
+        #: the /debug/fleet scoreboard, stitched traces, and the fleet
+        #: flight recorder — scraping rides the router's probers
+        self.fleet = FleetScope(router, tracer=rt.tracer)
+        router.attach_fleet(self.fleet)
+        self.fleet.bind_metrics(rt.registry)
+        rt.fleet = self.fleet  # the HTTP plane serves /debug/fleet
+        self.fleet.start()
 
     def _register_metrics(self) -> None:
         r = self.runtime.registry
@@ -435,6 +451,7 @@ class SonataMeshService:
                      waited_ms=round((time.monotonic() - t0) * 1e3, 1),
                      stragglers=rt.admission.in_flight)
         self.router.close()
+        self.fleet.close()
         self.unregister_node_series()
         d.note_phase("voices", closed=len(self.router.nodes))
         rt.close()
@@ -448,6 +465,7 @@ class SonataMeshService:
         self.runtime.drain.begin("shutdown")
         self.runtime.health.set_not_ready("shutting down")
         self.router.close()
+        self.fleet.close()
         self.unregister_node_series()
         with self._chan_lock:
             channels = list(self._channels.values())

@@ -158,6 +158,11 @@ class ServingRuntime:
         #: stable node identity for the fleet tier (ISSUE 12): set by
         #: the frontend once it knows its bind address, via set_node_id
         self.node_id: Optional[str] = None
+        #: fleet aggregation plane (ISSUE 13): set by the mesh router
+        #: frontend before start_http, so the HTTP plane serves
+        #: /debug/fleet and /debug/traces/stitched; None on backend
+        #: nodes (they only *export*, via /debug/scope/export)
+        self.fleet = None
         #: graceful drain (ISSUE 9): the process-wide drain flag + phase
         #: log + bounded in-flight wait; frontends' admission paths
         #: consult it so new work mid-drain fails typed (UNAVAILABLE,
@@ -268,7 +273,8 @@ class ServingRuntime:
             return None
         self.http = start_http_server(self.registry, health=self.health,
                                       port=resolved, host=host,
-                                      tracer=self.tracer, scope=self.scope)
+                                      tracer=self.tracer, scope=self.scope,
+                                      fleet=self.fleet)
         return self.http.port
 
     @property

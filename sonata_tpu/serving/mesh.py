@@ -62,6 +62,16 @@ attempt (an injected error counts toward that node's breaker exactly
 like a real one) and ``mesh.health`` fires inside every probe cycle —
 so the chaos lane can kill, wedge, or partition a node deterministically
 without owning real processes.
+
+The fleet observability plane (ISSUE 13) rides the same per-node
+prober: an attached :class:`~sonata_tpu.serving.fleetscope.FleetScope`
+gets :meth:`FleetScope.on_probe_cycle` after every health cycle and
+pulls the node's ``/debug/scope/export`` on its own slower cadence.
+The router only holds the bookkeeping: ``scope_scrape_at`` /
+``scope_stale`` per node, with a stale scrape (the fleet scraper's
+staleness budget exceeded) making the node **unroutable** — a node
+whose observability plane is wedged must not keep looking healthy just
+because the last good scrape said so.
 """
 
 from __future__ import annotations
@@ -232,6 +242,12 @@ class MeshNode:
         self.opened_at: Optional[float] = None
         self.next_probe_at: Optional[float] = None
         self.probe_backoff_s: Optional[float] = None
+        #: fleet observability bookkeeping (ISSUE 13): monotonic stamp
+        #: of the last good ``/debug/scope/export`` scrape, and the
+        #: staleness verdict the attached FleetScope maintains — stale
+        #: means unroutable (see the module docstring)
+        self.scope_scrape_at: Optional[float] = None
+        self.scope_stale = False
 
     def view(self) -> dict:
         # not named snapshot(): the repo-wide lock-order pass resolves
@@ -239,6 +255,7 @@ class MeshNode:
         # lock-taking snapshot() methods — a shared name would read as
         # a mesh-lock -> pool-lock -> mesh-lock cycle
         return {"node_id": self.node_id, "addr": self.spec.addr,
+                "index": self.index,
                 "state": _STATE_NAMES[self.state],
                 "ready": self.ready, "draining": self.draining,
                 "outstanding": self.outstanding,
@@ -249,7 +266,12 @@ class MeshNode:
                 "consecutive_failures": self.consecutive_failures,
                 "consecutive_probe_failures":
                     self.consecutive_probe_failures,
-                "probe_backoff_s": self.probe_backoff_s}
+                "probe_backoff_s": self.probe_backoff_s,
+                "scope_stale": self.scope_stale,
+                "scope_scrape_age_s": (
+                    None if self.scope_scrape_at is None
+                    else round(time.monotonic() - self.scope_scrape_at,
+                               3))}
 
 
 def default_classify(exc: BaseException) -> str:
@@ -333,6 +355,9 @@ class MeshRouter:
                       "hedged": 0, "failed": 0, "breaker_opens": 0,
                       "recovered": 0, "probe_failures": 0}
         self._wake = threading.Event()
+        #: attached fleet observability plane (ISSUE 13) — probed on
+        #: every cycle, scrapes on its own cadence; None costs one read
+        self._fleet = None
         self._probers: list = []
         if start_probers:
             for node in self.nodes:
@@ -358,9 +383,47 @@ class MeshRouter:
     def closed(self) -> bool:
         return self._closed
 
+    # -- fleet observability attachment (ISSUE 13) ----------------------------
+    def attach_fleet(self, fleet) -> None:
+        """Attach the fleet aggregation plane: each node's prober calls
+        ``fleet.on_probe_cycle(node)`` after every health cycle (the
+        scope-export scrape rides the prober thread on the fleet's own
+        slower cadence, so a wedged node export can never stall a
+        peer's probes either)."""
+        self._fleet = fleet
+
+    def record_scope_scrape(self, node: MeshNode) -> None:
+        """One successful scope-export scrape of ``node`` (stamps the
+        staleness clock the fleet scraper reads back)."""
+        with self._lock:
+            node.scope_scrape_at = time.monotonic()
+
+    def scope_scrape_age_s(self, node: MeshNode) -> Optional[float]:
+        """Seconds since the node's scope export last scraped OK, or
+        None before the first success (the
+        ``sonata_mesh_node_scrape_age_seconds`` callback)."""
+        with self._lock:
+            at = node.scope_scrape_at
+        return None if at is None else time.monotonic() - at
+
+    def set_scope_stale(self, node: MeshNode, stale: bool) -> None:
+        """Flip the staleness verdict (the FleetScope's eviction lever):
+        a stale node is unroutable until a scrape lands again."""
+        with self._lock:
+            was, node.scope_stale = node.scope_stale, stale
+        if stale and not was:
+            log.warning(
+                "mesh %s: node %s scope-export scrape is stale; evicted "
+                "to unroutable until its observability plane answers "
+                "again", self.name, node.node_id)
+        elif was and not stale:
+            log.info("mesh %s: node %s scope-export scrape recovered; "
+                     "routable again", self.name, node.node_id)
+
     # -- membership / health --------------------------------------------------
     def _routable_locked(self, node: MeshNode) -> bool:
-        return node.state != OPEN and node.ready and not node.draining
+        return (node.state != OPEN and node.ready and not node.draining
+                and not node.scope_stale)
 
     def routable_count(self) -> int:
         """Nodes currently accepting traffic (closed or probing breaker,
@@ -518,6 +581,14 @@ class MeshRouter:
             except Exception:
                 log.exception("mesh %s: probe loop error (node %s)",
                               self.name, node.node_id)
+            fleet = self._fleet
+            if fleet is not None:
+                try:
+                    fleet.on_probe_cycle(node)
+                except Exception:
+                    # the aggregation plane must never stall membership
+                    log.exception("mesh %s: fleet scrape error (node %s)",
+                                  self.name, node.node_id)
             self._wake.wait(timeout=self.probe_interval_s)
 
     # -- routing --------------------------------------------------------------
@@ -547,14 +618,15 @@ class MeshRouter:
             for n in self.nodes:
                 if (n.state == HALF_OPEN and n.outstanding == 0
                         and n.ready and not n.draining
-                        and n not in exclude):
+                        and not n.scope_stale and n not in exclude):
                     n.outstanding += 1
                     n.routed += 1
                     self.stats["routed"] += 1
                     return n
             routable = [n for n in self.nodes
                         if n.state == CLOSED and n.ready
-                        and not n.draining and n not in exclude]
+                        and not n.draining and not n.scope_stale
+                        and n not in exclude]
             if not routable:
                 candidates = [n for n in self.nodes if n not in exclude]
                 if candidates and all(n.draining for n in candidates):

@@ -317,6 +317,7 @@ class _Handler(BaseHTTPRequestHandler):
     health = None
     tracer = None
     scope = None
+    fleet = None
 
     def do_GET(self):  # noqa: N802 (http.server API)
         from . import faults
@@ -353,8 +354,10 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/debug/failpoints":
             self._reply_failpoints(query)
         elif path in ("/debug/quantiles", "/debug/buckets",
-                      "/debug/timeline"):
+                      "/debug/timeline", "/debug/scope/export"):
             self._reply_scope(path, query)
+        elif path in ("/debug/fleet", "/debug/traces/stitched"):
+            self._reply_fleet(path, query)
         else:
             self._reply(404, b"not found\n")
 
@@ -372,7 +375,13 @@ class _Handler(BaseHTTPRequestHandler):
             # aggregation plane configured, no debug surface
             self._reply(404, b"scope not enabled on this server\n")
             return
-        if path == "/debug/quantiles":
+        if path == "/debug/scope/export":
+            # the fleet hop (ISSUE 13): the whole aggregation plane as
+            # a compact mergeable payload, tagged with this node's id
+            doc = self.scope.export_snapshot()
+            doc["node_id"] = getattr(self.health, "node_id", None)
+            body = json.dumps(doc)
+        elif path == "/debug/quantiles":
             body = json.dumps({**self.scope.quantiles_snapshot(),
                                **self.scope.slo_snapshot()})
         elif path == "/debug/buckets":
@@ -388,6 +397,29 @@ class _Handler(BaseHTTPRequestHandler):
                     "interval_s": self.scope.tick_interval_s,
                     "snapshots": snaps})
         self._reply(200, body.encode("utf-8"),
+                    "application/json; charset=utf-8")
+
+    # -- fleet aggregation plane (serving/fleetscope.py) ---------------------
+    def _reply_fleet(self, path: str, query: str) -> None:
+        """``/debug/fleet`` (the fleet scoreboard) and
+        ``/debug/traces/stitched?id=`` (router + serving-node spans in
+        one Chrome-trace document).  Router-only surfaces: 404 on
+        servers with no fleet plane, same gate as the scope/tracer
+        siblings."""
+        import json
+        from urllib.parse import parse_qs
+
+        if self.fleet is None:
+            self._reply(404, b"fleet aggregation not enabled on this "
+                             b"server\n")
+            return
+        if path == "/debug/fleet":
+            code, doc = 200, self.fleet.fleet_snapshot()
+        else:
+            params = parse_qs(query)
+            code, doc = self.fleet.stitched_trace(
+                params.get("id", [""])[0])
+        self._reply(code, json.dumps(doc).encode("utf-8"),
                     "application/json; charset=utf-8")
 
     # -- failpoint arming plane (serving/faults.py) --------------------------
@@ -438,6 +470,11 @@ class _Handler(BaseHTTPRequestHandler):
         params = parse_qs(query)
         traces = (self.tracer.slowest_traces() if path == "/debug/slowest"
                   else self.tracer.recent_traces())
+        wanted_id = params.get("id", [""])[0]
+        if wanted_id:
+            # exact-id lookup: what the mesh router's stitched-trace
+            # fetch uses to pull one node trace instead of the ring
+            traces = [t for t in traces if t.request_id == wanted_id]
         try:
             limit = int(params.get("limit", ["0"])[0])
         except ValueError:
@@ -536,17 +573,21 @@ def resolve_metrics_port(port: Optional[int] = None) -> Optional[int]:
 def start_http_server(registry: MetricsRegistry, health=None,
                       port: Optional[int] = None,
                       host: Optional[str] = None,
-                      tracer=None, scope=None) -> MetricsHTTPServer:
+                      tracer=None, scope=None,
+                      fleet=None) -> MetricsHTTPServer:
     """Serve ``/metrics``, ``/healthz``, ``/readyz`` — plus, when a
     :class:`~sonata_tpu.serving.tracing.Tracer` is given,
-    ``/debug/traces``, ``/debug/slowest``, and ``/debug/profile``, and,
-    when a :class:`~sonata_tpu.serving.scope.Scope` is given,
-    ``/debug/quantiles``, ``/debug/buckets``, and ``/debug/timeline`` —
-    in a daemon thread."""
+    ``/debug/traces``, ``/debug/slowest``, and ``/debug/profile``; when
+    a :class:`~sonata_tpu.serving.scope.Scope` is given,
+    ``/debug/quantiles``, ``/debug/buckets``, ``/debug/timeline``, and
+    ``/debug/scope/export``; and, when a
+    :class:`~sonata_tpu.serving.fleetscope.FleetScope` is given (mesh
+    routers), ``/debug/fleet`` and ``/debug/traces/stitched`` — in a
+    daemon thread."""
     host = host or os.environ.get(METRICS_HOST_ENV, "127.0.0.1")
     handler = type("BoundHandler", (_Handler,),
                    {"registry": registry, "health": health,
-                    "tracer": tracer, "scope": scope})
+                    "tracer": tracer, "scope": scope, "fleet": fleet})
     httpd = ThreadingHTTPServer((host, port or 0), handler)
     httpd.daemon_threads = True
     return MetricsHTTPServer(httpd)

@@ -671,6 +671,57 @@ class Scope:
         with self._timeline_lock:
             return list(self._timeline)
 
+    # -- cross-process export (the fleet hop, ISSUE 13) -----------------------
+    def export_snapshot(self) -> dict:
+        """Compact mergeable export of the whole aggregation plane,
+        served per node at ``GET /debug/scope/export`` and folded
+        fleet-wide by the sonata-mesh router's
+        :class:`~sonata_tpu.serving.fleetscope.FleetScope`.
+
+        Ships sketch *bins and slot epochs*, never samples (the
+        :mod:`.sketches` export contract), the SLO counter rings, the
+        totals, and the top padding-waste buckets.  ``wall_time`` lets
+        the importer measure this node's clock offset against its own
+        fetch window (what re-bases stitched traces).  Cost: one pass
+        over the rolling rings under their slot locks — no merging, no
+        quantile math — so serving it at the fleet scrape cadence stays
+        inside the PR-7 <=2% overhead bar (measured: FLEET_r01.json
+        ``export_overhead_ratio``)."""
+        from .sketches import EXPORT_VERSION
+
+        with self._bucket_lock:
+            totals = {
+                "dispatches_total": self.dispatches_total,
+                "padding_waste_seconds_total": round(
+                    self.padding_waste_seconds_total, 6),
+                "cold_compiles_total": self.cold_compiles_total,
+                "runtime_cold_compiles_total": sum(
+                    self._runtime_cold.values())}
+            top_rows = [
+                {"batch_bucket": b, "text_bucket": t, "frame_bucket": f,
+                 **{k: (round(v, 6) if isinstance(v, float) else v)
+                    for k, v in acc.items()}}
+                for (b, t, f), acc in sorted(
+                    self._buckets.items(),
+                    key=lambda kv: kv[1]["waste_seconds"],
+                    reverse=True)[:8]]
+        return {
+            "v": EXPORT_VERSION,
+            "wall_time": time.time(),
+            "windows": [label for label, _s, _n in WINDOWS],
+            "stages": {
+                stage: {label: self._stages[stage][label].export()
+                        for label, _s, _n in WINDOWS}
+                for stage in STAGES},
+            "slos": {
+                spec.name: {
+                    label: self._slo_counts[spec.name][label].export()
+                    for label in (FAST_WINDOW[0], SLOW_WINDOW[0])}
+                for spec in self.slos},
+            "slo_table": [spec.to_dict() for spec in self.slos],
+            "totals": totals,
+            "top_waste_buckets": top_rows}
+
     def timeline_chrome(self) -> dict:
         """Counter-track export: load next to ``/debug/traces``' chrome
         file and the recorder's gauges line up under the spans."""

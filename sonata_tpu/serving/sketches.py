@@ -23,6 +23,20 @@ primitives:
 
 Everything takes an injectable ``clock`` so the window-expiry tests run
 on a fake clock instead of sleeping.
+
+**Cross-process export** (ISSUE 13): every container serializes to a
+compact versioned payload — bucket *bins* and slot *epochs*, never raw
+samples — via ``export()``, and imports fold back with
+:func:`merged_from_export` / :func:`totals_from_export`.  Because merge
+is bucket-wise addition, a fleet sketch merged from N nodes' exports is
+*identical* to the sketch of the pooled observations, so fleet
+quantiles inherit the same relative-error guarantee (the pinned
+cross-process bound in tests/test_fleetscope.py).  Slot epochs are
+re-based to the importer's clock through the exporter's own
+``now_epoch`` (monotonic clocks are not comparable across hosts, ages
+are), and a version or accuracy mismatch raises the typed
+:class:`SketchImportError` — folding incompatible bins silently would
+corrupt every fleet quantile downstream.
 """
 
 from __future__ import annotations
@@ -30,7 +44,7 @@ from __future__ import annotations
 import math
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 #: smallest value (seconds) the sketch distinguishes from zero; serving
 #: latencies below a microsecond are all "instant" for SLO purposes
@@ -38,6 +52,30 @@ MIN_TRACKED = 1e-6
 
 DEFAULT_RELATIVE_ACCURACY = 0.01
 DEFAULT_MAX_BINS = 512
+
+#: version stamp on every export payload; importers reject anything else
+#: (typed, loud) instead of folding bins whose meaning may have changed
+EXPORT_VERSION = 1
+
+
+class SketchImportError(ValueError):
+    """An export payload this build cannot import: unknown version,
+    incompatible relative accuracy (bucket keys are only comparable
+    between sketches sharing one gamma), or a malformed document.
+    Typed so cross-process importers (the sonata-mesh fleet scraper)
+    fail loudly per node instead of quietly merging garbage into
+    fleet-wide quantiles."""
+
+
+def _check_version(data, what: str) -> None:
+    if not isinstance(data, dict):
+        raise SketchImportError(
+            f"{what} export must be a dict, got {type(data).__name__}")
+    v = data.get("v")
+    if v != EXPORT_VERSION:
+        raise SketchImportError(
+            f"{what} export version {v!r} is not importable by this "
+            f"build (speaks version {EXPORT_VERSION})")
 
 
 class QuantileSketch:
@@ -148,9 +186,69 @@ class QuantileSketch:
                 "p90": _round(self.quantile(0.9)),
                 "p99": _round(self.quantile(0.99))}
 
+    # -- cross-process export --------------------------------------------------
+    def export(self) -> dict:
+        """Versioned, JSON-safe payload: bins + counts, never samples.
+        Bin keys serialize as strings (JSON object keys)."""
+        return export_quantile_sketch(self)
+
+    @classmethod
+    def from_export(cls, data) -> "QuantileSketch":
+        """Rebuild from :meth:`export` output; raises the typed
+        :class:`SketchImportError` on version mismatch or malformed
+        payloads."""
+        _check_version(data, "QuantileSketch")
+        try:
+            sk = cls(float(data["ra"]))
+            for k, c in dict(data["bins"]).items():
+                sk._bins[int(k)] = int(c)
+            sk._zero_count = int(data["zero"])
+            sk.count = int(data["count"])
+            sk.sum = float(data["sum"])
+            if sk.count > 0:
+                sk.min = float(data["min"])
+                sk.max = float(data["max"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise SketchImportError(
+                f"malformed QuantileSketch export: {e}") from None
+        if len(sk._bins) > sk._max_bins:
+            sk._collapse()
+        return sk
+
+    def merge_export(self, data) -> None:
+        """Fold an exported sketch into self.  Accuracy must match:
+        bucket key ``i`` means ``gamma**i`` and gammas differing means
+        the same key names a different value — silently adding such bins
+        would shift every downstream quantile."""
+        other = QuantileSketch.from_export(data)
+        if abs(other.relative_accuracy - self.relative_accuracy) > 1e-12:
+            raise SketchImportError(
+                f"cannot merge sketch with relative_accuracy="
+                f"{other.relative_accuracy} into one with "
+                f"{self.relative_accuracy}: bucket keys are incompatible")
+        self.merge(other)
+
 
 def _round(v: Optional[float]) -> Optional[float]:
     return None if v is None else round(v, 6)
+
+
+def export_quantile_sketch(sk: "QuantileSketch") -> dict:
+    """Serialize one sketch (the :meth:`QuantileSketch.export` body).
+
+    A module function — not a method call — so the ring containers can
+    serialize their slot sketches while holding their slot lock without
+    the serializer sharing a bare name with the lock-taking ring
+    ``export`` methods (the repo-wide lock-order pass resolves calls by
+    bare name, like the mesh ``view()``/``snapshot()`` note)."""
+    return {"v": EXPORT_VERSION,
+            "ra": sk.relative_accuracy,
+            "bins": {str(k): c for k, c in sk._bins.items()},
+            "zero": sk._zero_count,
+            "count": sk.count,
+            "sum": sk.sum,
+            "min": None if sk.count == 0 else sk.min,
+            "max": None if sk.count == 0 else sk.max}
 
 
 class _SlotRing:
@@ -224,6 +322,23 @@ class RollingSketch(_SlotRing):
                 out.merge(sketch)
         return out
 
+    def export(self) -> dict:
+        """Versioned ring payload: per-slot bins + slot epochs, plus the
+        exporter's ``now_epoch`` so the importer can turn epochs into
+        *ages* (monotonic epochs are process-local; ages cross hosts).
+        Runs wholly under the ring lock for the same reason as
+        :meth:`merged`."""
+        with self._lock:
+            now_epoch = self._epoch()
+            ring = [{"epoch": epoch,
+                     "sketch": export_quantile_sketch(payload)}
+                    for epoch, payload in self._ring.values()
+                    if now_epoch - epoch <= self.slots]
+        return {"v": EXPORT_VERSION, "kind": "sketch",
+                "window_s": self.window_s, "slots": self.slots,
+                "ra": self._accuracy, "now_epoch": now_epoch,
+                "ring": ring}
+
 
 class RollingCounter(_SlotRing):
     """Good/bad event counts over a rolling time window (SLO feed)."""
@@ -255,3 +370,110 @@ class RollingCounter(_SlotRing):
         if total == 0:
             return None
         return bad / total
+
+    def export(self) -> dict:
+        """Versioned ring payload (good/bad per slot + slot epochs) —
+        the counter twin of :meth:`RollingSketch.export`."""
+        with self._lock:
+            now_epoch = self._epoch()
+            ring = [{"epoch": epoch, "good": payload[0], "bad": payload[1]}
+                    for epoch, payload in self._ring.values()
+                    if now_epoch - epoch <= self.slots]
+        return {"v": EXPORT_VERSION, "kind": "counter",
+                "window_s": self.window_s, "slots": self.slots,
+                "now_epoch": now_epoch, "ring": ring}
+
+
+# ---------------------------------------------------------------------------
+# ring-export importers (the router side of the fleet hop)
+# ---------------------------------------------------------------------------
+
+def _ring_meta(data, what: str) -> tuple:
+    _check_version(data, what)
+    try:
+        window_s = float(data["window_s"])
+        slots = int(data["slots"])
+        now_epoch = int(data["now_epoch"])
+        ring = list(data["ring"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise SketchImportError(f"malformed {what} export: {e}") from None
+    if window_s <= 0 or slots <= 0:
+        raise SketchImportError(
+            f"malformed {what} export: window_s={window_s} slots={slots}")
+    return window_s, slots, now_epoch, ring
+
+
+def ring_from_export(data) -> Tuple[float, float, List[tuple]]:
+    """Parse a :meth:`RollingSketch.export` payload into
+    ``(window_s, slot_s, [(age_s, QuantileSketch), ...])`` where
+    ``age_s`` is the slot's age *at export time*.  The caller adds its
+    own scrape age before expiring slots against the window.  Raises
+    :class:`SketchImportError` (typed, loud) on any malformed entry —
+    validation happens at import, not lazily at query time."""
+    window_s, slots, now_epoch, ring = _ring_meta(data, "RollingSketch")
+    slot_s = window_s / slots
+    out: List[tuple] = []
+    for entry in ring:
+        try:
+            age_s = (now_epoch - int(entry["epoch"])) * slot_s
+            sketch = QuantileSketch.from_export(entry["sketch"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise SketchImportError(
+                f"malformed RollingSketch slot: {e}") from None
+        if age_s <= window_s:  # anything older exports as expired: no-op
+            out.append((age_s, sketch))
+    return window_s, slot_s, out
+
+
+def merged_from_export(data, *, extra_age_s: float = 0.0,
+                       relative_accuracy: Optional[float] = None
+                       ) -> QuantileSketch:
+    """One sketch folding a :meth:`RollingSketch.export` payload,
+    expiring slots whose export-time age plus ``extra_age_s`` (the
+    importer's scrape staleness) exceeds the window.  An empty or
+    fully-expired export merges as a no-op (count 0)."""
+    window_s, slot_s, ring = ring_from_export(data)
+    ra = (relative_accuracy if relative_accuracy is not None
+          else float(data.get("ra", DEFAULT_RELATIVE_ACCURACY)))
+    out = QuantileSketch(ra)
+    for age_s, sketch in ring:
+        if age_s + extra_age_s > window_s:
+            continue
+        if abs(sketch.relative_accuracy - ra) > 1e-12:
+            raise SketchImportError(
+                f"slot relative_accuracy {sketch.relative_accuracy} != "
+                f"ring accuracy {ra}")
+        out.merge(sketch)
+    return out
+
+
+def counter_ring_from_export(data) -> Tuple[float, float, List[tuple]]:
+    """Parse a :meth:`RollingCounter.export` payload into
+    ``(window_s, slot_s, [(age_s, good, bad), ...])`` — the counter
+    twin of :func:`ring_from_export`, validated whole at import."""
+    window_s, slots, now_epoch, ring = _ring_meta(data, "RollingCounter")
+    slot_s = window_s / slots
+    out: List[tuple] = []
+    for entry in ring:
+        try:
+            age_s = (now_epoch - int(entry["epoch"])) * slot_s
+            g, b = int(entry["good"]), int(entry["bad"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise SketchImportError(
+                f"malformed RollingCounter slot: {e}") from None
+        if age_s <= window_s:
+            out.append((age_s, g, b))
+    return window_s, slot_s, out
+
+
+def totals_from_export(data, *, extra_age_s: float = 0.0) -> tuple:
+    """(good, bad) folding a :meth:`RollingCounter.export` payload with
+    the same age-expiry contract as :func:`merged_from_export`."""
+    window_s, _slot_s, ring = counter_ring_from_export(data)
+    good = bad = 0
+    for age_s, g, b in ring:
+        if age_s + extra_age_s > window_s:
+            continue
+        good += g
+        bad += b
+    return good, bad

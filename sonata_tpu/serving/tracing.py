@@ -271,6 +271,37 @@ class Trace:
         return events
 
 
+def chrome_events_from_dict(trace_dict: dict, *, pid: int, tid: int = 1,
+                            wall_offset_s: float = 0.0) -> list:
+    """Chrome trace events from a *serialized* :meth:`Trace.to_dict`
+    document — how the sonata-mesh router splices a remote node's trace
+    (fetched as JSON over the node's ``/debug/traces?id=`` plane) into
+    one stitched cross-host document.
+
+    ``wall_offset_s`` is the probe-measured remote-minus-local wall
+    clock offset; subtracting it re-bases the remote spans onto the
+    local timebase, matching :meth:`Trace.chrome_events`'s
+    wall-anchored ``ts`` so router and node spans line up in one
+    Perfetto load."""
+    rid = trace_dict.get("request_id", "")
+    events = [{"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+               "args": {"name": f"req {rid}"}}]
+    t0 = float(trace_dict.get("wall_start", 0.0)) - wall_offset_s
+    for s in trace_dict.get("spans", ()):
+        start_s = t0 + float(s.get("start_ms", 0.0)) / 1e3
+        events.append({
+            "ph": "X", "pid": pid, "tid": tid,
+            "name": s.get("name", "?"),
+            "cat": trace_dict.get("name", ""),
+            "ts": round(start_s * 1e6, 1),
+            "dur": round(float(s.get("duration_ms", 0.0)) * 1e3, 1),
+            "args": {**(s.get("attrs") or {}), "request_id": rid,
+                     "span_id": s.get("span_id", ""),
+                     "parent_id": s.get("parent_id") or ""},
+        })
+    return events
+
+
 # ---------------------------------------------------------------------------
 # context propagation (same-thread hooks)
 # ---------------------------------------------------------------------------

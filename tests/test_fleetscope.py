@@ -1,0 +1,797 @@
+"""sonata-fleetscope: sketch export/import, fleet aggregation over the
+mesh, staleness eviction, the fleet flight recorder, and stitched
+cross-host traces.
+
+The serialization half pins the ISSUE-13 acceptance bound across REAL
+process boundaries: two subprocesses each build a rolling sketch from
+their own observations and print the versioned export; this process
+merges the exports and checks fleet quantiles against the pooled raw
+observations within the sketch's 1% relative-error guarantee.  The
+aggregation half drives :class:`~sonata_tpu.serving.fleetscope.
+FleetScope` through fake fetch callables over a prober-less router, so
+cadence, staleness, metrics, recorder dumps, and stitching are pinned
+deterministically.
+"""
+
+import json
+import math
+import random
+import subprocess
+import sys
+import time
+
+import pytest
+
+import sonata_tpu.serving.sketches as sketches_mod
+from sonata_tpu.serving import tracing
+from sonata_tpu.serving.fleetscope import FleetScope
+from sonata_tpu.serving.mesh import MeshRouter, NodeSpec
+from sonata_tpu.serving.metrics import MetricsRegistry
+from sonata_tpu.serving.scope import Scope
+from sonata_tpu.serving.sketches import (
+    EXPORT_VERSION,
+    QuantileSketch,
+    RollingCounter,
+    RollingSketch,
+    SketchImportError,
+    merged_from_export,
+    totals_from_export,
+)
+from sonata_tpu.serving.tracing import Tracer
+
+
+def make_router(n_nodes=2, **kw):
+    specs = [NodeSpec("127.0.0.1", 40000 + i, 41000 + i)
+             for i in range(n_nodes)]
+    kw.setdefault("start_probers", False)
+    return MeshRouter(specs, **kw)
+
+
+def make_fleet(router, **kw):
+    kw.setdefault("scrape_interval_s", 0.01)
+    kw.setdefault("stale_s", 30.0)
+    return FleetScope(router, **kw)
+
+
+# ---------------------------------------------------------------------------
+# sketch export / import units
+# ---------------------------------------------------------------------------
+
+def test_quantile_sketch_export_roundtrip_preserves_quantiles():
+    sk = QuantileSketch()
+    rng = random.Random(7)
+    for _ in range(2000):
+        sk.add(rng.lognormvariate(-2.0, 0.5))
+    back = QuantileSketch.from_export(json.loads(json.dumps(sk.export())))
+    for q in (0.5, 0.9, 0.99):
+        assert back.quantile(q) == sk.quantile(q)
+    assert back.count == sk.count and back.sum == pytest.approx(sk.sum)
+
+
+def test_export_version_mismatch_is_loud_and_typed():
+    sk = QuantileSketch()
+    sk.add(1.0)
+    bad = sk.export()
+    bad["v"] = EXPORT_VERSION + 1
+    with pytest.raises(SketchImportError):
+        QuantileSketch.from_export(bad)
+    rs = RollingSketch(60.0, 12)
+    rs.add(1.0)
+    ring_bad = rs.export()
+    ring_bad["v"] = 99
+    with pytest.raises(SketchImportError):
+        merged_from_export(ring_bad)
+    rc = RollingCounter(300.0, 15)
+    rc.record(bad=True)
+    c_bad = rc.export()
+    c_bad["v"] = None
+    with pytest.raises(SketchImportError):
+        totals_from_export(c_bad)
+
+
+def test_malformed_export_is_typed():
+    with pytest.raises(SketchImportError):
+        QuantileSketch.from_export("not a dict")
+    good = RollingSketch(60.0, 12)
+    good.add(0.5)
+    payload = good.export()
+    payload["ring"][0]["sketch"] = {"v": EXPORT_VERSION}  # fields missing
+    with pytest.raises(SketchImportError):
+        merged_from_export(payload)
+
+
+def test_accuracy_mismatch_refuses_merge():
+    a = QuantileSketch(0.01)
+    b = QuantileSketch(0.05)
+    b.add(1.0)
+    with pytest.raises(SketchImportError):
+        a.merge_export(b.export())
+
+
+def test_empty_and_expired_slot_exports_merge_as_noops():
+    empty = RollingSketch(60.0, 12)
+    merged = merged_from_export(empty.export())
+    assert merged.count == 0 and merged.quantile(0.5) is None
+    fresh = RollingSketch(60.0, 12)
+    fresh.add(0.25)
+    # an import whose scrape age already exceeds the window drops every
+    # slot: the no-op contract for stale data
+    merged = merged_from_export(fresh.export(), extra_age_s=61.0)
+    assert merged.count == 0
+    # and a fake-clock ring whose slots aged past the window exports
+    # them as already expired
+    clock = [0.0]
+    aged = RollingSketch(60.0, 12, clock=lambda: clock[0])
+    aged.add(0.25)
+    clock[0] = 120.0
+    assert merged_from_export(aged.export()).count == 0
+
+
+def test_rolling_counter_export_ages_and_totals():
+    rc = RollingCounter(300.0, 15)
+    for _ in range(3):
+        rc.record(bad=False)
+    rc.record(bad=True)
+    assert totals_from_export(rc.export()) == (3, 1)
+    assert totals_from_export(rc.export(), extra_age_s=301.0) == (0, 0)
+
+
+# ---------------------------------------------------------------------------
+# the pinned cross-process bound (ISSUE 13 acceptance)
+# ---------------------------------------------------------------------------
+
+_EXPORT_SCRIPT = """
+import importlib.util, json, random, sys
+spec = importlib.util.spec_from_file_location("sk", sys.argv[1])
+sk = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(sk)
+rng = random.Random(int(sys.argv[2]))
+rs = sk.RollingSketch(60.0, 12)
+obs = [rng.lognormvariate(-2.0, 0.7) for _ in range(3000)]
+for v in obs:
+    rs.add(v)
+print(json.dumps({"export": rs.export(), "obs": obs}))
+"""
+
+
+def _node_process(seed: int) -> dict:
+    out = subprocess.run(
+        [sys.executable, "-c", _EXPORT_SCRIPT,
+         sketches_mod.__file__, str(seed)],
+        capture_output=True, text=True, timeout=120, check=True)
+    return json.loads(out.stdout)
+
+
+def test_fleet_quantiles_from_merged_exports_match_pooled_raw_obs():
+    """Fleet quantiles computed from merged per-node sketch exports
+    agree with pooling the raw observations to within the sketch's 1%
+    relative-error guarantee — across two REAL processes."""
+    reports = [_node_process(seed) for seed in (11, 23)]
+    fleet = QuantileSketch()
+    pooled = []
+    for rep in reports:
+        node_sketch = merged_from_export(rep["export"])
+        assert node_sketch.count == len(rep["obs"])
+        fleet.merge(node_sketch)
+        pooled.extend(rep["obs"])
+    pooled.sort()
+    assert fleet.count == len(pooled)
+    ra = fleet.relative_accuracy
+    for q in (0.5, 0.9, 0.95, 0.99):
+        # the sketch's rank convention: the bucket holding element
+        # floor(q * (n - 1)) of the sorted pool
+        true = pooled[int(math.floor(q * (len(pooled) - 1)))]
+        est = fleet.quantile(q)
+        assert abs(est - true) <= ra * true * (1.0 + 1e-9), (
+            f"q={q}: merged {est} vs pooled {true} exceeds the "
+            f"{ra:.0%} relative-error bound")
+    # stronger: bucket union makes the merged sketch IDENTICAL to one
+    # sketch fed the pooled observations directly
+    direct = QuantileSketch()
+    for v in pooled:
+        direct.add(v)
+    for q in (0.5, 0.9, 0.99):
+        assert fleet.quantile(q) == direct.quantile(q)
+
+
+# ---------------------------------------------------------------------------
+# scope export -> fleet ingest
+# ---------------------------------------------------------------------------
+
+def _scope_with_traffic(n=200, slow_ttfb=0.05):
+    sc = Scope()
+    for i in range(n):
+        sc.observe("e2e", 0.1 + (i % 10) * 0.01)
+        sc.observe("ttfb", slow_ttfb)
+    return sc
+
+
+def test_scope_export_roundtrips_through_fleet_ingest():
+    sc = _scope_with_traffic()
+    try:
+        export = json.loads(json.dumps(sc.export_snapshot()))
+        assert export["v"] == EXPORT_VERSION
+        router = make_router(2)
+        fleet = make_fleet(router)
+        try:
+            fleet.ingest(router.nodes[0], export)
+            assert fleet.nodes_reporting() == 1
+            for window in ("1m", "5m", "1h"):
+                assert fleet.fleet_quantile("e2e", 0.5, window) == \
+                    sc.quantile("e2e", 0.5, window)
+            # single node: its delta against the fleet is exactly zero
+            assert fleet.node_delta(router.nodes[0], "e2e") == 0.0
+            # the scrape stamped the router-side staleness clock
+            assert router.scope_scrape_age_s(router.nodes[0]) is not None
+        finally:
+            fleet.close()
+            router.close()
+    finally:
+        sc.close()
+
+
+def test_ingest_rejects_envelope_version_mismatch():
+    sc = _scope_with_traffic(10)
+    try:
+        export = sc.export_snapshot()
+        export["v"] = 99
+        router = make_router(1)
+        fleet = make_fleet(router)
+        try:
+            with pytest.raises(SketchImportError):
+                fleet.ingest(router.nodes[0], export)
+            assert fleet.nodes_reporting() == 0
+        finally:
+            fleet.close()
+            router.close()
+    finally:
+        sc.close()
+
+
+def test_ingest_rejects_mismatched_relative_accuracy_loudly():
+    # fleet merges are raw bucket adds: a node built with a different
+    # gamma must be rejected whole at ingest (its bin keys mean
+    # different values), never folded into fleet quantiles
+    sc = _scope_with_traffic(10)
+    try:
+        export = sc.export_snapshot()
+        alien = RollingSketch(60.0, 12, relative_accuracy=0.05)
+        alien.add(0.25)
+        export["stages"]["e2e"]["1m"] = alien.export()
+        router = make_router(1)
+        fleet = make_fleet(router)
+        try:
+            with pytest.raises(SketchImportError):
+                fleet.ingest(router.nodes[0], export)
+            assert fleet.nodes_reporting() == 0
+        finally:
+            fleet.close()
+            router.close()
+    finally:
+        sc.close()
+
+
+def test_export_gone_404_drops_the_stale_node_scope():
+    # a node restarted with SONATA_SCOPE=0: its old export must not
+    # keep it "reporting" with an unboundedly-aging snapshot, and its
+    # node_id-labeled series must go away with it
+    sc = _scope_with_traffic(10)
+    state = {"code": 200}
+
+    def fetch(url, timeout_s):
+        return state["code"], (_export_body(sc)
+                               if state["code"] == 200 else "gone")
+
+    router = make_router(1)
+    registry = MetricsRegistry()
+    fleet = make_fleet(router, fetch=fetch, scrape_interval_s=0.0,
+                       stale_s=0.05)
+    try:
+        fleet.bind_metrics(registry)
+        fleet.on_probe_cycle(router.nodes[0])
+        assert fleet.nodes_reporting() == 1
+        state["code"] = 404
+        time.sleep(0.06)
+        fleet.on_probe_cycle(router.nodes[0])
+        assert fleet.nodes_reporting() == 0
+        from sonata_tpu.serving.metrics import parse_prometheus_text
+
+        parsed = parse_prometheus_text(registry.render())
+        assert "sonata_mesh_node_scrape_age_seconds" not in parsed
+        # and being deliberately unscoped is not a wedge: no eviction
+        assert router.routable_count() == 1
+    finally:
+        fleet.close()
+        router.close()
+        sc.close()
+
+
+def test_no_spurious_eviction_dump_when_router_boots_first(tmp_path):
+    # a router booting before its backends sees them unroutable on the
+    # first tick — that is a cold boot, not an eviction incident
+    router = make_router(2)
+    router.nodes[0].ready = False  # still warming at first tick
+    fleet = make_fleet(router, dump_dir=str(tmp_path))
+    try:
+        fleet.tick()
+        assert not any("node-evicted" in p.name
+                       for p in tmp_path.iterdir())
+        # a real eviction after the baseline tick still dumps
+        router.nodes[1].ready = False
+        fleet.tick()
+        assert any("node-evicted" in p.name for p in tmp_path.iterdir())
+    finally:
+        fleet.close()
+        router.close()
+
+
+def test_fleet_burn_rate_pools_node_slo_counters():
+    # node A within SLO, node B blowing its ttfb p95 threshold (2 s)
+    a = _scope_with_traffic(60, slow_ttfb=0.05)
+    b = _scope_with_traffic(60, slow_ttfb=5.0)
+    router = make_router(2)
+    fleet = make_fleet(router)
+    try:
+        fleet.ingest(router.nodes[0], a.export_snapshot())
+        fleet.ingest(router.nodes[1], b.export_snapshot())
+        burn = fleet.fleet_burn_rate("ttfb_p95", "5m")
+        # 60 bad of 120 observations over a 0.05 budget
+        assert burn == pytest.approx((60 / 120) / 0.05)
+        assert fleet.fleet_budget_remaining("ttfb_p95") == \
+            pytest.approx(1.0 - burn)
+    finally:
+        fleet.close()
+        router.close()
+        a.close()
+        b.close()
+
+
+def test_node_delta_names_the_outlier_node():
+    # 300 fast observations on node A, 3 slow ones on node B: the
+    # fleet p99 stays in A's territory, so B's tail stands out positive
+    a = _scope_with_traffic(300, slow_ttfb=0.05)
+    b = _scope_with_traffic(3, slow_ttfb=5.0)
+    router = make_router(2)
+    fleet = make_fleet(router)
+    try:
+        fleet.ingest(router.nodes[0], a.export_snapshot())
+        fleet.ingest(router.nodes[1], b.export_snapshot())
+        assert fleet.node_delta(router.nodes[1], "ttfb") > 1.0
+        assert fleet.node_delta(router.nodes[0], "ttfb") <= 0
+    finally:
+        fleet.close()
+        router.close()
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# scraping cadence + staleness eviction
+# ---------------------------------------------------------------------------
+
+def _export_body(sc: Scope) -> str:
+    return json.dumps(sc.export_snapshot())
+
+
+def test_probe_cycle_scrapes_on_the_fleet_cadence_not_every_probe():
+    sc = _scope_with_traffic(10)
+    calls = []
+
+    def fetch(url, timeout_s):
+        calls.append(url)
+        return 200, _export_body(sc)
+
+    router = make_router(1)
+    fleet = make_fleet(router, fetch=fetch, scrape_interval_s=3600.0)
+    try:
+        for _ in range(5):
+            fleet.on_probe_cycle(router.nodes[0])
+        # first cycle scraped; the rest were inside the cadence
+        assert len(calls) == 1
+        assert calls[0].endswith("/debug/scope/export")
+        assert fleet.nodes_reporting() == 1
+    finally:
+        fleet.close()
+        router.close()
+        sc.close()
+
+
+def test_stale_scrape_evicts_node_to_unroutable_and_recovers():
+    from sonata_tpu.serving.admission import Overloaded
+
+    sc = _scope_with_traffic(10)
+    healthy = [True]
+
+    def fetch(url, timeout_s):
+        if not healthy[0]:
+            raise ConnectionError("observability plane wedged")
+        return 200, _export_body(sc)
+
+    router = make_router(1)
+    fleet = make_fleet(router, fetch=fetch, scrape_interval_s=0.01,
+                       stale_s=0.15)
+    try:
+        fleet.on_probe_cycle(router.nodes[0])
+        assert router.routable_count() == 1
+        healthy[0] = False
+        deadline = time.monotonic() + 5.0
+        while router.routable_count() == 1 and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)
+            fleet.on_probe_cycle(router.nodes[0])
+        # staleness past the budget evicted the node: a wedged
+        # observability plane must not keep looking healthy
+        assert router.routable_count() == 0
+        assert router.nodes[0].scope_stale
+        with pytest.raises(Overloaded):
+            router.pick()
+        # the plane answers again: one good scrape restores membership
+        healthy[0] = True
+        time.sleep(0.02)
+        fleet.on_probe_cycle(router.nodes[0])
+        assert router.routable_count() == 1
+        assert not router.nodes[0].scope_stale
+    finally:
+        fleet.close()
+        router.close()
+        sc.close()
+
+
+def test_scope_disabled_node_is_never_stale_evicted():
+    router = make_router(1)
+    fleet = make_fleet(router, fetch=lambda u, t: (404, "no scope"),
+                       scrape_interval_s=0.0, stale_s=0.01)
+    try:
+        fleet.on_probe_cycle(router.nodes[0])
+        time.sleep(0.05)
+        fleet.on_probe_cycle(router.nodes[0])
+        # SONATA_SCOPE=0 on the node: it does not report, but that is
+        # a configuration, not a wedged plane — still routable
+        assert router.routable_count() == 1
+        assert not router.nodes[0].scope_stale
+    finally:
+        fleet.close()
+        router.close()
+
+
+def test_malformed_node_export_is_counted_not_folded():
+    router = make_router(1)
+    fleet = make_fleet(router, fetch=lambda u, t: (200, '{"v": 42}'),
+                       scrape_interval_s=0.0)
+    try:
+        assert fleet.scrape_node(router.nodes[0]) is False
+        assert fleet.stats["import_errors"] == 1
+        assert fleet.nodes_reporting() == 0
+    finally:
+        fleet.close()
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# metrics binding
+# ---------------------------------------------------------------------------
+
+def test_fleet_metric_families_and_lazy_node_series():
+    sc = _scope_with_traffic(50)
+    router = make_router(2)
+    router.nodes[0].node_id = "rack1-host1"
+    registry = MetricsRegistry()
+    fleet = make_fleet(router)
+    try:
+        fleet.bind_metrics(registry)
+        # fixed families exist; quantile series skip while empty
+        text = registry.render()
+        assert "sonata_fleet_nodes_reporting 0" in text
+        assert "sonata_mesh_node_scrape_age_seconds" not in \
+            text.replace("# HELP", "").replace("# TYPE", "")
+        fleet.ingest(router.nodes[0], sc.export_snapshot())
+        from sonata_tpu.serving.metrics import parse_prometheus_text
+
+        parsed = parse_prometheus_text(registry.render())
+        quant = parsed.get("sonata_fleet_stage_quantile", [])
+        assert any(lbl.get("stage") == "e2e" for lbl, _v in quant)
+        burn = parsed.get("sonata_fleet_slo_burn_rate", [])
+        assert {lbl.get("window") for lbl, _v in burn} == {"5m", "1h"}
+        ages = parsed.get("sonata_mesh_node_scrape_age_seconds", [])
+        assert [lbl.get("node_id") for lbl, _v in ages] == ["rack1-host1"]
+        deltas = parsed.get("sonata_fleet_node_delta", [])
+        assert {lbl.get("node_id") for lbl, _v in deltas} == \
+            {"rack1-host1"}
+        # teardown removes exactly the node-labeled series
+        fleet.unregister_node_series()
+        parsed = parse_prometheus_text(registry.render())
+        assert "sonata_mesh_node_scrape_age_seconds" not in parsed
+        assert "sonata_fleet_node_delta" not in parsed
+        assert "sonata_fleet_nodes_reporting" in parsed
+    finally:
+        fleet.close()
+        router.close()
+        sc.close()
+
+
+def test_node_series_rekey_when_scrape_teaches_new_node_id():
+    sc = _scope_with_traffic(10)
+    router = make_router(1)
+    registry = MetricsRegistry()
+    fleet = make_fleet(router)
+    try:
+        fleet.bind_metrics(registry)
+        fleet.ingest(router.nodes[0], sc.export_snapshot())
+        router.nodes[0].node_id = "learned-id"
+        fleet.ingest(router.nodes[0], sc.export_snapshot())
+        from sonata_tpu.serving.metrics import parse_prometheus_text
+
+        ages = parse_prometheus_text(registry.render()).get(
+            "sonata_mesh_node_scrape_age_seconds", [])
+        assert [lbl.get("node_id") for lbl, _v in ages] == ["learned-id"]
+    finally:
+        fleet.close()
+        router.close()
+        sc.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet flight recorder
+# ---------------------------------------------------------------------------
+
+def test_recorder_dumps_on_breaker_trip_and_rate_limits(tmp_path):
+    router = make_router(2, retries=0, breaker_threshold=1)
+    fleet = make_fleet(router, dump_dir=str(tmp_path))
+    try:
+        fleet.tick()  # baseline
+        with pytest.raises(ConnectionError):
+            list(router.route_stream(
+                lambda n, t: (_ for _ in ()).throw(
+                    ConnectionError("down"))))
+        snap = fleet.tick()
+        assert snap["routable"] == 1
+        dumps = [p for p in tmp_path.iterdir()
+                 if "breaker-trip" in p.name]
+        assert len(dumps) == 1
+        doc = json.loads(dumps[0].read_text())
+        assert doc["reason"] == "breaker-trip"
+        last = doc["snapshots"][-1]
+        assert last["routable"] == 1
+        assert any(n["state"] == "open" for n in last["nodes"].values())
+        # a second trip inside the rate-limit window does not re-dump
+        with pytest.raises(ConnectionError):
+            list(router.route_stream(
+                lambda n, t: (_ for _ in ()).throw(
+                    ConnectionError("down")),))
+        fleet.tick()
+        assert len([p for p in tmp_path.iterdir()
+                    if "breaker-trip" in p.name]) == 1
+    finally:
+        fleet.close()
+        router.close()
+
+
+def test_recorder_catches_trip_landing_before_first_tick(tmp_path):
+    # chaos phase M regression: the baseline is set at CONSTRUCTION,
+    # so a breaker trip racing ahead of the recorder's first 1 Hz tick
+    # still registers as an edge instead of becoming the baseline
+    router = make_router(2, retries=0, breaker_threshold=1)
+    fleet = make_fleet(router, dump_dir=str(tmp_path))
+    try:
+        with pytest.raises(ConnectionError):
+            list(router.route_stream(
+                lambda n, t: (_ for _ in ()).throw(
+                    ConnectionError("down"))))
+        fleet.tick()  # the FIRST tick ever
+        assert any("breaker-trip" in p.name for p in tmp_path.iterdir())
+    finally:
+        fleet.close()
+        router.close()
+
+
+def test_recorder_dumps_on_node_eviction(tmp_path):
+    from sonata_tpu.serving.drain import Draining
+
+    router = make_router(2)
+    fleet = make_fleet(router, dump_dir=str(tmp_path))
+    try:
+        fleet.tick()
+        router._note_draining(router.nodes[0], Draining("deploy"))
+        fleet.tick()
+        assert any("node-evicted" in p.name for p in tmp_path.iterdir())
+    finally:
+        fleet.close()
+        router.close()
+
+
+def test_recorder_dumps_on_fleet_burn_breach(tmp_path):
+    # a node burning its whole ttfb budget: fast burn >> 1
+    sc = _scope_with_traffic(50, slow_ttfb=5.0)
+    router = make_router(1)
+    fleet = make_fleet(router, dump_dir=str(tmp_path))
+    try:
+        fleet.ingest(router.nodes[0], sc.export_snapshot())
+        snap = fleet.tick()
+        assert snap["fleet_burn_breach"] == 1
+        assert snap["burn:ttfb_p95"] > 1.0
+        assert any("fleet-burn" in p.name for p in tmp_path.iterdir())
+        # still breaching is not a new crossing: no second dump
+        fleet.tick()
+        assert len([p for p in tmp_path.iterdir()
+                    if "fleet-burn" in p.name]) == 1
+    finally:
+        fleet.close()
+        router.close()
+        sc.close()
+
+
+def test_recorder_ring_is_bounded(tmp_path):
+    router = make_router(1)
+    fleet = make_fleet(router, recorder_cap=5)
+    try:
+        for _ in range(12):
+            fleet.tick()
+        assert len(fleet.timeline_snapshot()) == 5
+    finally:
+        fleet.close()
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# stitched traces
+# ---------------------------------------------------------------------------
+
+def _router_trace(tracer, rid, node_id):
+    with tracer.trace_request("mesh.SynthesizeUtterance",
+                              request_id=rid):
+        with tracing.span("admission"):
+            pass
+        with tracing.span("mesh-dispatch", node=node_id,
+                          addr="127.0.0.1:40000", attempt=1):
+            pass
+        with tracing.span("stream-emit"):
+            pass
+
+
+def test_stitched_trace_splices_router_and_node_spans_rebased():
+    tracer = Tracer(enabled=True)
+    _router_trace(tracer, "stitch-1", "nodeA")
+    node_tracer = Tracer(enabled=True)
+    with node_tracer.trace_request("SynthesizeUtterance",
+                                   request_id="stitch-1"):
+        with tracing.span("dispatch"):
+            pass
+    node_doc = node_tracer.find("stitch-1").to_dict()
+    node_doc["wall_start"] += 5.0  # the node's clock runs 5 s ahead
+
+    def fetch(url, timeout_s):
+        assert "/debug/traces?id=stitch-1" in url
+        return 200, json.dumps({"traces": [node_doc]})
+
+    router = make_router(1)
+    router.nodes[0].node_id = "nodeA"
+    sc = Scope()
+    fleet = make_fleet(router, tracer=tracer, fetch=fetch)
+    try:
+        export = sc.export_snapshot()
+        export["wall_time"] = time.time() + 5.0  # same skewed clock
+        fleet.ingest(router.nodes[0], export, wall_mid=time.time())
+        code, doc = fleet.stitched_trace("stitch-1")
+        assert code == 200
+        assert doc["stitched"]["node"] == "nodeA"
+        assert doc["stitched"]["wall_offset_s"] == pytest.approx(
+            5.0, abs=0.5)
+        xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        router_names = {e["name"] for e in xs if e["pid"] == 1}
+        node_names = {e["name"] for e in xs if e["pid"] == 2}
+        assert {"admission", "mesh-dispatch", "stream-emit"} <= \
+            router_names
+        assert "dispatch" in node_names
+        # every spliced span carries the one request id
+        assert all(e["args"]["request_id"] == "stitch-1" for e in xs)
+        # clock re-based: node spans landed inside the router's window
+        # (raw, the node's 5 s skew would push them far outside)
+        router_ts = [e["ts"] for e in xs if e["pid"] == 1]
+        node_ts = [e["ts"] for e in xs if e["pid"] == 2]
+        assert min(router_ts) - 1e6 < min(node_ts) < max(router_ts) + 1e6
+    finally:
+        fleet.close()
+        router.close()
+        sc.close()
+
+
+def test_stitched_trace_unknown_id_is_404():
+    tracer = Tracer(enabled=True)
+    router = make_router(1)
+    fleet = make_fleet(router, tracer=tracer)
+    try:
+        code, doc = fleet.stitched_trace("nope")
+        assert code == 404 and "no router trace" in doc["error"]
+        code, doc = fleet.stitched_trace("")
+        assert code == 400
+    finally:
+        fleet.close()
+        router.close()
+
+
+def test_stitched_trace_survives_unreachable_node():
+    tracer = Tracer(enabled=True)
+    _router_trace(tracer, "stitch-2", "nodeB")
+
+    def fetch(url, timeout_s):
+        raise ConnectionError("node is gone")
+
+    router = make_router(1)
+    router.nodes[0].node_id = "nodeB"
+    fleet = make_fleet(router, tracer=tracer, fetch=fetch)
+    try:
+        code, doc = fleet.stitched_trace("stitch-2")
+        # router spans still load; the node side reports its error
+        assert code == 200
+        assert doc["stitched"]["node_spans"] == 0
+        assert "node_error" in doc["stitched"]
+        assert any(e["pid"] == 1 and e.get("ph") == "X"
+                   for e in doc["traceEvents"])
+    finally:
+        fleet.close()
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# the router always stamps x-request-id onto the hop (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+class _FakeGrpcContext:
+    def __init__(self, metadata=()):
+        self._md = tuple(metadata)
+        self.trailers = None
+
+    def invocation_metadata(self):
+        return self._md
+
+    def set_trailing_metadata(self, md):
+        self.trailers = md
+
+    def time_remaining(self):
+        return None
+
+
+@pytest.mark.parametrize("client_md,expect_generated", [
+    ((), True),
+    ((("x-request-id", "client-chose-this"),), False),
+])
+def test_router_always_stamps_request_id_on_the_hop(
+        monkeypatch, client_md, expect_generated):
+    """The hop metadata must carry an x-request-id even when the client
+    sent none — a router-generated id at admission is what keys
+    stitched traces and node-side log correlation."""
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    from sonata_tpu.frontends import grpc_messages as pb
+    from sonata_tpu.frontends.mesh_server import SonataMeshService
+    from sonata_tpu.serving import ServingRuntime
+
+    router = make_router(1)
+    runtime = ServingRuntime(max_in_flight=2, request_timeout_s=30.0)
+    service = SonataMeshService(router, runtime=runtime)
+    try:
+        captured = {}
+
+        def fake_stub(node, name):
+            def fn(payload, timeout=None, metadata=None):
+                captured["metadata"] = metadata
+                return iter([b"chunk"])
+            return fn
+
+        monkeypatch.setattr(service, "_stream_stub", fake_stub)
+        ctx = _FakeGrpcContext(client_md)
+        out = list(service._routed_stream(
+            "SynthesizeUtterance",
+            pb.Utterance(voice_id="v", text="hello"), ctx))
+        assert out == [b"chunk"]
+        md = dict(captured["metadata"])
+        rid = md.get("x-request-id")
+        assert rid, "the hop carried no x-request-id"
+        if expect_generated:
+            assert len(rid) == 16  # new_request_id() shape
+        else:
+            assert rid == "client-chose-this"
+        # the router's own trace carries the same id, so the stitched
+        # lookup and the node's trace share one key
+        assert runtime.tracer.find(rid) is not None
+    finally:
+        service.shutdown()

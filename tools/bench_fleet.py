@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""Fleet-observability bench: scope-export scrape cost and the
+node-side overhead bar, plus the fleet scoreboard snapshot.
+
+Produces the committed ``FLEET_rNN.json`` artifact (folded into
+``BENCH_TREND.json`` by tools/bench_trend.py):
+
+- **Export overhead** (the acceptance bar, ≤ 1.02 — the PR-7 node-side
+  scope budget): realtime-stream TTFB p50 *directly against one
+  backend* with an external scraper hammering its
+  ``/debug/scope/export`` at 2 Hz (2.5–10× the default fleet cadence,
+  so the measurement is conservative) vs. the same backend unscraped,
+  arms interleaved per run.  Per the r11/r12 convention on this 2-vCPU
+  host, absolute TTFBs are noisy; the ratio of interleaved medians is
+  the committed number.
+- **Scrape cost** (deterministic): p50 wall time and payload size of a
+  ``/debug/scope/export`` GET against the traffic-fed node — what each
+  node pays per fleet cadence tick.
+- **Fleet scoreboard**: the router's ``/debug/fleet`` after the
+  traffic mix — nodes reporting, merged e2e quantile count, scrape
+  counters — recorded so the artifact pins that aggregation actually
+  populated during the run.
+
+Backends boot via ``tools/serving_smoke.py --mesh-node-boot`` (the same
+pinned-port node boot the CI mesh phase and bench_mesh use), sharing
+one ``SONATA_JAX_CACHE_DIR`` so boots after the first are warm.
+
+Run: ``JAX_PLATFORMS=cpu python tools/bench_fleet.py --out FLEET_r01.json``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("SONATA_WARMUP_LATTICE", "off")
+# a fast fleet cadence so the scoreboard populates inside the bench
+os.environ.setdefault("SONATA_FLEET_SCRAPE_INTERVAL_S", "1")
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tests"))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+SMOKE = Path(__file__).resolve().parent / "serving_smoke.py"
+
+from serving_smoke import free_port, http_get, wait_readyz  # noqa: E402
+
+TEXT = ("A first sentence for the benchmark stream. "
+        "A second sentence keeps it streaming.")
+RUNS_PER_ARM = 10
+STREAMS_PER_RUN = 3
+SCRAPER_PERIOD_S = 0.5
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=None,
+                    help="write the artifact here (e.g. FLEET_r01.json);"
+                         " omitted = print only")
+    ap.add_argument("--runs", type=int, default=RUNS_PER_ARM)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import grpc
+
+    from sonata_tpu.frontends import grpc_messages as pb
+    from sonata_tpu.frontends.mesh_server import create_mesh_server
+    from voices import write_tiny_voice
+
+    cfg = str(write_tiny_voice(Path(tempfile.mkdtemp(prefix="fleet_bench"))))
+    cache = tempfile.mkdtemp(prefix="fleet_bench_cache")
+    ports = [(free_port(), free_port()) for _ in range(2)]
+    logs = [open(os.path.join(cache, f"node{i}.log"), "w")
+            for i in range(2)]
+
+    def boot(i: int) -> subprocess.Popen:
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   SMOKE_VOICE_CFG=cfg, SONATA_JAX_CACHE_DIR=cache,
+                   MESH_NODE_GRPC_PORT=str(ports[i][0]),
+                   MESH_NODE_METRICS_PORT=str(ports[i][1]))
+        return subprocess.Popen(
+            [sys.executable, str(SMOKE), "--mesh-node-boot"],
+            env=env, stdout=logs[i], stderr=logs[i])
+
+    print("fleet-bench: booting 2 backend nodes...")
+    procs = [boot(0), boot(1)]
+    for i in range(2):
+        if not wait_readyz(ports[i][1], 300.0):
+            raise RuntimeError(f"backend {i} never became ready")
+
+    specs = [f"127.0.0.1:{g}/{m}" for g, m in ports]
+    mesh_server, mesh_port = create_mesh_server(
+        0, backends=specs, metrics_port=0, request_timeout_s=120.0)
+    mesh_server.start()
+    mesh_base = \
+        f"http://127.0.0.1:{mesh_server.sonata_runtime.http_port}"
+    node0_base = f"http://127.0.0.1:{ports[0][1]}"
+    print(f"fleet-bench: router on :{mesh_port} over {specs}")
+
+    def realtime(port: int):
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        return channel, channel.unary_stream(
+            "/sonata_grpc.sonata_grpc/SynthesizeUtteranceRealtime",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=pb.WaveSamples.decode)
+
+    direct_channel, direct_rpc = realtime(ports[0][0])
+    mesh_channel, mesh_rpc = realtime(mesh_port)
+    ch = grpc.insecure_channel(f"127.0.0.1:{ports[0][0]}")
+    voices = ch.unary_unary(
+        "/sonata_grpc.sonata_grpc/ListVoices",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.VoiceList.decode)(pb.Empty())
+    voice_id = voices.voices[0].voice_id
+    ch.close()
+
+    def stream_once(rpc) -> float:
+        t0 = time.monotonic()
+        for chunk in rpc(pb.Utterance(voice_id=voice_id, text=TEXT),
+                         timeout=120.0):
+            if len(chunk.wav_samples) > 0:
+                return time.monotonic() - t0
+        raise RuntimeError("stream produced no audio")
+
+    # traffic through the router so the fleet plane has data to merge
+    for _ in range(6):
+        stream_once(mesh_rpc)
+
+    # ---- export overhead A/B (direct to node 0, scraper on/off) ----
+    stop_scraper = threading.Event()
+
+    def scraper() -> None:
+        while not stop_scraper.wait(SCRAPER_PERIOD_S):
+            try:
+                http_get(node0_base + "/debug/scope/export")
+            except Exception:
+                pass
+
+    stream_once(direct_rpc)  # settle lap
+    ttfbs = {"baseline": [], "scraped": []}
+    for _run in range(args.runs):
+        # interleaved arms: host noise hits both alike
+        for _ in range(STREAMS_PER_RUN):
+            ttfbs["baseline"].append(stream_once(direct_rpc))
+        stop_scraper.clear()
+        t = threading.Thread(target=scraper, daemon=True)
+        t.start()
+        try:
+            for _ in range(STREAMS_PER_RUN):
+                ttfbs["scraped"].append(stream_once(direct_rpc))
+        finally:
+            stop_scraper.set()
+            t.join(timeout=5.0)
+    p50 = {arm: statistics.median(v) for arm, v in ttfbs.items()}
+    overhead = p50["scraped"] / p50["baseline"]
+    print(f"fleet-bench: TTFB p50 baseline {p50['baseline'] * 1e3:.1f} "
+          f"ms, export-scraped {p50['scraped'] * 1e3:.1f} ms, "
+          f"overhead ratio {overhead:.4f}")
+
+    # ---- scrape cost (deterministic) ----
+    costs, size = [], 0
+    for _ in range(20):
+        t0 = time.monotonic()
+        code, body = http_get(node0_base + "/debug/scope/export")
+        costs.append(time.monotonic() - t0)
+        assert code == 200, f"export answered {code}"
+        size = len(body)
+    scrape_p50_ms = statistics.median(costs) * 1e3
+    print(f"fleet-bench: /debug/scope/export p50 {scrape_p50_ms:.2f} ms, "
+          f"{size} bytes")
+
+    # ---- fleet scoreboard ----
+    fdoc = {}
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        code, body = http_get(mesh_base + "/debug/fleet")
+        fdoc = json.loads(body) if code == 200 else {}
+        if fdoc.get("fleet", {}).get("nodes_reporting") == 2:
+            break
+        time.sleep(0.5)
+    fleet = fdoc.get("fleet", {})
+    e2e_5m = fleet.get("stage_quantiles", {}).get("e2e", {}).get("5m", {})
+    print(f"fleet-bench: scoreboard: {fleet.get('nodes_reporting')} "
+          f"reporting, e2e 5m count {e2e_5m.get('count')}, "
+          f"p99 {e2e_5m.get('p99')}")
+
+    results = [
+        {"metric": "export_overhead_ratio", "value": round(overhead, 4)},
+        {"metric": "ttfb_p50_baseline_ms",
+         "value": round(p50["baseline"] * 1e3, 2)},
+        {"metric": "ttfb_p50_export_scraped_ms",
+         "value": round(p50["scraped"] * 1e3, 2)},
+        {"metric": "scrape_export_p50_ms",
+         "value": round(scrape_p50_ms, 3)},
+        {"metric": "scrape_export_bytes", "value": size},
+        {"metric": "fleet_nodes_reporting",
+         "value": fleet.get("nodes_reporting", 0)},
+        {"metric": "fleet_e2e_count_5m",
+         "value": e2e_5m.get("count", 0)},
+    ]
+    if isinstance(e2e_5m.get("p99"), (int, float)):
+        results.append({"metric": "fleet_e2e_p99_5m_s",
+                        "value": round(e2e_5m["p99"], 4)})
+
+    mesh_channel.close()
+    direct_channel.close()
+    mesh_server.stop(grace=None)
+    mesh_server.sonata_service.shutdown()
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=60.0)
+        except subprocess.TimeoutExpired:
+            p.kill()
+    for f in logs:
+        f.close()
+
+    artifact = {
+        "bench": "fleet",
+        "host": "ci-cpu",
+        "notes": (
+            "sonata-fleetscope bench: 2 backend subprocesses "
+            "(serving_smoke --mesh-node-boot, shared jax cache) + "
+            "in-process router with a 1 s fleet scrape cadence.  "
+            "export_overhead_ratio is the ISSUE-13 acceptance bar "
+            "(<= 1.02, the PR-7 node-side scope budget): realtime TTFB "
+            "p50 direct against node 0 with an external 2 Hz "
+            "/debug/scope/export scraper vs unscraped, %d interleaved "
+            "runs x %d streams per arm — the scraper runs at 2.5-10x "
+            "the default 5 s fleet cadence, so the committed ratio is "
+            "conservative.  scrape_export_* rows are the deterministic "
+            "per-tick cost each node pays; the fleet_* rows pin that "
+            "the router's /debug/fleet scoreboard actually populated "
+            "from both nodes during the run.  Per the r11/r12 noise "
+            "convention on this 2-vCPU host, absolute TTFB rows are "
+            "supporting evidence only." % (args.runs, STREAMS_PER_RUN)),
+        "configs": {"fleet": {"results": results}},
+    }
+    if args.out:
+        Path(args.out).write_text(
+            json.dumps(artifact, indent=1, sort_keys=True) + "\n",
+            encoding="utf-8")
+        print(f"fleet-bench: wrote {args.out}")
+    ok = (overhead <= 1.02
+          and fleet.get("nodes_reporting") == 2
+          and e2e_5m.get("count", 0) >= 1)
+    print(f"fleet-bench: {'PASS' if ok else 'FAIL'} "
+          f"(export overhead {overhead:.4f} <= 1.02, "
+          f"{fleet.get('nodes_reporting')} nodes reporting)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    rc = main()
+    sys.stdout.flush()
+    os._exit(rc)

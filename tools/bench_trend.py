@@ -7,10 +7,12 @@ streaming TTFB/throughput/overhead, ``BENCH_CPU_rNN`` lowering A/Bs)
 plus the ``WARMUP_rNN.json`` warm-restart artifact (cold/warm
 time-to-ready from the serving smoke's lattice phase — a warmup-cost
 regression is a deploy-latency regression and gets flagged like any
-other) and the ``MESH_rNN.json`` fleet-tier artifact (router-hop TTFB
+other), the ``MESH_rNN.json`` fleet-tier artifact (router-hop TTFB
 overhead + the kill-phase reroute/drop counters from
-tools/bench_mesh.py), but nothing reads them *across* revisions — a
-slow 10% drift
+tools/bench_mesh.py), and the ``FLEET_rNN.json`` fleet-observability
+artifact (scope-export scrape cost + the node-side export-enabled
+overhead ratio from tools/bench_fleet.py), but nothing reads them
+*across* revisions — a slow 10% drift
 per PR is invisible until someone diffs artifacts by hand.  This tool:
 
 1. parses every ``BENCH*_r*.json`` / ``WARMUP_r*.json`` at the repo
@@ -40,7 +42,7 @@ REPO = Path(__file__).resolve().parent.parent
 TREND_PATH = REPO / "BENCH_TREND.json"
 REGRESSION_THRESHOLD = 0.20
 
-_REV_RE = re.compile(r"^((?:BENCH|WARMUP|MESH)[A-Z_]*)_r(\d+)\.json$")
+_REV_RE = re.compile(r"^((?:BENCH|WARMUP|MESH|FLEET)[A-Z_]*)_r(\d+)\.json$")
 
 #: metric-name fragments → comparison direction
 _LOWER_IS_BETTER = ("ttfb", "rtf", "overhead", "latency", "wall",
@@ -95,7 +97,8 @@ def collect() -> Dict[str, Dict]:
     families: Dict[str, Dict] = {}
     paths = sorted(list(REPO.glob("BENCH*_r*.json"))
                    + list(REPO.glob("WARMUP_r*.json"))
-                   + list(REPO.glob("MESH_r*.json")))
+                   + list(REPO.glob("MESH_r*.json"))
+                   + list(REPO.glob("FLEET_r*.json")))
     for path in paths:
         m = _REV_RE.match(path.name)
         if m is None:

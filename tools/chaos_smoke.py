@@ -107,6 +107,13 @@ os.environ["SONATA_DEGRADE_RECOVER_S"] = "8"
 # ladder reaching level >= 2 in phase F each ship the preceding minutes
 TIMELINE_DIR = tempfile.mkdtemp(prefix="chaos_timeline")
 os.environ["SONATA_TIMELINE_DUMP_DIR"] = TIMELINE_DIR
+# fleet flight recorder (serving/fleetscope.py, ISSUE 13): phase M's
+# breaker trip must auto-dump the FLEET timeline too — its own dir so
+# the two recorders' dumps can't be confused, and a 1 s scrape cadence
+# so the router's fleet plane populates inside the phase
+FLEET_DIR = tempfile.mkdtemp(prefix="chaos_fleet")
+os.environ["SONATA_FLEET_DUMP_DIR"] = FLEET_DIR
+os.environ["SONATA_FLEET_SCRAPE_INTERVAL_S"] = "1"
 # the smoke drives its own bucket prewarm (below); the lattice warmup
 # would re-compile dozens of shapes per replica per warmup call here
 os.environ.setdefault("SONATA_WARMUP_LATTICE", "off")
@@ -661,6 +668,30 @@ def main() -> int:
     code, _ = http_get(mbase + "/readyz")
     check("mesh: router readyz 503 at zero routable nodes", code == 503,
           f"(code {code})")
+    # fleet flight recorder (ISSUE 13): the breaker trip above is an
+    # incident — the router's 1 Hz fleet recorder must auto-dump the
+    # preceding snapshots without being asked
+    fleet_dumps: list = []
+    deadline = time.monotonic() + 8.0
+    while time.monotonic() < deadline and not fleet_dumps:
+        fleet_dumps = sorted(f for f in os.listdir(FLEET_DIR)
+                             if "breaker-trip" in f)
+        time.sleep(0.2)
+    check("mesh: fleet recorder auto-dumped on the breaker trip",
+          bool(fleet_dumps), f"({os.listdir(FLEET_DIR)})")
+    if fleet_dumps:
+        with open(os.path.join(FLEET_DIR, fleet_dumps[-1]),
+                  encoding="utf-8") as f:
+            fdump = json.load(f)
+        fsnaps = fdump.get("snapshots", [])
+        check("mesh: fleet dump shows the node out of membership",
+              bool(fsnaps) and (fsnaps[-1].get("routable") == 0
+                               or any(n.get("state") == "open"
+                                      for n in fsnaps[-1]
+                                      .get("nodes", {}).values())),
+              f"({fsnaps[-1] if fsnaps else None})")
+    check("mesh: router /debug/fleet scoreboard is served",
+          http_get(mbase + "/debug/fleet")[0] == 200)
     disarm_all()
 
     # mesh.health:hang — two probe cycles hang (1.2 s cap, then typed

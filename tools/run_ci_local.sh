@@ -23,7 +23,11 @@
 #      backend subprocesses + 1 sonata-mesh router — SIGTERM drain and
 #      SIGKILL under concurrent streams lose zero not-yet-streaming
 #      requests, router /readyz tracks healthy-node count, and a
-#      restarted backend rejoins with no router restart
+#      restarted backend rejoins with no router restart; the mesh
+#      phase also asserts the fleetscope plane (ISSUE 13): /debug/fleet
+#      populated from both backend subprocesses, sonata_fleet_* series
+#      in the router's /metrics after traffic, and one stitched trace
+#      carrying router and node spans under one request id
 #      (tools/serving_smoke.py)
 #   5. "Multi-device lane" — test_replicas on a forced 4-device CPU
 #      host (the replica-pool acceptance shape), plus test_parallel on

@@ -787,6 +787,9 @@ def main(args=None) -> int:
     check("mesh: backend node 1 boots ready", wait_node_ready(1))
 
     specs = [f"127.0.0.1:{g}/{m}" for g, m in node_ports]
+    # fleetscope (ISSUE 13): a 1 s scrape cadence so the fleet checks
+    # below populate within the smoke's budget (read at router build)
+    os.environ["SONATA_FLEET_SCRAPE_INTERVAL_S"] = "1"
     mesh_server_obj, mesh_port = create_mesh_server(
         0, backends=specs, metrics_port=0, request_timeout_s=60.0)
     mesh_server_obj.start()
@@ -826,6 +829,95 @@ def main(args=None) -> int:
     check("mesh: responses name the serving node in trailing metadata",
           served_nodes and None not in served_nodes,
           f"({served_nodes})")
+
+    # ---- fleetscope (ISSUE 13): fleet scoreboard, fleet metrics, and
+    # one stitched cross-process trace ----
+    expected_node_ids = {f"127.0.0.1:{g}" for g, _m in node_ports}
+    fdoc: dict = {}
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        code, body = http_get(mesh_base + "/debug/fleet")
+        fdoc = json.loads(body) if code == 200 else {}
+        if fdoc.get("fleet", {}).get("nodes_reporting") == 2 and \
+                fdoc["fleet"]["stage_quantiles"]["e2e"]["5m"][
+                    "count"] >= 1:
+            break
+        time.sleep(0.5)
+    check("fleet: /debug/fleet populated from both backend "
+          "subprocesses",
+          fdoc.get("fleet", {}).get("nodes_reporting") == 2,
+          f"({fdoc.get('fleet', {}).get('nodes_reporting')} reporting)")
+    check("fleet: merged stage quantiles carry the traffic mix",
+          fdoc.get("fleet", {}).get("stage_quantiles", {})
+              .get("e2e", {}).get("5m", {}).get("count", 0) >= 1)
+    reporting_ids = {n.get("node_id") for n in fdoc.get("nodes", [])
+                     if n.get("reporting")}
+    check("fleet: scoreboard names both node ids",
+          reporting_ids == expected_node_ids,
+          f"({reporting_ids} vs {expected_node_ids})")
+    reporting_rows = [n for n in fdoc.get("nodes", [])
+                      if n.get("reporting")]
+    check("fleet: scoreboard rows carry scrape staleness and burn",
+          bool(reporting_rows)
+          and all({"export_age_s", "burn", "delta_p99_5m"} <= set(n)
+                  for n in reporting_rows))
+    slo_rows = fdoc.get("fleet", {}).get("slo", [])
+    check("fleet: SLO table present with fast/slow burn windows",
+          bool(slo_rows)
+          and all(set(s.get("burn_rate", {})) == {"5m", "1h"}
+                  for s in slo_rows))
+    parsed = parse_prometheus_text(http_get(mesh_base + "/metrics")[1])
+    fq = parsed.get("sonata_fleet_stage_quantile", [])
+    check("fleet: sonata_fleet_stage_quantile series in router "
+          "/metrics after traffic",
+          any(lbl.get("stage") == "e2e" for lbl, _v in fq),
+          f"({len(fq)} series)")
+    fb = parsed.get("sonata_fleet_slo_burn_rate", [])
+    check("fleet: sonata_fleet_slo_burn_rate series in router /metrics",
+          bool(fb) and {lbl.get("window") for lbl, _v in fb} <= \
+          {"5m", "1h"}, f"({len(fb)} series)")
+    ages = parsed.get("sonata_mesh_node_scrape_age_seconds", [])
+    check("fleet: sonata_mesh_node_scrape_age_seconds labeled per "
+          "node_id",
+          {lbl.get("node_id") for lbl, _v in ages} == expected_node_ids,
+          f"({[lbl for lbl, _v in ages]})")
+    check("fleet: scrape ages are fresh (inside the 1 s cadence x 5)",
+          ages and all(v < 5.0 for _lbl, v in ages),
+          f"({[v for _lbl, v in ages]})")
+    # one stitched trace: router spans + serving-node spans under one
+    # request id, re-based onto the router's clock (the Perfetto bar)
+    stitched_ok, stitch_doc = False, {}
+    call = mesh_synth(pb.Utterance(voice_id=voice_id,
+                                   text="Stitch this trace."),
+                      timeout=60.0,
+                      metadata=(("x-request-id", "mesh-stitch-1"),))
+    list(call)
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and not stitched_ok:
+        code, body = http_get(
+            mesh_base + "/debug/traces/stitched?id=mesh-stitch-1")
+        stitch_doc = json.loads(body) if code == 200 else {}
+        stitched_ok = stitch_doc.get("stitched", {}).get(
+            "node_spans", 0) > 0
+        if not stitched_ok:
+            time.sleep(0.5)
+    xs = [e for e in stitch_doc.get("traceEvents", [])
+          if e.get("ph") == "X"]
+    router_names = {e["name"] for e in xs if e.get("pid") == 1}
+    node_names = {e["name"] for e in xs if e.get("pid") == 2}
+    check("fleet: stitched trace carries the router span tree",
+          {"admission", "mesh-dispatch", "stream-emit"} <= router_names,
+          f"({sorted(router_names)})")
+    check("fleet: stitched trace splices the serving node's spans",
+          {"dispatch", "stream-emit"} & node_names,
+          f"({sorted(node_names)})")
+    check("fleet: every stitched span shares the one request id",
+          bool(xs) and all(e.get("args", {}).get("request_id")
+                           == "mesh-stitch-1" for e in xs))
+    check("fleet: stitched doc names the serving node",
+          stitch_doc.get("stitched", {}).get("node")
+          in expected_node_ids,
+          f"({stitch_doc.get('stitched')})")
 
     stream_text = ("A first sentence for the in-flight stream. "
                    "A second sentence keeps it streaming. "
