@@ -23,11 +23,16 @@ Design points specific to the hop:
   carries the same id, so one Perfetto load of both ``/debug/traces``
   shows router queue → node dispatch end to end.
 - **Unary surface**: voice management (``LoadVoice`` / ``UnloadVoice``
-  / ``SetSynthesisOptions``) fans out to every reachable node (a fleet
-  where only one node holds the voice would break routing); lookups
+  / ``SetSynthesisOptions``) records *desired state* in the placement
+  plane (ISSUE 14) and applies it to the voice's assigned nodes
+  (``SONATA_PLACEMENT_REPLICAS``, default all); the anti-entropy
+  reconciler riding the membership probers replays missed ops to
+  nodes that were down, breaker-open, or restarted later — one
+  reachable node is enough for the RPC to succeed.  Lookups
   (``GetVoiceInfo`` / ``GetSynthesisOptions`` / ``ListVoices``) forward
-  to any routable node; ``CheckHealth`` / ``GetSonataVersion`` answer
-  for the router itself.
+  to a routable node (preferring converged holders of the requested
+  voice); ``CheckHealth`` / ``GetSonataVersion`` answer for the router
+  itself.
 - **The router drains like a node**: SIGTERM runs the same pinned
   ``DRAIN_PHASES`` order (readiness off first, typed refusals, bounded
   in-flight wait) — the "voices" phase closes mesh membership probing
@@ -67,9 +72,10 @@ from ..serving import (
 from ..serving.fleetscope import FleetScope
 from ..serving.logs import configure_logging
 from ..serving.mesh import MeshRouter, parse_backends, resolve_node_id
+from ..serving.placement import PlacementPlane, VoiceWarming
 from ..serving.replicas import OPEN
 from . import grpc_messages as pb
-from .grpc_server import _METHODS, _SERVICE_PATH, _status_for
+from .grpc_server import _METHODS, _SERVICE_PATH, _status_for, voice_id_for
 
 log = logging.getLogger("sonata.mesh")
 
@@ -170,6 +176,20 @@ class SonataMeshService:
         rt.health.set_ready(
             f"mesh router over {len(router.nodes)} node(s)")
         self._register_metrics()
+        #: sonata-placement (ISSUE 14): the desired-state voice
+        #: registry + anti-entropy reconciler.  Voice ops through this
+        #: router are recorded and REPLAYED — a SIGKILLed-and-restarted
+        #: backend rejoins and gets its voices back with no operator
+        #: action; routing is voice-aware (converged holders only, a
+        #: typed voice-warming refusal after the bounded wait).  The
+        #: reconcile loop rides the router's per-node prober threads.
+        self.placement = PlacementPlane(
+            router,
+            apply_load=self._apply_load,
+            apply_unload=self._apply_unload,
+            apply_options=self._apply_options)
+        router.attach_placement(self.placement)
+        self.placement.bind_metrics(rt.registry)
         #: sonata-fleetscope (ISSUE 13): fleet-merged quantiles/burn,
         #: the /debug/fleet scoreboard, stitched traces, and the fleet
         #: flight recorder — scraping rides the router's probers
@@ -178,6 +198,29 @@ class SonataMeshService:
         self.fleet.bind_metrics(rt.registry)
         rt.fleet = self.fleet  # the HTTP plane serves /debug/fleet
         self.fleet.start()
+
+    # -- placement replay transport (the plane's apply_* callables) ----------
+    def _apply_load(self, node, config_path: str):
+        return self._call_unary(
+            node, "LoadVoice", pb.VoicePath(config_path=config_path),
+            pb.VoiceInfo, 600.0)  # a replayed load may compile cold
+
+    def _apply_unload(self, node, voice_id: str) -> None:
+        try:
+            self._call_unary(node, "UnloadVoice",
+                             pb.VoiceIdentifier(voice_id=voice_id),
+                             pb.Empty, 60.0)
+        except grpc.RpcError as e:
+            code = getattr(e, "code", None)
+            code = code() if callable(code) else None
+            if code != grpc.StatusCode.NOT_FOUND:
+                raise  # already gone there == retired
+
+    def _apply_options(self, node, payload: bytes):
+        return self._call_unary(
+            node, "SetSynthesisOptions",
+            pb.VoiceSynthesisOptions.decode(payload),
+            pb.SynthesisOptions, 30.0)
 
     def _register_metrics(self) -> None:
         r = self.runtime.registry
@@ -243,15 +286,22 @@ class SonataMeshService:
             response_deserializer=resp_cls.decode)
         return fn(request, timeout=timeout_s)
 
-    def _routable_node(self, context):
-        node = next((n for n in self.router.nodes
-                     if n.state != OPEN and n.ready and not n.draining),
-                    None)
-        if node is None:
+    def _routable_node(self, context, voice_id: Optional[str] = None):
+        nodes = [n for n in self.router.nodes
+                 if n.state != OPEN and n.ready and not n.draining]
+        if voice_id and self.placement.has_voice(voice_id):
+            # voice-aware lookup forwarding: prefer a converged holder
+            # so GetVoiceInfo does not 404 off a not-yet-reconciled node
+            holders = [n for n in nodes
+                       if n.loaded_voices is None
+                       or voice_id in n.loaded_voices]
+            if holders:
+                nodes = holders
+        if not nodes:
             context.abort(grpc.StatusCode.UNAVAILABLE,
                           f"mesh {self.router.name!r}: no routable "
                           "backend node")
-        return node
+        return nodes[0]
 
     # -- unary RPCs -----------------------------------------------------------
     def GetSonataVersion(self, request: pb.Empty, context) -> pb.Version:
@@ -289,23 +339,141 @@ class SonataMeshService:
         return last
 
     def LoadVoice(self, request: pb.VoicePath, context) -> pb.VoiceInfo:
-        # generous bound: each node's load may compile cold executables
-        return self._fanout("LoadVoice", request, pb.VoiceInfo, context,
-                            timeout_s=600.0)
+        """Record the voice as desired state, then load it onto its
+        placement (``SONATA_PLACEMENT_REPLICAS`` nodes, default all).
+
+        Unlike the PR-12 best-effort fan-out, one reachable node
+        suffices for success — the anti-entropy reconciler replays the
+        load to every other assigned node (including ones that are
+        down, breaker-open, or restarted *later*), which is what closes
+        the rejoins-without-voices gap.  Zero successes rolls the
+        desired record back and fails typed."""
+        if not request.config_path:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "config_path is required")
+        self.runtime.drain.raise_if_draining()
+        vid = voice_id_for(request.config_path)
+        created = self.placement.record_load(vid, request.config_path)
+        info, last_err = None, None
+        for node in self.placement.assigned_nodes(vid):
+            if node.state == OPEN or node.draining:
+                continue  # the reconciler replays once it rejoins
+            try:
+                info = self._call_unary(node, "LoadVoice", request,
+                                        pb.VoiceInfo, 600.0)
+                self.placement.note_applied(node, vid)
+                self.router.note_voice_loaded(node, vid)
+            except grpc.RpcError as e:
+                last_err = (node, e)
+                log.warning("mesh %s: LoadVoice on node %s failed "
+                            "(reconciler will replay): %s", self.router.name,
+                            node.node_id, e)
+        if info is None:
+            if created:
+                # the op reached nobody: no ghost desired state
+                self.placement.forget_load(vid)
+            if last_err is not None:
+                node, e = last_err
+                context.abort(
+                    e.code() if callable(getattr(e, "code", None))
+                    and e.code() is not None else grpc.StatusCode.UNKNOWN,
+                    f"node {node.node_id}: {e.details() or ''}")
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          f"mesh {self.router.name!r}: no reachable "
+                          "backend node to load the voice on")
+        return info
 
     def UnloadVoice(self, request: pb.VoiceIdentifier,
                     context) -> pb.Empty:
-        return self._fanout("UnloadVoice", request, pb.Empty, context,
-                            timeout_s=60.0)
+        """Tombstone the voice (nothing ever resurrects it) and unload
+        it from every reachable node; nodes that are down now are
+        retired by the reconciler when they rejoin."""
+        self.runtime.drain.raise_if_draining()
+        vid = request.voice_id
+        known = self.placement.record_unload(vid)
+        found = False
+        for node in self.router.nodes:
+            if node.state == OPEN or node.draining:
+                continue
+            if (node.loaded_voices is not None
+                    and vid not in node.loaded_voices and known):
+                continue  # known-absent there: nothing to do
+            try:
+                self._call_unary(node, "UnloadVoice", request, pb.Empty,
+                                 60.0)
+                found = True
+                self.router.note_voice_unloaded(node, vid)
+            except grpc.RpcError as e:
+                code = getattr(e, "code", None)
+                code = code() if callable(code) else None
+                if code == grpc.StatusCode.NOT_FOUND:
+                    continue
+                if not known:
+                    context.abort(code or grpc.StatusCode.UNKNOWN,
+                                  f"node {node.node_id}: "
+                                  f"{e.details() or ''}")
+                log.warning("mesh %s: UnloadVoice on node %s failed "
+                            "(reconciler will retire): %s",
+                            self.router.name, node.node_id, e)
+        if not found and not known:
+            # the unload found the voice NOWHERE and the registry never
+            # knew it: roll the tombstone back out, or a node later
+            # boot-loading this id would be silently retired by an op
+            # the client was told failed
+            self.placement.forget_unload(vid)
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"no voice with id {vid}")
+        return pb.Empty()
 
     def SetSynthesisOptions(self, request: pb.VoiceSynthesisOptions,
                             context) -> pb.SynthesisOptions:
-        return self._fanout("SetSynthesisOptions", request,
-                            pb.SynthesisOptions, context, timeout_s=30.0)
+        """Apply the options to every current holder, then record the
+        payload as desired state (replayed verbatim to late joiners by
+        the reconciler).  Apply-before-record: an RPC that reaches no
+        holder aborts typed with NOTHING recorded — the registry must
+        never hold options the client was told failed.  Voices the
+        registry has never seen — node boot-config voices — keep the
+        PR-12 fan-out path."""
+        vid = request.voice_id
+        if not self.placement.has_voice(vid):
+            return self._fanout("SetSynthesisOptions", request,
+                                pb.SynthesisOptions, context,
+                                timeout_s=30.0)
+        self.runtime.drain.raise_if_draining()
+        last, last_err = None, None
+        applied_nodes = []
+        for node in self.placement.assigned_nodes(vid):
+            if node.state == OPEN or node.draining:
+                continue
+            if (node.loaded_voices is not None
+                    and vid not in node.loaded_voices):
+                continue  # not resident yet: the load replay carries it
+            try:
+                last = self._call_unary(node, "SetSynthesisOptions",
+                                        request, pb.SynthesisOptions,
+                                        30.0)
+                applied_nodes.append(node)
+            except grpc.RpcError as e:
+                last_err = (node, e)
+        if last is None:
+            if last_err is not None:
+                node, e = last_err
+                context.abort(
+                    e.code() if callable(getattr(e, "code", None))
+                    and e.code() is not None else grpc.StatusCode.UNKNOWN,
+                    f"node {node.node_id}: {e.details() or ''}")
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          f"mesh {self.router.name!r}: no reachable "
+                          f"holder of voice {vid}")
+        self.placement.record_options(vid, request.encode())
+        for node in applied_nodes:
+            self.placement.note_applied(node, vid)
+        return last
 
     def _forward_one(self, name: str, request, resp_cls, context,
                      timeout_s: float = 15.0):
-        node = self._routable_node(context)
+        node = self._routable_node(
+            context, getattr(request, "voice_id", None))
         try:
             return self._call_unary(node, name, request, resp_cls,
                                     timeout_s)
@@ -385,7 +553,8 @@ class SonataMeshService:
                         for chunk in self.router.route_stream(
                                 start, deadline=deadline,
                                 request_id=rid,
-                                classify=_classify_rpc_error):
+                                classify=_classify_rpc_error,
+                                voice=request.voice_id or None):
                             n_chunks += 1
                             if first:
                                 first = False
@@ -409,6 +578,12 @@ class SonataMeshService:
                                          served[0].node_id),))
                             except Exception:
                                 pass
+        except VoiceWarming as e:
+            # typed like a draining refusal (UNAVAILABLE, retryable):
+            # the voice is desired but no holder has converged inside
+            # the bounded placement wait — a reconcile is in flight
+            self._abort(context, name, grpc.StatusCode.UNAVAILABLE,
+                        str(e))
         except Overloaded as e:
             rt.shed.labels(source="mesh").inc()
             self._abort(context, name, _status_for(e), str(e))
@@ -452,6 +627,7 @@ class SonataMeshService:
                      stragglers=rt.admission.in_flight)
         self.router.close()
         self.fleet.close()
+        self.placement.close()
         self.unregister_node_series()
         d.note_phase("voices", closed=len(self.router.nodes))
         rt.close()
@@ -466,6 +642,7 @@ class SonataMeshService:
         self.runtime.health.set_not_ready("shutting down")
         self.router.close()
         self.fleet.close()
+        self.placement.close()
         self.unregister_node_series()
         with self._chan_lock:
             channels = list(self._channels.values())

@@ -54,6 +54,7 @@ from .metrics import (
     resolve_metrics_port,
     start_http_server,
 )
+from .placement import PlacementPlane, VoiceWarming
 from .replicas import ReplicaPool, resolve_replica_count
 from .scope import Scope
 from .tracing import Trace, Tracer
@@ -76,9 +77,11 @@ __all__ = [
     "parse_prometheus_text",
     "resolve_metrics_port",
     "start_http_server",
+    "PlacementPlane",
     "ReplicaPool",
     "resolve_replica_count",
     "Scope",
+    "VoiceWarming",
     "scope_mod",
     "ServingRuntime",
     "Trace",
@@ -306,6 +309,16 @@ class ServingRuntime:
         def voice_gauge(name, help, fn):
             labeled_gauge(name, help, fn, lbl)
 
+        # actual-state signal for the fleet tier (ISSUE 14): the
+        # sonata-mesh placement reconciler scrapes this gauge (and the
+        # /readyz ``voices=`` twin maintained on the health plane) to
+        # diff a node's resident voices against its desired state
+        self.health.note_voice(voice_id)
+        voice_gauge("sonata_voice_loaded",
+                    "1 while this voice is loaded and serving on this "
+                    "node (the actual-state signal the sonata-mesh "
+                    "placement reconciler diffs against desired state).",
+                    lambda: 1.0)
         if rtf_counter is not None:
             def stat(attr):
                 return lambda: float(getattr(rtf_counter.snapshot(), attr))
@@ -479,6 +492,7 @@ class ServingRuntime:
         (metric, labels) pairs register_voice created (recorded per
         voice, so the two methods cannot drift apart), releasing the
         closures that would otherwise pin the unloaded voice's objects."""
+        self.health.drop_voice(voice_id)
         for metric, labels in self._voice_series.pop(voice_id, []):
             metric.remove(**labels)
         for probe in self._voice_probes.pop(voice_id, []):

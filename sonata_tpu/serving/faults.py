@@ -26,7 +26,11 @@ exercised by at least one test):
   breaker exactly like a real one);
 - ``mesh.health``         — inside every mesh membership health probe
   (how the chaos lane kills/wedges/partitions a whole node
-  deterministically without owning real processes).
+  deterministically without owning real processes);
+- ``mesh.reconcile``      — inside every voice-placement reconcile cycle
+  (an injected error counts toward that node's breaker on its own
+  consecutive reconcile-failure counter — separate, so probe successes
+  cannot launder it; a hang stalls only that node's prober thread).
 
 Modes:
 
@@ -88,6 +92,7 @@ SITES = (
     "metrics.scrape",
     "mesh.route",
     "mesh.health",
+    "mesh.reconcile",
 )
 
 MODES = ("error", "hang", "slow", "corrupt-shape")

@@ -613,12 +613,19 @@ class FleetScope:
             "budget_remaining": _round6(
                 self.fleet_budget_remaining(spec.name))}
             for spec in self.slos]
+        # per-node voice-placement table (ISSUE 14): desired vs
+        # converged holders, budgets, tombstones — served here so one
+        # /debug/fleet load answers "where do this fleet's voices live"
+        plane = getattr(self.router, "placement", None)
+        placement = (plane.placement_view() if plane is not None
+                     else None)
         return {
             "name": view["name"],
             "routable": view["routable"],
             "router_stats": view["stats"],
             "scrape": {"interval_s": self.scrape_interval_s,
                        "stale_s": self.stale_s, **stats},
+            "placement": placement,
             "nodes": nodes_out,
             "fleet": {
                 "nodes_reporting": len(by_index),

@@ -40,6 +40,13 @@ class HealthState:
         #: address; surfaced on /readyz and CheckHealth so fleet-side
         #: logs name this process instead of an opaque channel
         self.node_id: Optional[str] = None
+        #: loaded-voice ids (maintained by ServingRuntime.register_voice
+        #: / unregister_voice), surfaced as the ``voices=`` line on
+        #: /readyz — the ACTUAL-state signal the sonata-mesh placement
+        #: reconciler diffs against its desired state.  Present even
+        #: when empty: an explicit empty set ("this node holds no
+        #: voices") is exactly the news a restarted node must deliver.
+        self._voice_ids: set = set()
         #: named predicates evaluated at every readiness read: the
         #: process is ready only when the event is set AND every gate
         #: holds.  This is how live conditions (e.g. "this voice's
@@ -118,6 +125,21 @@ class HealthState:
         with self._lock:
             self._reason = reason
         self._ready.clear()
+
+    # -- loaded voices (the placement reconciler's actual state) -------------
+    def note_voice(self, voice_id: str) -> None:
+        with self._lock:
+            self._voice_ids.add(voice_id)
+
+    def drop_voice(self, voice_id: str) -> None:
+        with self._lock:
+            self._voice_ids.discard(voice_id)
+
+    def voices_view(self) -> list:
+        """Sorted loaded-voice ids (what /readyz renders as
+        ``voices=``)."""
+        with self._lock:
+            return sorted(self._voice_ids)
 
     def wait_ready(self, timeout: Optional[float] = None) -> bool:
         return self._ready.wait(timeout)

@@ -59,7 +59,8 @@ Pieces, in dependency order:
 
 Failpoint sites: ``mesh.route`` fires inside every per-node dispatch
 attempt (an injected error counts toward that node's breaker exactly
-like a real one) and ``mesh.health`` fires inside every probe cycle —
+like a real one), ``mesh.health`` fires inside every probe cycle, and
+``mesh.reconcile`` fires inside every voice-placement reconcile cycle —
 so the chaos lane can kill, wedge, or partition a node deterministically
 without owning real processes.
 
@@ -72,6 +73,15 @@ The router only holds the bookkeeping: ``scope_scrape_at`` /
 staleness budget exceeded) making the node **unroutable** — a node
 whose observability plane is wedged must not keep looking healthy just
 because the last good scrape said so.
+
+The voice-placement plane (ISSUE 14,
+:class:`~sonata_tpu.serving.placement.PlacementPlane`) rides the same
+probers: each health cycle scrapes the node's *actual* loaded-voice
+set (the ``voices=`` line on ``/readyz``, falling back to the
+``sonata_voice_loaded`` gauge) and drives one reconcile cycle per
+``SONATA_PLACEMENT_RECONCILE_INTERVAL_S``; the router holds the
+per-node actual set, the per-(node, voice) outstanding counts, and the
+voice-aware restriction in :meth:`MeshRouter.pick`.
 """
 
 from __future__ import annotations
@@ -92,6 +102,7 @@ from .admission import Overloaded
 from .deadlines import Deadline
 from .drain import Draining
 from .metrics import parse_prometheus_text
+from .placement import VoiceWarming
 from .replicas import CLOSED, HALF_OPEN, OPEN, _STATE_NAMES, _env_float, _env_int
 
 log = logging.getLogger("sonata.serving")
@@ -132,7 +143,8 @@ class _HedgeCancelled(Exception):
 #: the metric families the membership prober actually reads (scrape
 #: lines are pre-filtered to these before parsing)
 _SCRAPE_FAMILIES = ("sonata_draining", "sonata_replica_outstanding",
-                    "sonata_in_flight", "sonata_node_info")
+                    "sonata_in_flight", "sonata_node_info",
+                    "sonata_voice_loaded")
 
 
 def resolve_node_id(default: str) -> str:
@@ -233,6 +245,13 @@ class MeshNode:
         #: docstring on why they never launder each other
         self.consecutive_failures = 0
         self.consecutive_probe_failures = 0
+        #: consecutive placement-reconcile failures — a THIRD separate
+        #: counter, for the same reason probes and routes have their
+        #: own: probes run every 0.5 s and reconciles every 2 s, so a
+        #: shared counter would let each probe success launder the
+        #: reconcile failures accumulated between cycles and a node
+        #: whose control plane can never be reconciled would never trip
+        self.consecutive_reconcile_failures = 0
         self.outstanding = 0            # router-side in-flight
         self.reported_outstanding = 0.0  # scraped backend occupancy
         self.routed = 0
@@ -248,6 +267,15 @@ class MeshNode:
         #: means unroutable (see the module docstring)
         self.scope_scrape_at: Optional[float] = None
         self.scope_stale = False
+        #: voice placement (ISSUE 14): the node's ACTUAL loaded-voice
+        #: set, scraped from the ``voices=`` line on ``/readyz`` (or
+        #: the ``sonata_voice_loaded`` gauge); None until a scrape has
+        #: reported one — an unknown actual set keeps PR-12 semantics
+        #: (no reconcile ops, permissive voice-aware routing)
+        self.loaded_voices: Optional[frozenset] = None
+        #: router-side in-flight per voice on this node (what the RAM
+        #: budget's never-evict-a-live-voice guard reads)
+        self.voice_outstanding: dict = {}
 
     def view(self) -> dict:
         # not named snapshot(): the repo-wide lock-order pass resolves
@@ -266,8 +294,12 @@ class MeshNode:
                 "consecutive_failures": self.consecutive_failures,
                 "consecutive_probe_failures":
                     self.consecutive_probe_failures,
+                "consecutive_reconcile_failures":
+                    self.consecutive_reconcile_failures,
                 "probe_backoff_s": self.probe_backoff_s,
                 "scope_stale": self.scope_stale,
+                "voices": (None if self.loaded_voices is None
+                           else sorted(self.loaded_voices)),
                 "scope_scrape_age_s": (
                     None if self.scope_scrape_at is None
                     else round(time.monotonic() - self.scope_scrape_at,
@@ -358,6 +390,9 @@ class MeshRouter:
         #: attached fleet observability plane (ISSUE 13) — probed on
         #: every cycle, scrapes on its own cadence; None costs one read
         self._fleet = None
+        #: attached voice-placement plane (ISSUE 14) — reconciles on
+        #: the prober threads, restricts voice-aware routing
+        self._placement = None
         self._probers: list = []
         if start_probers:
             for node in self.nodes:
@@ -391,6 +426,85 @@ class MeshRouter:
         slower cadence, so a wedged node export can never stall a
         peer's probes either)."""
         self._fleet = fleet
+
+    # -- voice placement attachment (ISSUE 14) --------------------------------
+    def attach_placement(self, plane) -> None:
+        """Attach the voice-placement plane: each node's prober calls
+        ``plane.on_probe_cycle(node)`` after every health cycle (the
+        reconcile runs on the prober thread at the plane's own slower
+        cadence, so a wedged reconcile can only ever stall its own
+        node's prober), and ``pick(voice=...)`` restricts routing to
+        the plane's converged holders."""
+        self._placement = plane
+
+    @property
+    def placement(self):
+        return self._placement
+
+    def voice_load_view(self, node: MeshNode) -> tuple:
+        """(actual loaded-voice set or None, per-voice router-side
+        in-flight) for the placement reconciler — one consistent read
+        under the router lock."""
+        with self._lock:
+            return node.loaded_voices, dict(node.voice_outstanding)
+
+    def note_voice_loaded(self, node: MeshNode, voice_id: str) -> None:
+        """A voice op just landed on ``node`` (RPC fan-out or a
+        reconcile replay): fold it into the actual set optimistically
+        so routing converges immediately — the next ``/readyz`` scrape
+        remains the source of truth and overwrites the whole set."""
+        with self._lock:
+            if node.loaded_voices is None:
+                node.loaded_voices = frozenset((voice_id,))
+            else:
+                node.loaded_voices = node.loaded_voices | {voice_id}
+
+    def note_voice_unloaded(self, node: MeshNode,
+                            voice_id: str) -> None:
+        with self._lock:
+            if node.loaded_voices:
+                node.loaded_voices = node.loaded_voices - {voice_id}
+
+    def note_reconcile_failure(self, node: MeshNode,
+                               reason: str) -> None:
+        """A failed reconcile cycle (injected ``mesh.reconcile`` fault,
+        hang-cap conviction, failed replay op) counts toward the
+        node's breaker on its OWN consecutive counter — probes succeed
+        4x as often as reconciles run, so sharing the probe counter
+        would let each probe success launder the reconcile failures
+        accumulated between cycles (the PR-12 probe-vs-route lesson,
+        third edition).  A node whose control plane cannot be
+        reconciled is therefore eventually evicted from membership."""
+        with self._lock:
+            node.consecutive_reconcile_failures += 1
+            self._maybe_trip_locked(
+                node, node.consecutive_reconcile_failures,
+                f"reconcile failed ({reason})")
+
+    def note_reconcile_success(self, node: MeshNode) -> None:
+        """A clean reconcile cycle resets only the RECONCILE counter
+        (never the probe or route ones)."""
+        with self._lock:
+            node.consecutive_reconcile_failures = 0
+
+    def begin_voice_retire(self, node: MeshNode,
+                           voice_id: str) -> bool:
+        """Atomically stop routing ``voice_id`` to ``node`` ahead of an
+        unload/eviction op.  Under the router lock: refuse (False) if
+        the voice has in-flight streams there; otherwise remove it from
+        the node's actual set — ``pick`` can then never route a new
+        stream for the voice to this node, so the unload RPC that
+        follows cannot kill a stream the router admitted (the
+        never-evict-a-live-voice invariant, closed against the
+        diff-to-apply race).  A failed unload RPC self-heals: the next
+        ``/readyz`` scrape restores the actual set and the reconciler
+        retries."""
+        with self._lock:
+            if node.voice_outstanding.get(voice_id, 0) > 0:
+                return False
+            if node.loaded_voices:
+                node.loaded_voices = node.loaded_voices - {voice_id}
+            return True
 
     def record_scope_scrape(self, node: MeshNode) -> None:
         """One successful scope-export scrape of ``node`` (stamps the
@@ -456,7 +570,7 @@ class MeshRouter:
                 self._probe_result(node, ok=True, ready=True,
                                    draining=node.draining)
                 return True
-            code, _body = self._fetch(node.spec.metrics_base + "/readyz",
+            code, rbody = self._fetch(node.spec.metrics_base + "/readyz",
                                       self.probe_timeout_s)
         except Exception as e:
             self._probe_result(node, ok=False,
@@ -466,6 +580,16 @@ class MeshRouter:
         draining = False
         reported: Optional[float] = None
         node_id: Optional[str] = None
+        #: the node's ACTUAL loaded-voice set — the `voices=` line on
+        #: /readyz is authoritative (present-but-empty means "no
+        #: voices", explicitly); absent falls back to the
+        #: sonata_voice_loaded gauge below, and neither leaves the
+        #: actual set unknown (old backends keep PR-12 semantics)
+        voices: Optional[frozenset] = None
+        for line in rbody.splitlines():
+            if line.startswith("voices="):
+                raw = line[len("voices="):].strip()
+                voices = frozenset(v for v in raw.split(",") if v)
         try:
             mcode, mbody = self._fetch(
                 node.spec.metrics_base + "/metrics", self.probe_timeout_s)
@@ -489,18 +613,26 @@ class MeshRouter:
                 for lbl, _v in series.get("sonata_node_info", []):
                     if lbl.get("node_id"):
                         node_id = lbl["node_id"]
+                if voices is None:
+                    loaded = series.get("sonata_voice_loaded", [])
+                    if loaded:
+                        voices = frozenset(
+                            lbl["voice"] for lbl, v in loaded
+                            if v > 0 and lbl.get("voice"))
         except Exception:
             # /readyz answered, so the node is alive; the /metrics
             # enrichment is best-effort and must not convict it
             pass
         self._probe_result(node, ok=True, ready=ready, draining=draining,
-                           reported=reported, node_id=node_id)
+                           reported=reported, node_id=node_id,
+                           voices=voices)
         return True
 
     def _probe_result(self, node: MeshNode, *, ok: bool,
                       ready: bool = False, draining: bool = False,
                       reported: Optional[float] = None,
                       node_id: Optional[str] = None,
+                      voices: Optional[frozenset] = None,
                       error: Optional[str] = None) -> None:
         with self._lock:
             node.last_probe_at = time.monotonic()
@@ -526,6 +658,10 @@ class MeshRouter:
                 node.reported_outstanding = reported
             if node_id:
                 node.node_id = node_id
+            if voices is not None:
+                # the scraped actual set replaces the optimistic view
+                # wholesale — a restarted node's empty set is real news
+                node.loaded_voices = voices
             # a probe success resets only the PROBE counter: it must
             # not launder route failures accumulated between scrapes
             node.consecutive_probe_failures = 0
@@ -589,6 +725,16 @@ class MeshRouter:
                     # the aggregation plane must never stall membership
                     log.exception("mesh %s: fleet scrape error (node %s)",
                                   self.name, node.node_id)
+            placement = self._placement
+            if placement is not None:
+                try:
+                    # run_cycle already charges failures to the node's
+                    # breaker; this guard only catches plane bugs
+                    placement.on_probe_cycle(node)
+                except Exception:
+                    log.exception(
+                        "mesh %s: placement reconcile error (node %s)",
+                        self.name, node.node_id)
             self._wake.wait(timeout=self.probe_interval_s)
 
     # -- routing --------------------------------------------------------------
@@ -607,28 +753,50 @@ class MeshRouter:
     def _rank_locked(self, node: MeshNode) -> tuple:
         return (node.outstanding, -self._headroom(node), node.index)
 
-    def pick(self, exclude: tuple = ()) -> MeshNode:
+    def pick(self, exclude: tuple = (),
+             voice: Optional[str] = None) -> MeshNode:
         """Reserve the best routable node (caller must :meth:`release`).
 
         A half-open node with nothing outstanding takes the request as
-        its breaker trial.  Raises typed :class:`Draining` when every
-        candidate is mid-deploy, :class:`Overloaded` when none is
-        healthy."""
+        its breaker trial.  With ``voice`` set and a placement plane
+        attached, candidates are restricted to converged holders of
+        that voice; zero converged holders of a known voice raises the
+        typed :class:`VoiceWarming` refusal (``route_stream`` absorbs
+        it with the bounded placement wait).  Raises typed
+        :class:`Draining` when every candidate is mid-deploy,
+        :class:`Overloaded` when none is healthy."""
         with self._lock:
+            allowed = None
+            if voice is not None and self._placement is not None:
+                # plane lock nested inside the router lock — the one
+                # ordering the placement plane is built around
+                allowed = self._placement.routable_for(voice)
+
+            def _holds(n: MeshNode) -> bool:
+                return allowed is None or n.index in allowed
+
             for n in self.nodes:
                 if (n.state == HALF_OPEN and n.outstanding == 0
                         and n.ready and not n.draining
-                        and not n.scope_stale and n not in exclude):
-                    n.outstanding += 1
-                    n.routed += 1
-                    self.stats["routed"] += 1
-                    return n
+                        and not n.scope_stale and n not in exclude
+                        and _holds(n)):
+                    return self._reserve_locked(n, voice)
             routable = [n for n in self.nodes
                         if n.state == CLOSED and n.ready
                         and not n.draining and not n.scope_stale
-                        and n not in exclude]
+                        and n not in exclude and _holds(n)]
             if not routable:
                 candidates = [n for n in self.nodes if n not in exclude]
+                if allowed is not None and any(
+                        self._routable_locked(n) for n in candidates) \
+                        and not any(_holds(n) for n in candidates
+                                    if self._routable_locked(n)):
+                    # healthy nodes exist, none has converged on the
+                    # voice yet: warming, not overload
+                    raise VoiceWarming(
+                        f"voice-warming: no converged holder of voice "
+                        f"{voice!r} in mesh {self.name!r} yet "
+                        "(placement replay in flight; retry shortly)")
                 if candidates and all(n.draining for n in candidates):
                     raise Draining(
                         f"draining: every node of mesh {self.name!r} is "
@@ -638,15 +806,31 @@ class MeshRouter:
                     f"({sum(1 for n in self.nodes if self._routable_locked(n))}"
                     f" of {len(self.nodes)} routable)")
             best = min(routable, key=self._rank_locked)
-            best.outstanding += 1
-            best.routed += 1
-            self.stats["routed"] += 1
-            return best
+            return self._reserve_locked(best, voice)
 
-    def release(self, node: MeshNode) -> None:
+    def _reserve_locked(self, node: MeshNode,
+                        voice: Optional[str]) -> MeshNode:
+        node.outstanding += 1
+        node.routed += 1
+        self.stats["routed"] += 1
+        if voice is not None:
+            node.voice_outstanding[voice] = \
+                node.voice_outstanding.get(voice, 0) + 1
+            if self._placement is not None:
+                self._placement.touch(voice)  # the LRU clock
+        return node
+
+    def release(self, node: MeshNode,
+                voice: Optional[str] = None) -> None:
         with self._lock:
             if node.outstanding > 0:
                 node.outstanding -= 1
+            if voice is not None:
+                held = node.voice_outstanding.get(voice, 0)
+                if held <= 1:
+                    node.voice_outstanding.pop(voice, None)
+                else:
+                    node.voice_outstanding[voice] = held - 1
 
     def record_route(self, node: MeshNode, ok: bool,
                      reason: str = "") -> None:
@@ -702,7 +886,8 @@ class MeshRouter:
     def route_stream(self, start: Callable, *,
                      deadline: Optional[Deadline] = None,
                      request_id: Optional[str] = None,
-                     classify: Optional[Callable] = None) -> Iterator:
+                     classify: Optional[Callable] = None,
+                     voice: Optional[str] = None) -> Iterator:
         """Route one streaming request across the fleet; yields chunks.
 
         ``start(node, timeout_s)`` opens the stream on ``node`` and
@@ -711,19 +896,41 @@ class MeshRouter:
         contract: route-class failures and draining refusals reroute
         (bounded by ``SONATA_MESH_RETRIES`` and the deadline) while no
         chunk has been yielded; after the first chunk every failure is
-        typed through.  The caller holds its own admission slot; this
-        method holds the per-node outstanding count.
+        typed through.  With ``voice`` set, routing is restricted to
+        converged placement holders, and a :class:`VoiceWarming` state
+        gets a bounded router-side wait (``SONATA_PLACEMENT_WAIT_MS``,
+        separate from the retry budget — a warming voice is not a
+        fault) before failing typed.  The caller holds its own
+        admission slot; this method holds the per-node outstanding
+        count.
         """
         classify = classify if classify is not None else default_classify
         tried: list = []
         retries_left = self.retries
         backoff_s = self.retry_backoff_ms / 1e3
         streamed = False
+        warming_until: Optional[float] = None
         while True:
             if deadline is not None:
                 deadline.raise_if_expired()
             try:
-                node = self.pick(exclude=tuple(tried))
+                node = self.pick(exclude=tuple(tried), voice=voice)
+            except VoiceWarming as e:
+                now = time.monotonic()
+                if warming_until is None:
+                    budget = (self._placement.wait_budget_s
+                              if self._placement is not None else 0.0)
+                    warming_until = now + budget
+                if now < warming_until and (deadline is None
+                                            or deadline.alive()):
+                    time.sleep(min(0.05, max(warming_until - now, 0.0)))
+                    continue
+                with self._lock:
+                    self.stats["failed"] += 1
+                log.warning("mesh %s: request %s failed voice-warming "
+                            "after the placement wait budget (%s)",
+                            self.name, request_id, e)
+                raise
             except (Overloaded, Draining) as e:
                 # transient no-candidate states deserve the same bounded
                 # retry as a route failure: the canonical case is a node
@@ -794,16 +1001,16 @@ class MeshRouter:
                             yield chunk
                     sp.annotate(streamed=streamed)
                 self.record_route(node, ok=True)
-                self.release(node)
+                self.release(node, voice)
                 return
             except GeneratorExit:
                 # the client went away: stop the backend stream, free
                 # the slot, and let the generator close normally
                 self._cancel(call)
-                self.release(node)
+                self.release(node, voice)
                 raise
             except Exception as e:
-                self.release(node)
+                self.release(node, voice)
                 if hedged[0] and not streamed:
                     kind = "hedge"
                 elif streamed:

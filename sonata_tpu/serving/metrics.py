@@ -342,6 +342,15 @@ class _Handler(BaseHTTPRequestHandler):
             # (the sonata-mesh router scrapes /readyz for membership)
             nid = getattr(self.health, "node_id", None)
             tag = f"node={nid}\n".encode() if nid else b""
+            # the loaded-voice set is the placement reconciler's
+            # ACTUAL state — emitted even when empty (a restarted
+            # node's empty set is exactly the news that triggers the
+            # replay), on both the 200 and 503 bodies (a warming node
+            # already holds its voices)
+            voices_view = getattr(self.health, "voices_view", None)
+            if voices_view is not None:
+                tag += ("voices=" + ",".join(voices_view())
+                        + "\n").encode()
             if self.health is None or self.health.ready:
                 self._reply(200, b"ready\n" + tag)
             else:

@@ -1,0 +1,848 @@
+"""sonata-placement: desired-state voice registry, placement map,
+anti-entropy reconcile, voice-aware routing, and RAM-budgeted LRU
+eviction — driven through fake apply callables and a probers-off
+router, so every contract is pinned deterministically (the multi-
+process replay lives in the serving/chaos smokes).
+"""
+
+import threading
+import time
+
+import pytest
+
+from sonata_tpu.serving import faults
+from sonata_tpu.serving.admission import Overloaded
+from sonata_tpu.serving.mesh import MeshRouter, NodeSpec
+from sonata_tpu.serving.metrics import MetricsRegistry
+from sonata_tpu.serving.placement import PlacementPlane, VoiceWarming
+from sonata_tpu.serving.replicas import CLOSED, OPEN
+
+
+def make_router(n_nodes=2, **kw):
+    specs = [NodeSpec("127.0.0.1", 40000 + i, 41000 + i)
+             for i in range(n_nodes)]
+    kw.setdefault("start_probers", False)
+    kw.setdefault("retry_backoff_ms", 1.0)
+    return MeshRouter(specs, **kw)
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+    def tick(self, dt=1.0):
+        self.now += dt
+        return self.now
+
+
+def make_plane(router, **kw):
+    """Plane over fake apply callables that record every op."""
+    ops = []
+
+    def apply_load(node, path):
+        ops.append(("load", node.index, path))
+
+    def apply_unload(node, vid):
+        ops.append(("unload", node.index, vid))
+
+    def apply_options(node, payload):
+        ops.append(("set_options", node.index, payload))
+
+    kw.setdefault("replicas", 0)
+    kw.setdefault("wait_ms", 0.0)
+    plane = PlacementPlane(router, apply_load=apply_load,
+                           apply_unload=apply_unload,
+                           apply_options=apply_options, **kw)
+    router.attach_placement(plane)
+    return plane, ops
+
+
+def set_actual(node, *voices):
+    node.loaded_voices = frozenset(voices)
+
+
+# ---------------------------------------------------------------------------
+# registry revisions
+# ---------------------------------------------------------------------------
+
+def test_record_load_revisions_and_tombstone_lifecycle():
+    r = make_router(2)
+    try:
+        plane, _ops = make_plane(r)
+        assert plane.record_load("v1", "/cfg/a.json") is True
+        rev1 = plane.placement_view()["voices"][0]["revision"]
+        # an idempotent re-load overwrites the record, never duplicates
+        assert plane.record_load("v1", "/cfg/a.json") is False
+        rev2 = plane.placement_view()["voices"][0]["revision"]
+        assert rev2 > rev1
+        assert plane.record_unload("v1") is True
+        view = plane.placement_view()
+        assert view["voices"] == [] and "v1" in view["tombstones"]
+        # reload after unload clears the tombstone: loadable again
+        assert plane.record_load("v1", "/cfg/a.json") is True
+        view = plane.placement_view()
+        assert [v["voice_id"] for v in view["voices"]] == ["v1"]
+        assert view["tombstones"] == []
+    finally:
+        r.close()
+
+
+def test_unload_never_resurrects_on_a_stale_rejoining_node():
+    # a node rejoining with an unloaded voice still resident is
+    # retired, and nothing ever re-adds the voice
+    r = make_router(2)
+    try:
+        plane, ops = make_plane(r)
+        plane.record_load("v1", "/cfg/a.json")
+        plane.record_unload("v1")
+        set_actual(r.nodes[0], "v1")  # the stale rejoiner
+        applied = plane.reconcile_node(r.nodes[0])
+        assert applied == [("unload", "v1")]
+        assert ("unload", 0, "v1") in ops
+        assert r.nodes[0].loaded_voices == frozenset()
+        # further cycles are quiet: no load op can resurrect it
+        ops.clear()
+        assert plane.reconcile_node(r.nodes[0]) == []
+        assert not any(kind == "load" for kind, *_rest in ops)
+    finally:
+        r.close()
+
+
+def test_boot_config_voices_unknown_to_registry_are_left_alone():
+    r = make_router(2)
+    try:
+        plane, ops = make_plane(r)
+        plane.record_load("v1", "/cfg/a.json")
+        set_actual(r.nodes[0], "v1", "bootvoice")  # bootvoice: node boot config
+        assert plane.reconcile_node(r.nodes[0]) == []
+        assert ops == []
+        assert "bootvoice" in r.nodes[0].loaded_voices
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# placement spread
+# ---------------------------------------------------------------------------
+
+def test_placement_spread_balances_pressure():
+    r = make_router(4)
+    try:
+        plane, _ops = make_plane(r, replicas=2)
+        for i in range(4):
+            plane.record_load(f"v{i}", f"/cfg/{i}.json")
+        view = plane.placement_view()
+        pressures = [len(row["placed"]) for row in view["nodes"]]
+        assert sorted(pressures) == [2, 2, 2, 2]
+        assert all(len(v["assigned"]) == 2 for v in view["voices"])
+    finally:
+        r.close()
+
+
+def test_replicas_default_places_on_every_node():
+    r = make_router(3)
+    try:
+        plane, _ops = make_plane(r)  # replicas=0 == all (wire compat)
+        plane.record_load("v1", "/cfg/a.json")
+        assert plane.desired_count("v1") == 3
+    finally:
+        r.close()
+
+
+def test_placement_is_sticky_across_rebalances():
+    r = make_router(3)
+    try:
+        plane, _ops = make_plane(r, replicas=1)
+        plane.record_load("v1", "/cfg/a.json")
+        before = plane.placement_view()["voices"][0]["assigned"]
+        for node in r.nodes:
+            plane.reconcile_node(node)
+        assert plane.placement_view()["voices"][0]["assigned"] == before
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# anti-entropy reconcile: replay, convergence, options
+# ---------------------------------------------------------------------------
+
+def test_reconcile_replays_load_to_restarted_node_and_converges():
+    r = make_router(2)
+    try:
+        plane, ops = make_plane(r)
+        plane.record_load("v1", "/cfg/a.json")
+        set_actual(r.nodes[1], "v1")       # the surviving holder
+        set_actual(r.nodes[0])             # restarted: empty actual set
+        assert plane.converged_count("v1") == 1
+        applied = plane.reconcile_node(r.nodes[0])
+        assert applied == [("load", "v1")]
+        assert ops == [("load", 0, "/cfg/a.json")]
+        # the replay folds into the actual set optimistically
+        assert "v1" in r.nodes[0].loaded_voices
+        assert plane.converged_count("v1") == 2
+        # and the next cycle is quiet
+        ops.clear()
+        assert plane.reconcile_node(r.nodes[0]) == []
+        assert ops == []
+    finally:
+        r.close()
+
+
+def test_reconcile_skips_nodes_with_unknown_actual_set():
+    # no metrics plane == no scraped actual set: PR-12 semantics, no ops
+    r = make_router(1)
+    try:
+        plane, ops = make_plane(r)
+        plane.record_load("v1", "/cfg/a.json")
+        assert r.nodes[0].loaded_voices is None
+        assert plane.reconcile_node(r.nodes[0]) == []
+        assert ops == []
+    finally:
+        r.close()
+
+
+def test_reconcile_skips_open_and_draining_nodes():
+    r = make_router(1)
+    try:
+        plane, ops = make_plane(r)
+        plane.record_load("v1", "/cfg/a.json")
+        set_actual(r.nodes[0])
+        r.nodes[0].state = OPEN
+        assert plane.reconcile_node(r.nodes[0]) == []
+        r.nodes[0].state = CLOSED
+        r.nodes[0].draining = True
+        assert plane.reconcile_node(r.nodes[0]) == []
+        assert ops == []
+    finally:
+        r.close()
+
+
+def test_load_replay_carries_recorded_options():
+    r = make_router(1)
+    try:
+        plane, ops = make_plane(r)
+        plane.record_load("v1", "/cfg/a.json")
+        plane.record_options("v1", b"OPTS")
+        set_actual(r.nodes[0])
+        applied = plane.reconcile_node(r.nodes[0])
+        assert applied == [("load", "v1")]
+        assert ops == [("load", 0, "/cfg/a.json"),
+                       ("set_options", 0, b"OPTS")]
+    finally:
+        r.close()
+
+
+def test_options_replay_to_converged_holder_and_after_restart():
+    r = make_router(1)
+    try:
+        plane, ops = make_plane(r)
+        plane.record_load("v1", "/cfg/a.json")
+        set_actual(r.nodes[0], "v1")
+        assert plane.reconcile_node(r.nodes[0]) == []
+        plane.record_options("v1", b"OPTS")
+        assert plane.reconcile_node(r.nodes[0]) == [("set_options", "v1")]
+        # applied: the next cycle is quiet
+        ops.clear()
+        assert plane.reconcile_node(r.nodes[0]) == []
+        # a breaker trip (restart in progress) forgets what was applied
+        # there, so options replay on rejoin even when the voice is
+        # back via boot config
+        r.nodes[0].state = OPEN
+        plane.reconcile_node(r.nodes[0])
+        r.nodes[0].state = CLOSED
+        assert plane.reconcile_node(r.nodes[0]) == [("set_options", "v1")]
+    finally:
+        r.close()
+
+
+def test_record_options_unknown_voice_is_refused():
+    r = make_router(1)
+    try:
+        plane, _ops = make_plane(r)
+        assert plane.record_options("nope", b"x") is False
+    finally:
+        r.close()
+
+
+def test_forget_load_rolls_back_without_tombstone():
+    r = make_router(1)
+    try:
+        plane, _ops = make_plane(r)
+        plane.record_load("v1", "/cfg/a.json")
+        plane.forget_load("v1")
+        view = plane.placement_view()
+        assert view["voices"] == [] and view["tombstones"] == []
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# re-placement: holder evicted / breaker-tripped
+# ---------------------------------------------------------------------------
+
+def test_tripped_only_holder_is_replaced_within_one_cycle():
+    r = make_router(2)
+    try:
+        plane, ops = make_plane(r, replicas=1)
+        plane.record_load("v1", "/cfg/a.json")
+        set_actual(r.nodes[0], "v1")
+        set_actual(r.nodes[1])
+        assert plane.placement_view()["voices"][0]["assigned"] == \
+            [r.nodes[0].node_id]
+        r.nodes[0].state = OPEN  # the only holder trips
+        applied = plane.reconcile_node(r.nodes[1])
+        assert applied == [("load", "v1")]
+        view = plane.placement_view()["voices"][0]
+        assert view["assigned"] == [r.nodes[1].node_id]
+        assert view["converged"] == [r.nodes[1].node_id]
+        assert plane.stats["evictions_unplaced"] == 1
+    finally:
+        r.close()
+
+
+def test_under_target_keeps_dead_holder_for_replay_on_rejoin():
+    # replicas=all: a tripped node stays assigned (no replacement
+    # exists), so its rejoin gets a replay instead of orphan retirement
+    r = make_router(2)
+    try:
+        plane, _ops = make_plane(r)
+        plane.record_load("v1", "/cfg/a.json")
+        set_actual(r.nodes[0], "v1")
+        set_actual(r.nodes[1], "v1")
+        r.nodes[0].state = OPEN
+        plane.reconcile_node(r.nodes[1])
+        assert plane.desired_count("v1") == 2  # dead holder kept
+        # rejoin restarted-empty: the replay lands
+        r.nodes[0].state = CLOSED
+        set_actual(r.nodes[0])
+        assert plane.reconcile_node(r.nodes[0]) == [("load", "v1")]
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# voice-aware pick + typed voice-warming refusal
+# ---------------------------------------------------------------------------
+
+def test_pick_restricted_to_converged_holders():
+    r = make_router(2)
+    try:
+        plane, _ops = make_plane(r)
+        plane.record_load("v1", "/cfg/a.json")
+        set_actual(r.nodes[0], "v1")
+        set_actual(r.nodes[1])  # healthy but not a holder
+        # node 1 is less loaded, but only node 0 holds the voice
+        r.nodes[0].outstanding = 5
+        node = r.pick(voice="v1")
+        assert node.index == 0
+        r.release(node, "v1")
+        # without a voice (or with an unknown one) routing is free
+        assert r.pick().index == 1
+        r.release(r.nodes[1])
+        assert r.pick(voice="unknown-voice").index == 1
+        r.release(r.nodes[1], "unknown-voice")
+    finally:
+        r.close()
+
+
+def test_pick_unknown_actual_set_stays_permissive():
+    r = make_router(2)
+    try:
+        plane, _ops = make_plane(r)
+        plane.record_load("v1", "/cfg/a.json")
+        set_actual(r.nodes[0])          # known NOT to hold it
+        r.nodes[1].loaded_voices = None  # no metrics plane: permissive
+        assert r.pick(voice="v1").index == 1
+    finally:
+        r.close()
+
+
+def test_pick_zero_holders_raises_typed_voice_warming():
+    r = make_router(2)
+    try:
+        plane, _ops = make_plane(r)
+        plane.record_load("v1", "/cfg/a.json")
+        set_actual(r.nodes[0])
+        set_actual(r.nodes[1])
+        with pytest.raises(VoiceWarming) as ei:
+            r.pick(voice="v1")
+        assert "voice-warming" in str(ei.value)
+        # no healthy node at all stays Overloaded, not warming
+        for n in r.nodes:
+            n.state = OPEN
+        with pytest.raises(Overloaded):
+            r.pick(voice="v1")
+    finally:
+        r.close()
+
+
+def test_pick_voice_outstanding_accounting():
+    r = make_router(1)
+    try:
+        plane, _ops = make_plane(r)
+        plane.record_load("v1", "/cfg/a.json")
+        set_actual(r.nodes[0], "v1")
+        n = r.pick(voice="v1")
+        n2 = r.pick(voice="v1")
+        assert n is n2 and n.voice_outstanding == {"v1": 2}
+        r.release(n, "v1")
+        assert n.voice_outstanding == {"v1": 1}
+        r.release(n, "v1")
+        assert n.voice_outstanding == {}
+    finally:
+        r.close()
+
+
+def test_route_stream_waits_bounded_then_fails_typed():
+    r = make_router(1)
+    try:
+        plane, _ops = make_plane(r, wait_ms=200.0)
+        plane.record_load("v1", "/cfg/a.json")
+        set_actual(r.nodes[0])  # no holder
+        t0 = time.monotonic()
+        with pytest.raises(VoiceWarming):
+            list(r.route_stream(lambda n, t: [b"x"], voice="v1"))
+        elapsed = time.monotonic() - t0
+        assert 0.15 <= elapsed < 3.0  # waited the budget, then typed
+        assert r.stats["failed"] == 1
+    finally:
+        r.close()
+
+
+def test_route_stream_serves_once_convergence_lands_mid_wait():
+    r = make_router(1)
+    try:
+        plane, _ops = make_plane(r, wait_ms=2000.0)
+        plane.record_load("v1", "/cfg/a.json")
+        set_actual(r.nodes[0])
+        timer = threading.Timer(
+            0.1, lambda: set_actual(r.nodes[0], "v1"))
+        timer.start()
+        try:
+            out = list(r.route_stream(lambda n, t: [b"ok"], voice="v1"))
+        finally:
+            timer.cancel()
+        assert out == [b"ok"]
+        assert r.stats["failed"] == 0
+        assert r.nodes[0].voice_outstanding == {}  # released
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# RAM budget: LRU eviction + the never-evict-live-streams invariant
+# ---------------------------------------------------------------------------
+
+def lru_setup(n_nodes=2, budget=1024.0):
+    r = make_router(n_nodes)
+    clock = FakeClock()
+    plane, ops = make_plane(r, replicas=1, ram_budget_mb=budget,
+                            voice_mb=512.0, clock=clock)
+    return r, plane, ops, clock
+
+
+def test_lru_eviction_order_under_ram_budget():
+    # budget fits 2 voices per node; the 3rd load on a node evicts the
+    # least-recently-routed one
+    r, plane, ops, clock = lru_setup()
+    try:
+        for i, vid in enumerate(("v1", "v2", "v3", "v4")):
+            clock.tick()
+            plane.record_load(vid, f"/cfg/{vid}.json")
+        # spread: v1,v3 -> node0; v2,v4 -> node1 (both at budget)
+        view = {row["index"]: row["placed"]
+                for row in plane.placement_view()["nodes"]}
+        assert view[0] == ["v1", "v3"] and view[1] == ["v2", "v4"]
+        set_actual(r.nodes[0], "v1", "v3")
+        set_actual(r.nodes[1], "v2", "v4")
+        # v1 is routed (MRU); then v5 lands on node 0 -> v3 is LRU there
+        clock.tick()
+        plane.touch("v1")
+        clock.tick()
+        plane.record_load("v5", "/cfg/v5.json")
+        applied = plane.reconcile_node(r.nodes[0])
+        assert ("unload", "v3") in applied      # LRU evicted, not v1
+        assert ("load", "v5") in applied
+        assert plane.stats["evictions_ram_budget"] == 1
+        view = {row["index"]: row["placed"]
+                for row in plane.placement_view()["nodes"]}
+        assert view[0] == ["v1", "v5"]
+    finally:
+        r.close()
+
+
+def test_eviction_never_takes_a_voice_with_live_streams():
+    r, plane, ops, clock = lru_setup()
+    try:
+        for vid in ("v1", "v2"):
+            clock.tick()
+            plane.record_load(vid, f"/cfg/{vid}.json")
+        # force both onto node 0 so the budget (2 voices) is at the line
+        # v1 -> node0, v2 -> node1 by spread; add a third on node 0
+        set_actual(r.nodes[0], "v1")
+        set_actual(r.nodes[1], "v2")
+        clock.tick()
+        plane.record_load("v3", "/cfg/v3.json")  # -> node0 (tie: index)
+        clock.tick()
+        plane.record_load("v4", "/cfg/v4.json")  # -> node1
+        clock.tick()
+        plane.record_load("v5", "/cfg/v5.json")  # -> node0, over budget
+        # v1 is the LRU on node 0 — but it has a live stream there
+        n = r.pick(voice="v1")
+        assert n.index == 0
+        applied = plane.reconcile_node(r.nodes[0])
+        assert ("unload", "v1") not in applied
+        view = {row["index"]: row["placed"]
+                for row in plane.placement_view()["nodes"]}
+        assert "v1" in view[0]          # protected by the live stream
+        assert "v3" not in view[0]      # the next-LRU went instead
+        r.release(n, "v1")
+    finally:
+        r.close()
+
+
+def test_eviction_deferred_when_every_voice_has_live_streams():
+    r, plane, ops, clock = lru_setup(n_nodes=1, budget=512.0)
+    try:
+        clock.tick()
+        plane.record_load("v1", "/cfg/v1.json")
+        set_actual(r.nodes[0], "v1")
+        clock.tick()
+        plane.record_load("v2", "/cfg/v2.json")  # over budget now
+        r.nodes[0].loaded_voices = frozenset(("v1", "v2"))
+        a = r.pick(voice="v1")
+        b = r.pick(voice="v2")
+        before = plane.stats["evictions_ram_budget"]
+        plane.reconcile_node(r.nodes[0])
+        assert plane.stats["evictions_ram_budget"] == before  # deferred
+        r.release(a, "v1")
+        r.release(b, "v2")
+        plane.reconcile_node(r.nodes[0])
+        assert plane.stats["evictions_ram_budget"] == before + 1
+    finally:
+        r.close()
+
+
+def test_evicted_voice_replaces_onto_node_with_budget_room():
+    r, plane, ops, clock = lru_setup(n_nodes=3)
+    try:
+        # fill node 0 past budget: v1, v2 -> spread; v3 forced there
+        clock.tick()
+        plane.record_load("v1", "/cfg/v1.json")   # -> node0
+        clock.tick()
+        plane.record_load("v2", "/cfg/v2.json")   # -> node1
+        clock.tick()
+        plane.record_load("v3", "/cfg/v3.json")   # -> node2
+        clock.tick()
+        plane.record_load("v4", "/cfg/v4.json")   # -> node0 (at budget)
+        clock.tick()
+        plane.record_load("v5", "/cfg/v5.json")   # -> node1 (at budget)
+        clock.tick()
+        plane.record_load("v6", "/cfg/v6.json")   # -> node2 (at budget)
+        clock.tick()
+        plane.record_load("v7", "/cfg/v7.json")   # -> node0: over budget
+        set_actual(r.nodes[0], "v1", "v4")
+        set_actual(r.nodes[1], "v2", "v5")
+        set_actual(r.nodes[2], "v3", "v6")
+        plane.reconcile_node(r.nodes[0])
+        assert plane.stats["evictions_ram_budget"] == 1   # v1 (LRU) out
+        # v1 is re-placed only where budget room exists — all nodes are
+        # full, so it stays unplaced rather than ping-ponging
+        assert plane.desired_count("v1") == 0
+        # free room on node 1 and reconcile: v1 lands there
+        plane.record_unload("v2")
+        set_actual(r.nodes[1], "v5")
+        plane.reconcile_node(r.nodes[1])
+        assert plane.desired_count("v1") == 1
+        assert plane.placement_view()["voices"][0]["assigned"] == \
+            [r.nodes[1].node_id]
+    finally:
+        r.close()
+
+
+def test_unload_deferred_while_streams_resident_then_retired():
+    # the never-evict invariant extends to the unload op itself: a
+    # tombstoned (or unplaced) voice with resident iteration-loop /
+    # in-flight streams keeps serving until they finish
+    r = make_router(1)
+    try:
+        plane, ops = make_plane(r)
+        plane.record_load("v1", "/cfg/a.json")
+        set_actual(r.nodes[0], "v1")
+        n = r.pick(voice="v1")
+        plane.record_unload("v1")
+        assert plane.reconcile_node(r.nodes[0]) == []   # deferred
+        assert ops == []
+        r.release(n, "v1")
+        assert plane.reconcile_node(r.nodes[0]) == [("unload", "v1")]
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# mesh.reconcile failpoint + breaker accounting
+# ---------------------------------------------------------------------------
+
+def test_reconcile_failpoint_error_counts_toward_node_breaker():
+    reg = faults.registry()
+    r = make_router(2, breaker_threshold=3)
+    try:
+        plane, _ops = make_plane(r)
+        plane.record_load("v1", "/cfg/a.json")
+        set_actual(r.nodes[0], "v1")
+        reg.arm("mesh.reconcile", "error", max_hits=3)
+        for _ in range(3):
+            assert plane.run_cycle(r.nodes[0]) is False
+        assert plane.stats["reconcile_failures"] == 3
+        assert r.nodes[0].consecutive_reconcile_failures == 3
+        assert r.nodes[0].state == OPEN     # counts toward THE breaker
+        assert r.nodes[1].state == CLOSED   # only that node's
+        # the arm is spent: the next cycle succeeds
+        assert plane.run_cycle(r.nodes[1]) is True
+    finally:
+        reg.disarm_all()
+        r.close()
+
+
+def test_probe_success_does_not_launder_reconcile_failures():
+    # probes run 4x as often as reconciles: a shared counter would let
+    # each probe success erase the reconcile failures accumulated
+    # between cycles, so a node whose control plane can never be
+    # reconciled would never trip — the counters are separate (the
+    # PR-12 probe-vs-route lesson, third edition)
+    reg = faults.registry()
+    r = make_router(1, breaker_threshold=3,
+                    fetch=lambda url, t: (200, "ready\nvoices=v1\n"))
+    try:
+        plane, _ops = make_plane(r)
+        plane.record_load("v1", "/cfg/a.json")
+        reg.arm("mesh.reconcile", "error", max_hits=3)
+        for _ in range(2):
+            assert plane.run_cycle(r.nodes[0]) is False
+            assert r.probe_once(r.nodes[0]) is True  # probes succeed
+        assert r.nodes[0].consecutive_reconcile_failures == 2  # NOT reset
+        assert plane.run_cycle(r.nodes[0]) is False
+        assert r.nodes[0].state == OPEN
+        # a clean reconcile cycle resets only the reconcile counter
+        reg.disarm_all()
+        r.nodes[0].state = CLOSED
+        assert plane.run_cycle(r.nodes[0]) is True
+        assert r.nodes[0].consecutive_reconcile_failures == 0
+    finally:
+        reg.disarm_all()
+        r.close()
+
+
+def test_failed_replay_op_counts_as_reconcile_failure():
+    r = make_router(1, breaker_threshold=10)
+    try:
+        def broken_load(node, path):
+            raise ConnectionError("node fell over mid-replay")
+
+        plane = PlacementPlane(r, apply_load=broken_load, wait_ms=0.0)
+        r.attach_placement(plane)
+        plane.record_load("v1", "/cfg/a.json")
+        set_actual(r.nodes[0])
+        assert plane.run_cycle(r.nodes[0]) is False
+        assert plane.stats["op_failures"] == 1
+        assert plane.stats["reconcile_failures"] == 1
+        assert r.nodes[0].consecutive_reconcile_failures == 1
+    finally:
+        r.close()
+
+
+def test_unload_op_rechecks_streams_and_stops_routing_first():
+    # the diff's outstanding snapshot and the unload RPC are separated
+    # by real time: begin_voice_retire re-checks under the router lock
+    # and removes the voice from the actual set BEFORE the RPC, so a
+    # new stream can neither be routed mid-unload nor killed by it
+    r = make_router(1)
+    try:
+        retired = []
+
+        def apply_unload(node, vid):
+            # at RPC time the router must already refuse to route the
+            # voice here — the never-evict-a-live-voice race, closed
+            retired.append(vid)
+            assert vid not in (node.loaded_voices or ())
+
+        plane = PlacementPlane(r, apply_unload=apply_unload, wait_ms=0.0)
+        r.attach_placement(plane)
+        plane.record_load("v1", "/cfg/a.json")
+        plane.record_unload("v1")
+        set_actual(r.nodes[0], "v1")
+        # a stream slips in AFTER the diff snapshot: simulate by
+        # driving begin_voice_retire directly
+        n = r.pick(voice="v1")
+        assert r.begin_voice_retire(r.nodes[0], "v1") is False
+        assert "v1" in r.nodes[0].loaded_voices  # untouched: still live
+        r.release(n, "v1")
+        assert plane.reconcile_node(r.nodes[0]) == [("unload", "v1")]
+        assert retired == ["v1"]
+        assert r.nodes[0].loaded_voices == frozenset()
+    finally:
+        r.close()
+
+
+def test_forget_load_restores_the_tombstone_it_cleared():
+    # a LoadVoice that reaches zero nodes must not erase an earlier
+    # unload: the rollback re-erects the tombstone, so a partitioned
+    # node rejoining with the voice resident is still retired
+    r = make_router(1)
+    try:
+        plane, _ops = make_plane(r)
+        plane.record_load("v1", "/cfg/a.json")
+        plane.record_unload("v1")
+        plane.record_load("v1", "/cfg/a.json")   # clears the tombstone
+        plane.forget_load("v1")                  # ...but the op failed
+        view = plane.placement_view()
+        assert view["voices"] == [] and "v1" in view["tombstones"]
+        set_actual(r.nodes[0], "v1")             # the stale rejoiner
+        assert plane.reconcile_node(r.nodes[0]) == [("unload", "v1")]
+    finally:
+        r.close()
+
+
+def test_forget_unload_rolls_the_tombstone_back_out():
+    # an UnloadVoice that found the voice NOWHERE (NOT_FOUND to the
+    # client) must not poison the id: a node boot-loading it later is
+    # left alone
+    r = make_router(1)
+    try:
+        plane, ops = make_plane(r)
+        plane.record_unload("bootvoice")
+        plane.forget_unload("bootvoice")
+        assert plane.placement_view()["tombstones"] == []
+        set_actual(r.nodes[0], "bootvoice")
+        assert plane.reconcile_node(r.nodes[0]) == []
+        assert ops == []
+    finally:
+        r.close()
+
+
+def test_lru_clock_ignores_unknown_ids_and_prunes_on_unload():
+    # touch() records only registry-known voices (a client spraying
+    # typo'd ids must not grow the table), and unload prunes the entry
+    r = make_router(1)
+    try:
+        plane, _ops = make_plane(r)
+        plane.record_load("v1", "/cfg/a.json")
+        plane.touch("no-such-voice")
+        plane.touch("v1")
+        with plane._lock:
+            assert set(plane._last_used) == {"v1"}
+        plane.record_unload("v1")
+        with plane._lock:
+            assert plane._last_used == {}
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# probe scrape: the actual-state channels
+# ---------------------------------------------------------------------------
+
+def test_probe_scrapes_voices_line_from_readyz():
+    def fetch(url, timeout_s):
+        if url.endswith("/readyz"):
+            return 200, "ready\nnode=n1\nvoices=12,34\n"
+        return 200, ""
+
+    r = make_router(1, fetch=fetch)
+    try:
+        assert r.probe_once(r.nodes[0]) is True
+        assert r.nodes[0].loaded_voices == frozenset(("12", "34"))
+        assert r.nodes[0].view()["voices"] == ["12", "34"]
+    finally:
+        r.close()
+
+
+def test_probe_scrapes_empty_voices_line_as_explicit_empty_set():
+    def fetch(url, timeout_s):
+        if url.endswith("/readyz"):
+            return 200, "ready\nvoices=\n"
+        return 200, ""
+
+    r = make_router(1, fetch=fetch)
+    try:
+        assert r.probe_once(r.nodes[0]) is True
+        assert r.nodes[0].loaded_voices == frozenset()
+    finally:
+        r.close()
+
+
+def test_probe_falls_back_to_voice_loaded_gauge():
+    def fetch(url, timeout_s):
+        if url.endswith("/readyz"):
+            return 200, "ready\n"  # old backend: no voices= line
+        return 200, 'sonata_voice_loaded{voice="77"} 1\n'
+
+    r = make_router(1, fetch=fetch)
+    try:
+        assert r.probe_once(r.nodes[0]) is True
+        assert r.nodes[0].loaded_voices == frozenset(("77",))
+    finally:
+        r.close()
+
+
+def test_probe_without_either_channel_leaves_actual_unknown():
+    r = make_router(1, fetch=lambda url, t: (200, ""))
+    try:
+        assert r.probe_once(r.nodes[0]) is True
+        assert r.nodes[0].loaded_voices is None
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# metrics + debug surfaces
+# ---------------------------------------------------------------------------
+
+def test_placement_metrics_lazily_created_and_exactly_torn_down():
+    r = make_router(2)
+    try:
+        plane, _ops = make_plane(r)
+        reg = MetricsRegistry()
+        plane.bind_metrics(reg)
+        plane.record_load("v1", "/cfg/a.json")
+        set_actual(r.nodes[0], "v1")
+        set_actual(r.nodes[1])
+        text = reg.render()
+        assert 'sonata_placement_desired{voice="v1"} 2' in text
+        assert 'sonata_placement_converged{voice="v1"} 1' in text
+        assert 'sonata_placement_reconcile_ops_total{op="load"} 0' in text
+        assert ('sonata_placement_evictions_total{reason="ram-budget"}'
+                ' 0') in text
+        plane.reconcile_node(r.nodes[1])
+        text = reg.render()
+        assert 'sonata_placement_converged{voice="v1"} 2' in text
+        assert 'sonata_placement_reconcile_ops_total{op="load"} 1' in text
+        # unload drops exactly the per-voice series
+        plane.record_unload("v1")
+        text = reg.render()
+        assert 'voice="v1"' not in text
+        assert "sonata_placement_reconcile_ops_total" in text
+    finally:
+        r.close()
+
+
+def test_placement_view_rows():
+    r = make_router(2)
+    try:
+        plane, _ops = make_plane(r, replicas=2)
+        plane.record_load("v1", "/cfg/a.json")
+        plane.record_options("v1", b"O")
+        set_actual(r.nodes[0], "v1")
+        view = plane.placement_view()
+        assert view["replicas"] == 2
+        row = view["voices"][0]
+        assert row["voice_id"] == "v1"
+        assert row["options_revision"] is not None
+        assert len(row["assigned"]) == 2 and len(row["converged"]) == 1
+        assert view["nodes"][0]["actual"] == ["v1"]
+        assert view["nodes"][0]["est_ram_mb"] > 0
+    finally:
+        r.close()

@@ -725,10 +725,6 @@ def main() -> int:
     check("mesh: router readyz recovers with the node", code == 200,
           f"(code {code})")
 
-    fired = fires_total()
-    check("every registered site fired this run (mesh sites included)",
-          all(fired.get(s, 0) > 0 for s in faults.SITES), f"({fired})")
-
     mesh_channel.close()
     mesh_server_obj.stop(grace=None)
     mesh_server_obj.sonata_service.shutdown()
@@ -739,6 +735,116 @@ def main() -> int:
     degradation_mod.install(runtime.degradation)
     if runtime.scope is not None:
         scope_mod.install(runtime.scope)
+
+    # ---- phase M2: voice-placement reconcile (ISSUE 14) — the
+    # mesh.reconcile failpoint's error/hang semantics, plus
+    # kill-the-only-holder → re-placement within one reconcile cycle.
+    # Driven against a probers-off router (deterministic cycle order on
+    # both seeds; every arm below is rate=1).  The second "node" is a
+    # phantom: a dead gRPC port sharing THIS server's metrics plane, so
+    # its probes answer and its scraped actual set already carries the
+    # voice — re-placement converges without a second real process.
+    import socket
+
+    from sonata_tpu.serving.mesh import MeshRouter, parse_backends
+    from sonata_tpu.serving.placement import PlacementPlane
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        phantom_port = s.getsockname()[1]
+    prouter = MeshRouter(
+        parse_backends(f"127.0.0.1:{port}/{runtime.http_port},"
+                       f"127.0.0.1:{phantom_port}/{runtime.http_port}"),
+        start_probers=False, name="chaos-placement",
+        probe_interval_s=0.2)
+    plane = PlacementPlane(prouter, replicas=1,
+                           reconcile_interval_s=0.2, wait_ms=0.0,
+                           apply_load=lambda node, path: None,
+                           apply_unload=lambda node, vid: None,
+                           apply_options=lambda node, payload: None)
+    prouter.attach_placement(plane)
+    plane.record_load(voice_id, cfg)
+    prouter.probe_once(prouter.nodes[0])
+    prouter.probe_once(prouter.nodes[1])
+    check("placement: probes scrape the loaded-voice set from /readyz",
+          prouter.nodes[0].loaded_voices is not None
+          and voice_id in prouter.nodes[0].loaded_voices,
+          f"({prouter.nodes[0].view()})")
+    def assigned_indexes() -> list:
+        # the phantom scrapes the real node's sonata_node_info, so both
+        # entries share a node_id string — identity checks go by the
+        # stable node INDEX (the fleet-recorder lesson from PR 13)
+        with plane._lock:
+            return list(plane._assign.get(voice_id, ()))
+
+    rec0 = fires_total().get("mesh.reconcile", 0)
+    ok = plane.run_cycle(prouter.nodes[0])
+    check("placement: clean reconcile cycle fires the mesh.reconcile "
+          "site", ok and fires_total().get("mesh.reconcile", 0) == rec0,
+          "(site is a no-op single branch until armed)")
+    check("placement: the voice is placed and converged on its only "
+          "holder (replicas=1)",
+          assigned_indexes() == [0]
+          and plane.converged_count(voice_id) == 1,
+          f"({plane.placement_view()['voices']})")
+
+    # mesh.reconcile:error — three injected cycle errors must count
+    # toward THAT node's breaker (threshold 3) like failed probes
+    arm_spec("mesh.reconcile:error:1::3")
+    for _i in range(3):
+        check(f"placement: injected reconcile error {_i + 1} is "
+              "counted", plane.run_cycle(prouter.nodes[0]) is False)
+    check("placement: reconcile errors tripped the holder's breaker",
+          prouter.nodes[0].state == OPEN
+          and prouter.nodes[1].state == CLOSED,
+          f"({prouter.nodes[0].view()})")
+    check("placement: mesh.reconcile fires counted",
+          fires_total().get("mesh.reconcile", 0) == rec0 + 3,
+          f"({fires_total()})")
+    disarm_all()
+
+    # kill-the-only-holder: ONE reconcile cycle re-places the voice on
+    # the surviving node — and it is converged immediately (the
+    # phantom's scraped actual set already carries the voice)
+    plane.run_cycle(prouter.nodes[1])
+    check("placement: voice re-placed onto the surviving node within "
+          "one reconcile cycle",
+          assigned_indexes() == [1]
+          and plane.converged_count(voice_id) == 1
+          and plane.stats["evictions_unplaced"] == 1,
+          f"({plane.placement_view()['voices']}, {plane.stats})")
+
+    # mesh.reconcile:hang — a hung cycle stalls only its own node's
+    # reconcile (per-node prober isolation); the 400 ms cap converts it
+    # to a counted failure instead of a wedged thread
+    failures_before = plane.stats["reconcile_failures"]
+    arm_spec("mesh.reconcile:hang:1:400:1")
+    hang_thread = threading.Thread(
+        target=plane.run_cycle, args=(prouter.nodes[1],))
+    t_hang = time.monotonic()
+    hang_thread.start()
+    peer_probes = 0
+    while hang_thread.is_alive() and time.monotonic() - t_hang < 5.0:
+        prouter.probe_once(prouter.nodes[0])
+        peer_probes += 1
+        time.sleep(0.05)
+    hang_thread.join(timeout=10.0)
+    check("placement: a hung reconcile stalls only its own node's "
+          "cycle (peer probes kept cycling)",
+          not hang_thread.is_alive() and peer_probes >= 3
+          and time.monotonic() - t_hang >= 0.35,
+          f"({peer_probes} peer probes in "
+          f"{time.monotonic() - t_hang:.2f}s)")
+    check("placement: the hang cap converts to a counted reconcile "
+          "failure",
+          plane.stats["reconcile_failures"] == failures_before + 1,
+          f"({plane.stats})")
+    disarm_all()
+    prouter.close()
+
+    fired = fires_total()
+    check("every registered site fired this run (mesh sites included)",
+          all(fired.get(s, 0) > 0 for s in faults.SITES), f"({fired})")
 
     # ---- phase G: no request outlived its budget; registry symmetry ----
     check("no request outlived deadline + watchdog budget", not overruns,

@@ -178,7 +178,12 @@ def mesh_node_boot() -> int:
     router's membership at the same address), voice loaded + warmed,
     SIGTERM handlers installed (the drain path IS the phase's subject),
     reporting one ``MESHNODE {json}`` line and then serving until
-    signalled."""
+    signalled.
+
+    ``MESH_NODE_EMPTY=1`` (ISSUE 14) boots the node with NO voices —
+    ready immediately, empty ``voices=`` line on ``/readyz`` — the
+    restarted-after-SIGKILL shape whose voice set the router's
+    placement reconciler must restore with zero operator action."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -203,15 +208,22 @@ def mesh_node_boot() -> int:
                                  request_timeout_s=60.0)
     server.start()
     install_signal_handlers(server)
-    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
-    load = channel.unary_unary(
-        "/sonata_grpc.sonata_grpc/LoadVoice",
-        request_serializer=lambda m: m.encode(),
-        response_deserializer=pb.VoiceInfo.decode)
-    info = load(pb.VoicePath(config_path=cfg))
-    server.sonata_service.warmup_and_mark_ready()
+    voice_id = ""
+    if os.environ.get("MESH_NODE_EMPTY") == "1":
+        runtime = server.sonata_runtime
+        runtime.warmup_progress.finish()
+        runtime.health.set_ready("no preloaded voices")
+    else:
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        load = channel.unary_unary(
+            "/sonata_grpc.sonata_grpc/LoadVoice",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=pb.VoiceInfo.decode)
+        info = load(pb.VoicePath(config_path=cfg))
+        voice_id = info.voice_id
+        server.sonata_service.warmup_and_mark_ready()
     print("MESHNODE " + json.dumps(
-        {"voice_id": info.voice_id, "grpc_port": port,
+        {"voice_id": voice_id, "grpc_port": port,
          "metrics_port": metrics_port,
          "node_id": server.sonata_runtime.node_id}), flush=True)
     server.wait_for_termination()
@@ -762,12 +774,13 @@ def main(args=None) -> int:
     node_logs = [open(os.path.join(mesh_cache, f"node{i}.log"), "w")
                  for i in range(2)]
 
-    def boot_node(i: int) -> subprocess.Popen:
+    def boot_node(i: int, empty: bool = False) -> subprocess.Popen:
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    SMOKE_VOICE_CFG=cfg,
                    SONATA_JAX_CACHE_DIR=mesh_cache,
                    MESH_NODE_GRPC_PORT=str(node_ports[i][0]),
-                   MESH_NODE_METRICS_PORT=str(node_ports[i][1]))
+                   MESH_NODE_METRICS_PORT=str(node_ports[i][1]),
+                   MESH_NODE_EMPTY="1" if empty else "0")
         return subprocess.Popen(
             [sys.executable, __file__, "--mesh-node-boot"],
             env=env, stdout=node_logs[i], stderr=node_logs[i])
@@ -809,6 +822,33 @@ def main(args=None) -> int:
     code, _ = http_get(mesh_base + "/readyz")
     check("mesh: router readyz 200 with both nodes up", code == 200,
           f"(code {code})")
+
+    # ---- placement (ISSUE 14): register desired state through the
+    # router (idempotent on nodes that boot-loaded the same config) so
+    # every voice op from here on is reconciled, not fire-and-forget
+    mesh_load = mesh_channel.unary_unary(
+        "/sonata_grpc.sonata_grpc/LoadVoice",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.VoiceInfo.decode)
+    minfo = mesh_load(pb.VoicePath(config_path=cfg), timeout=120.0)
+    check("placement: router LoadVoice records desired state with the "
+          "fleet voice id", minfo.voice_id == voice_id,
+          f"({minfo.voice_id} vs {voice_id})")
+
+    def placement_gauge(name: str) -> float:
+        parsed = parse_prometheus_text(
+            http_get(mesh_base + "/metrics")[1])
+        return sum(v for lbl, v in parsed.get(name, [])
+                   if lbl.get("voice") == voice_id)
+
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline and \
+            placement_gauge("sonata_placement_converged") < 2:
+        time.sleep(0.2)
+    check("placement: sonata_placement_desired covers both nodes",
+          placement_gauge("sonata_placement_desired") == 2.0)
+    check("placement: both nodes converged holders within the probe "
+          "cadence", placement_gauge("sonata_placement_converged") == 2.0)
 
     # the standard traffic mix through the router
     mesh_mix = ("Mesh routing check.", "Short.",
@@ -1036,8 +1076,85 @@ def main(args=None) -> int:
     check("mesh: router readyz 200 after the kill (one healthy node)",
           code == 200, f"(code {code})")
 
+    # ---- placement (ISSUE 14): restart the SIGKILLed backend EMPTY
+    # under traffic.  The acceptance bar: the reconciler restores its
+    # desired voice set with no router restart and zero client-visible
+    # errors for not-yet-streaming requests — and routing stays
+    # voice-aware, so the warming node serves only once converged.
+    wait_exit(procs[1], 30.0)  # reap the SIGKILLed pid, free the port
+    restart_results: dict = {}
+    threads = [threading.Thread(target=run_stream,
+                                args=(restart_results, j))
+               for j in range(4)]
+    for t in threads:
+        t.start()
+    procs[1] = boot_node(1, empty=True)
+    check("placement: emptied backend boots ready with no voices",
+          wait_node_ready(1))
+    for t in threads:
+        t.join(timeout=120.0)
+    check("placement: zero client-visible errors across the empty "
+          "restart",
+          all(j in restart_results and restart_results[j][1] is None
+              and restart_results[j][0] > 0 for j in range(4)),
+          str({j: (r[1].code().name if r[1] else f"{r[0]} chunks")
+               for j, r in restart_results.items()}))
+    # the reconciler replays LoadVoice onto the rejoined node: its own
+    # /readyz voices= line (the reconciler's actual-state channel)
+    # must carry the fleet voice again, with no router restart
+    restored = False
+    deadline = time.monotonic() + 120.0
+    while time.monotonic() < deadline and not restored:
+        _c, rbody = http_get(
+            f"http://127.0.0.1:{node_ports[1][1]}/readyz")
+        restored = any(line.startswith("voices=")
+                       and voice_id in line for line in rbody.splitlines())
+        if not restored:
+            time.sleep(0.5)
+    check("placement: reconciler replays LoadVoice onto the rejoined "
+          "node", restored)
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline and \
+            placement_gauge("sonata_placement_converged") < 2:
+        time.sleep(0.2)
+    check("placement: sonata_placement_converged returns to 2",
+          placement_gauge("sonata_placement_converged") == 2.0)
+    check("placement: sonata_placement_reconcile_ops_total counted the "
+          "replay",
+          sum(v for lbl, v in parse_prometheus_text(
+              http_get(mesh_base + "/metrics")[1]).get(
+              "sonata_placement_reconcile_ops_total", [])
+              if lbl.get("op") == "load") >= 1.0)
+    # the /debug/fleet scoreboard carries the placement table
+    code, body = http_get(mesh_base + "/debug/fleet")
+    pdoc = (json.loads(body) if code == 200 else {}).get("placement")
+    prow = next((v for v in (pdoc or {}).get("voices", [])
+                 if v["voice_id"] == voice_id), None)
+    check("placement: /debug/fleet placement table shows the voice "
+          "converged on both nodes",
+          prow is not None and len(prow["assigned"]) == 2
+          and len(prow["converged"]) == 2, f"({prow})")
+    # and the restored node actually synthesizes the voice again
+    restored_id = f"127.0.0.1:{node_ports[1][0]}"
+    served_by_restored = False
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline and not served_by_restored:
+        call = mesh_synth(pb.Utterance(voice_id=voice_id,
+                                       text="Serve from the restored "
+                                            "node."), timeout=60.0)
+        ok = bool(list(call))
+        trailers = dict(call.trailing_metadata() or ())
+        served_by_restored = ok and \
+            trailers.get("x-sonata-node-id") == restored_id
+    check("placement: the restored node synthesizes the replayed "
+          "voice", served_by_restored)
+
     # zero healthy nodes is the line the router's readiness must not
     # survive
+    procs[1].kill()
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline and router.routable_count() != 1:
+        time.sleep(0.1)
     procs[0].send_signal(signal.SIGTERM)
     wait_exit(procs[0], 90.0)
     deadline = time.monotonic() + 30.0
