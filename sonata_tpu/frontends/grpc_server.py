@@ -59,6 +59,7 @@ from ..serving import (
     Overloaded,
     ServingRuntime,
     faults,
+    synthcache,
     tracing,
 )
 from ..serving import warmup as serving_warmup
@@ -446,8 +447,176 @@ class SonataGrpcService:
         return self._admitted(request, context, "SynthesizeUtterance",
                               self._synthesize_utterance)
 
+    # -- synthesis cache (serving/synthcache.py, ISSUE 15) --------------------
+    def _cache_key_for(self, v: "_Voice", request: pb.Utterance,
+                       kind: str) -> str:
+        """Canonical request identity: normalized text + voice/speaker/
+        scales + output format + the stream-shape fields.  The speaker
+        and scales are snapshotted from the voice's fallback config
+        exactly like the synthesis paths snapshot them, so the key and
+        the audio can never disagree about identity."""
+        sc = v.voice.get_fallback_synthesis_config()
+        sid = sc.speaker[1] if sc.speaker else None
+        info = v.voice.audio_output_info()
+        sa = request.speech_args
+        realtime = kind == "realtime"
+        return synthcache.request_key(
+            rpc=kind, text=request.text, voice_id=v.voice_id, speaker=sid,
+            length_scale=sc.length_scale, noise_scale=sc.noise_scale,
+            noise_w=sc.noise_w, sample_rate=info.sample_rate,
+            sample_width=info.sample_width, channels=info.num_channels,
+            mode=request.synthesis_mode or 0,
+            chunk_size=(request.realtime_chunk_size or 55) if realtime
+            else 0,
+            chunk_padding=(request.realtime_chunk_padding or 3)
+            if realtime else 0,
+            speech_args=None if sa is None else (
+                sa.rate, sa.volume, sa.pitch, sa.appended_silence_ms))
+
+    def _cached_stream(self, cache, request, context, *, rpc: str,
+                       kind: str, body, to_msg, payload_of):
+        """Serve one streaming RPC through the synthesis cache.
+
+        The probe sits AHEAD of pool/iteration-loop admission: a hit
+        replays the committed chunk sequence (zero dispatches, zero
+        queue wait) under a ``cache-hit`` span; a concurrent identical
+        request follows the single-flight leader's filling entry; a
+        miss makes this request the leader — every emitted chunk is
+        teed into the fill handle, committed only when the stream
+        finishes fully (any other exit aborts the fill, so a failed/
+        cancelled/deadline-expired stream never caches a truncated
+        result).
+        """
+        v = self._get(request.voice_id, context)
+        key = self._cache_key_for(v, request, kind)
+        outcome, handle = cache.lookup(key, tag=v.voice_id)
+        if outcome == "hit":
+            yield from self._replay_cached(handle, context, rpc, to_msg)
+            return
+        if outcome == "follow":
+            served = yield from self._follow_cached(handle, context, rpc,
+                                                    to_msg)
+            if served:
+                return
+            # leader failed/stalled before any of THIS stream's audio
+            # left: recover via independent synthesis, cache untouched
+            # (a leader error must not fan out)
+            outcome = "bypass"
+        if outcome != "fill":  # bypass: degraded lookup — plain miss
+            yield from body()
+            return
+        # a client disconnect can surface as the deadline's cancel flag,
+        # which makes the miss bodies RETURN normally mid-stream — this
+        # flag (fed by the same context callback) lets the commit below
+        # tell that truncated exit from a genuinely finished stream
+        cancelled = Deadline.none()
+        add_cb = getattr(context, "add_callback", None)
+        if add_cb is not None:
+            try:
+                add_cb(cancelled.cancel)
+            except Exception:
+                pass  # context already terminated
+        committed = False
+        try:
+            for msg in body():
+                handle.add_chunk(*payload_of(msg))
+                yield msg
+            # commit ONLY a fully-successful stream: not one cut short
+            # by a client disconnect, and not one whose identity drifted
+            # mid-fill (a concurrent SetSynthesisOptions changes the
+            # scales the lazy path reads live — the re-derived key must
+            # still match the one the entry was filed under)
+            if not cancelled.cancelled \
+                    and self._cache_key_for(v, request, kind) == key:
+                handle.commit_fill()
+                committed = True
+        finally:
+            if not committed:
+                handle.abort_fill()
+
+    def _replay_cached(self, chunks, context, rpc: str, to_msg):
+        """A cache hit: replay the stored chunk sequence byte for byte
+        (same chunk boundaries the filling synthesis produced), with
+        the standard TTFB/latency accounting and a ``cache-hit`` span
+        instead of the dispatch tree."""
+        rt = self.runtime
+        deadline = rt.deadline_for(context)
+        t0 = time.monotonic()
+        try:
+            with tracing.span("cache-hit", chunks=len(chunks)) as sp:
+                first = True
+                for payload, aux in chunks:
+                    if deadline.cancelled:
+                        return  # client went away mid-replay
+                    deadline.raise_if_expired()
+                    if first:
+                        first = False
+                        ttfb = time.monotonic() - t0
+                        rt.ttfb.observe(ttfb)
+                        sp.annotate(ttfb_ms=round(ttfb * 1e3, 3))
+                    yield to_msg(payload, aux)
+            rt.synth_latency.observe(time.monotonic() - t0)
+        except DeadlineExceeded as e:
+            rt.expired.inc()
+            self._abort_sonata(context, rpc, e)
+
+    def _follow_cached(self, follower, context, rpc: str, to_msg):
+        """Single-flight follower: stream chunks from the leader's
+        filling entry as they land (bounded per-chunk wait).  Returns
+        True when served to completion, False when the leader failed
+        before ANY audio left this stream (the caller then falls back
+        to independent synthesis).  A leader failure after audio left
+        fails this stream typed — splicing in chunks from a fresh,
+        differently-noised synthesis would be worse than failing."""
+        rt = self.runtime
+        deadline = rt.deadline_for(context)
+        t0 = time.monotonic()
+        n = 0
+        try:
+            with tracing.span("cache-follow") as sp:
+                for payload, aux in follower:
+                    if deadline.cancelled:
+                        return True  # client gone; nothing to recover
+                    deadline.raise_if_expired()
+                    n += 1
+                    if n == 1:
+                        ttfb = time.monotonic() - t0
+                        rt.ttfb.observe(ttfb)
+                        sp.annotate(ttfb_ms=round(ttfb * 1e3, 3))
+                    yield to_msg(payload, aux)
+                sp.annotate(chunks=n)
+            rt.synth_latency.observe(time.monotonic() - t0)
+            return True
+        except synthcache.LeaderFailed as e:
+            if n == 0:
+                return False
+            self._abort_sonata(context, rpc, e)
+        except DeadlineExceeded as e:
+            rt.expired.inc()
+            self._abort_sonata(context, rpc, e)
+        finally:
+            # a follower whose client went away mid-follow (cancel flag
+            # or generator close) would otherwise never reach a terminal
+            # state — resolve it as a miss so hits+misses keeps counting
+            # every resolved lookup (no-op once already resolved)
+            follower.abandon()
+
     def _synthesize_utterance(self, request: pb.Utterance,
                               context) -> Iterator[pb.SynthesisResult]:
+        cache = self.runtime.synth_cache
+        if cache is None:  # default: byte-for-byte the pre-cache path
+            yield from self._synthesize_utterance_miss(request, context)
+            return
+        yield from self._cached_stream(
+            cache, request, context, rpc="SynthesizeUtterance",
+            kind="utterance",
+            body=lambda: self._synthesize_utterance_miss(request, context),
+            to_msg=lambda payload, aux: pb.SynthesisResult(
+                wav_samples=payload, rtf=aux if aux is not None else 0.0),
+            payload_of=lambda msg: (msg.wav_samples, msg.rtf))
+
+    def _synthesize_utterance_miss(self, request: pb.Utterance,
+                                   context) -> Iterator[pb.SynthesisResult]:
         rt = self.runtime
         v = self._get(request.voice_id, context)
         cfg = self._speech_args_config(request.speech_args)
@@ -564,6 +733,11 @@ class SonataGrpcService:
         promises, before the model underneath disappears), then the
         voice's own worker threads, then the readiness gate and metrics
         series."""
+        if self.runtime.synth_cache is not None:
+            # drop the voice's cached streams: a reload at the same
+            # config path reuses the voice id, and entries filled by the
+            # OLD model must not replay as hits against the new one
+            self.runtime.synth_cache.drop_tag(v.voice_id)
         if v.scheduler is not None:
             v.scheduler.shutdown()  # a ReplicaPool drains every replica
         if v.pool is not None:
@@ -712,6 +886,20 @@ class SonataGrpcService:
 
     def _synthesize_realtime(self, request: pb.Utterance,
                              context) -> Iterator[pb.WaveSamples]:
+        cache = self.runtime.synth_cache
+        if cache is None:  # default: byte-for-byte the pre-cache path
+            yield from self._synthesize_realtime_miss(request, context)
+            return
+        yield from self._cached_stream(
+            cache, request, context, rpc="SynthesizeUtteranceRealtime",
+            kind="realtime",
+            body=lambda: self._synthesize_realtime_miss(request, context),
+            to_msg=lambda payload, aux: pb.WaveSamples(
+                wav_samples=payload),
+            payload_of=lambda msg: (msg.wav_samples, None))
+
+    def _synthesize_realtime_miss(self, request: pb.Utterance,
+                                  context) -> Iterator[pb.WaveSamples]:
         rt = self.runtime
         v = self._get(request.voice_id, context)
         cfg = self._speech_args_config(request.speech_args)

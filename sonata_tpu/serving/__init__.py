@@ -41,6 +41,7 @@ from . import degradation as degradation_mod
 from . import faults, tracing
 from . import mesh as mesh_mod
 from . import scope as scope_mod
+from . import synthcache as synthcache_mod
 from . import warmup as warmup_mod
 from .admission import AdmissionController, Overloaded
 from .deadlines import Deadline, DeadlineExceeded, default_timeout_s
@@ -57,6 +58,7 @@ from .metrics import (
 from .placement import PlacementPlane, VoiceWarming
 from .replicas import ReplicaPool, resolve_replica_count
 from .scope import Scope
+from .synthcache import SynthCache
 from .tracing import Trace, Tracer
 
 __all__ = [
@@ -81,8 +83,10 @@ __all__ = [
     "ReplicaPool",
     "resolve_replica_count",
     "Scope",
+    "SynthCache",
     "VoiceWarming",
     "scope_mod",
+    "synthcache_mod",
     "ServingRuntime",
     "Trace",
     "Tracer",
@@ -225,6 +229,23 @@ class ServingRuntime:
             self.scope.add_probe(
                 "shed_total", lambda: float(self.admission.shed_total))
             self.scope.start()
+        #: content-addressed synthesis cache (ISSUE 15): enabled by
+        #: SONATA_SYNTH_CACHE_MB > 0 (default off — the request path is
+        #: then byte-for-byte the pre-cache shape).  The frontends probe
+        #: it ahead of pool/iteration-loop admission; its hit/miss/
+        #: bytes series ride the metrics plane as scrape-time callbacks
+        #: and its hit-ratio rows ride the scope plane.
+        self.synth_cache: Optional[SynthCache] = synthcache_mod.from_env()
+        if self.synth_cache is not None:
+            self.synth_cache.bind_metrics(r)
+            if self.scope is not None:
+                self.scope.attach_cache_stats(self.synth_cache.cache_view)
+                self.scope.add_probe(
+                    "cache_hit_ratio",
+                    lambda: self.synth_cache.hit_ratio())
+                self.scope.add_probe(
+                    "cache_bytes",
+                    lambda: float(self.synth_cache.bytes_used))
         #: per-voice flight-recorder probes added by register_voice, so
         #: unregister removes exactly what was added
         self._voice_probes: dict = {}
@@ -501,6 +522,8 @@ class ServingRuntime:
 
     def close(self) -> None:
         degradation_mod.uninstall(self.degradation)
+        if self.synth_cache is not None:
+            self.synth_cache.close()
         if self.scope is not None:
             scope_mod.uninstall(self.scope)
             self.scope.close()

@@ -30,7 +30,10 @@ exercised by at least one test):
 - ``mesh.reconcile``      — inside every voice-placement reconcile cycle
   (an injected error counts toward that node's breaker on its own
   consecutive reconcile-failure counter — separate, so probe successes
-  cannot launder it; a hang stalls only that node's prober thread).
+  cannot launder it; a hang stalls only that node's prober thread);
+- ``cache.lookup``        — inside every synthesis-cache probe
+  (``serving/synthcache.py``): an injected error degrades that lookup
+  to a normal miss — a broken cache can never fail a request.
 
 Modes:
 
@@ -93,6 +96,7 @@ SITES = (
     "mesh.route",
     "mesh.health",
     "mesh.reconcile",
+    "cache.lookup",
 )
 
 MODES = ("error", "hang", "slow", "corrupt-shape")

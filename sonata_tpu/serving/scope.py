@@ -313,6 +313,11 @@ class Scope:
         self._last_dump_at: Dict[str, float] = {}
         self.dumps: List[str] = []  # paths written (newest last)
         self._breached: tuple = ()  # slo names burning > budget (fast)
+        #: synthesis-cache stats source (ISSUE 15): the runtime attaches
+        #: its SynthCache's ``cache_view`` so the debug plane and the
+        #: flight recorder carry hit-ratio rows; None on cache-off
+        #: processes (the snapshot then simply omits the section)
+        self._cache_view_fn: Optional[Callable[[], dict]] = None
         self._started = time.monotonic()
         self._stop = threading.Event()
         self._ticker: Optional[threading.Thread] = None
@@ -625,14 +630,35 @@ class Scope:
                     len(snapshots), path, reason)
         return path
 
+    # -- synthesis-cache rows (serving/synthcache.py, ISSUE 15) ---------------
+    def attach_cache_stats(self, view_fn: Callable[[], dict]) -> None:
+        """Attach the synthesis cache's ``cache_view`` callable so the
+        scope plane serves hit-ratio rows (``/debug/quantiles``
+        ``synth_cache`` section) next to the quantile/SLO state."""
+        self._cache_view_fn = view_fn
+
+    def cache_snapshot(self) -> Optional[dict]:
+        fn = self._cache_view_fn
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception:
+            # a closing cache must never break the debug plane
+            return None
+
     # -- debug-plane views ----------------------------------------------------
     def quantiles_snapshot(self) -> dict:
-        return {
+        doc = {
             "windows": [label for label, _s, _n in WINDOWS],
             "stages": {
                 stage: {label: self._merged(stage, label).to_dict()
                         for label, _s, _n in WINDOWS}
                 for stage in STAGES}}
+        cache = self.cache_snapshot()
+        if cache is not None:
+            doc["synth_cache"] = cache
+        return doc
 
     def slo_snapshot(self) -> dict:
         out = []

@@ -1,0 +1,520 @@
+"""sonata-synthcache: content-addressed request-level synthesis cache.
+
+At consumer scale TTS traffic is dominated by repeated strings
+(notification templates, IVR prompts, UI text), yet every request runs
+the full phonemize→VITS→epilogue pipeline even when the engine
+synthesized the identical utterance milliseconds ago.  This module turns
+the hottest requests into a memcpy:
+
+- **Content-addressed.**  Entries are keyed by :func:`request_key` — a
+  blake2b digest of the canonical request identity: the
+  whitespace/casing-normalized text (:func:`canonical_text`), voice id,
+  speaker id, length/noise/noise-w scales, output sample-rate/format,
+  and the stream-shape fields (RPC kind, synthesis mode, realtime chunk
+  schedule).  Never Python ``hash()`` — the key is pinned stable across
+  processes so a fleet of replicas agrees on identity.
+- **Chunk-exact replay.**  An entry stores the finished stream as its
+  ordered i16 chunk list (the exact wire payloads the miss produced), so
+  a hit replays the same chunk sequence byte for byte: clients, the
+  crossfade seams, and the trace shape are indistinguishable from the
+  synthesis that filled the entry.
+- **Write-through LRU bounded by bytes.**  ``SONATA_SYNTH_CACHE_MB``
+  (0 = off, the default — the pre-cache request path is byte-for-byte
+  unchanged) bounds the committed chunk bytes; inserting past the budget
+  evicts least-recently-used entries first.  An entry is inserted only
+  on FULLY-successful synthesis — a failed, cancelled, or
+  deadline-expired stream never caches a truncated result.
+- **Single-flight dedup.**  N concurrent identical requests admit ONE
+  synthesizer (the leader, who fills the entry); the other N−1 stream
+  chunks from the filling entry as they land.  Follower waits are
+  bounded per chunk by ``SONATA_SYNTH_CACHE_WAIT_S``; on leader failure
+  (or a stalled leader) a follower that has not yet emitted audio falls
+  back to independent synthesis — a leader error must not fan out.  A
+  follower the leader fails MID-stream raises
+  :class:`LeaderFailed` typed instead (the mesh rule: re-sending audio
+  from an independent — differently-noised — synthesis is worse than
+  failing).
+- **Failpoint.**  Every :meth:`SynthCache.lookup` fires the
+  ``cache.lookup`` site; an injected (or real) lookup error degrades to
+  a normal miss that bypasses the cache entirely — a broken cache can
+  never fail a request.
+- **Observability.**  ``sonata_synth_cache_{hits,misses,inserts,
+  evictions}_total`` and ``sonata_synth_cache_bytes`` on the metrics
+  plane (counter semantics via scrape-time callbacks — the hot path
+  bumps plain ints under the cache lock), plus hit-ratio rows on the
+  scope plane (``/debug/quantiles`` ``synth_cache`` section and the
+  flight recorder's ``cache_hit_ratio`` probe).
+
+The cache is owned by the
+:class:`~sonata_tpu.serving.ServingRuntime` and wired into the request
+path in ``frontends/grpc_server.py`` AHEAD of pool/iteration-loop
+admission: hits bypass queue wait entirely and stamp a ``cache-hit``
+trace span.  Nothing here imports gRPC or jax.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import threading
+import time
+import unicodedata
+from collections import OrderedDict
+from typing import Iterator, Optional, Tuple
+
+from ..core import OperationError
+from . import faults
+
+log = logging.getLogger("sonata.serving")
+
+CACHE_MB_ENV = "SONATA_SYNTH_CACHE_MB"
+CACHE_WAIT_S_ENV = "SONATA_SYNTH_CACHE_WAIT_S"
+
+DEFAULT_WAIT_S = 10.0
+#: per-chunk bookkeeping estimate added to the payload length so a
+#: thousand tiny chunks cannot hide from the byte budget
+CHUNK_OVERHEAD_BYTES = 64
+
+#: key-schema version: bump whenever the canonical tuple changes shape,
+#: so stale cross-process assumptions about identity fail to collide
+#: instead of colliding wrong
+KEY_VERSION = "v1"
+
+_FILLING, _COMPLETE, _FAILED = "filling", "complete", "failed"
+
+#: one chunk as stored and replayed: (wire payload bytes, aux float) —
+#: aux carries the per-sentence RTF for SynthesizeUtterance results and
+#: is None for realtime wave chunks
+Chunk = Tuple[bytes, Optional[float]]
+
+
+class LeaderFailed(OperationError):
+    """The single-flight leader failed (or stalled past the bounded
+    wait) while this follower was streaming from its filling entry."""
+
+
+def canonical_text(text: str) -> str:
+    """The cache's one definition of textual identity: Unicode NFC,
+    casefolded, whitespace runs collapsed to single spaces, stripped.
+    ``" Hello\\n\\tWORLD "`` and ``"hello world"`` address one entry.
+
+    Casefolding is a documented trade-off (DEPLOY.md): eSpeak can
+    pronounce casing ("US" vs "us"), so case-divergent texts share the
+    entry of whoever synthesized first — template traffic is
+    case-stable, which is what this cache exists for."""
+    return " ".join(unicodedata.normalize("NFC", text).casefold().split())
+
+
+def _num(v) -> str:
+    """Canonical numeric rendering (``repr`` floats round-trip exactly;
+    ints stay ints) so 1.0 and 1 cannot split an identity."""
+    if v is None:
+        return "-"
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+def request_key(*, rpc: str, text: str, voice_id: str,
+                speaker: Optional[int],
+                length_scale: float, noise_scale: float, noise_w: float,
+                sample_rate: int, sample_width: int, channels: int,
+                mode: int = 0, chunk_size: int = 0, chunk_padding: int = 0,
+                speech_args: Optional[tuple] = None) -> str:
+    """Content address of one synthesis request.
+
+    A blake2b digest of the canonical tuple — NOT Python ``hash()``
+    (whose strings are salted per process): the derivation is pinned
+    stable across processes by test_synthcache's golden digest.
+    ``speech_args`` is the raw (rate, volume, pitch,
+    appended_silence_ms) tuple or None; any prosody post-processing
+    changes the audio, so it is part of identity.
+    """
+    sa = "-" if speech_args is None else ",".join(
+        _num(x) for x in speech_args)
+    parts = (KEY_VERSION, rpc, canonical_text(text), voice_id,
+             _num(speaker), _num(length_scale), _num(noise_scale),
+             _num(noise_w), _num(sample_rate), _num(sample_width),
+             _num(channels), _num(mode), _num(chunk_size),
+             _num(chunk_padding), sa)
+    blob = "\x1f".join(parts).encode("utf-8")
+    return hashlib.blake2b(blob, digest_size=16).hexdigest()
+
+
+def resolve_cache_mb() -> float:
+    """``SONATA_SYNTH_CACHE_MB`` (the one default-defining read): 0 /
+    unset / unparseable = off.  Fractional megabytes are honored — the
+    smoke lanes size the budget below one entry-set on purpose."""
+    raw = os.environ.get(CACHE_MB_ENV, "").strip()
+    if not raw:
+        return 0.0
+    try:
+        mb = float(raw)
+    except ValueError:
+        log.warning("ignoring non-numeric %s=%r (cache stays off)",
+                    CACHE_MB_ENV, raw)
+        return 0.0
+    return max(mb, 0.0)
+
+
+def resolve_wait_s() -> float:
+    """``SONATA_SYNTH_CACHE_WAIT_S``: the bounded per-chunk follower
+    wait before a stalled leader is treated as failed."""
+    try:
+        return max(0.1, float(os.environ.get(CACHE_WAIT_S_ENV,
+                                             DEFAULT_WAIT_S)))
+    except ValueError:
+        return DEFAULT_WAIT_S
+
+
+def from_env() -> Optional["SynthCache"]:
+    """The runtime's construction gate: a :class:`SynthCache` when
+    ``SONATA_SYNTH_CACHE_MB`` > 0, else None (the default — every cache
+    hook then costs one ``is None`` branch)."""
+    mb = resolve_cache_mb()
+    if mb <= 0:
+        return None
+    return SynthCache(max_bytes=int(mb * 1024 * 1024),
+                      wait_s=resolve_wait_s())
+
+
+class _Entry:
+    """One cached (or filling) stream.  ``chunks`` is append-only while
+    filling and frozen after the terminal transition; readers and the
+    filling writer synchronize on ``cond``.  ``tag`` groups entries for
+    invalidation (the frontends tag by voice id, so :meth:`SynthCache.
+    drop_tag` can purge a voice's streams on unload/reload)."""
+
+    __slots__ = ("key", "chunks", "bytes", "state", "cond", "tag",
+                 "invalidated")
+
+    def __init__(self, key: str, tag: Optional[str] = None):
+        self.key = key
+        self.chunks: list = []          # [(payload, aux), ...]
+        self.bytes = 0
+        self.state = _FILLING
+        self.cond = threading.Condition()
+        self.tag = tag
+        #: set (under the registry lock) by drop_tag while this entry
+        #: is still filling: the fill keeps streaming to its clients,
+        #: but its commit must not insert — the tag's voice was
+        #: unloaded mid-fill, and a reload at the same id would hit
+        #: stale audio
+        self.invalidated = False
+
+    def view(self) -> dict:
+        return {"key": self.key, "chunks": len(self.chunks),
+                "bytes": self.bytes, "state": self.state,
+                "tag": self.tag}
+
+
+class FillHandle:
+    """The single-flight leader's handle: tee every emitted chunk in,
+    then exactly one of :meth:`commit_fill` (fully-successful stream →
+    write-through insert) or :meth:`abort_fill` (any other exit — the
+    truncated result is discarded and waiting followers are released
+    into their fallback)."""
+
+    __slots__ = ("_cache", "_entry", "_done")
+
+    def __init__(self, cache: "SynthCache", entry: _Entry):
+        self._cache = cache
+        self._entry = entry
+        self._done = False
+
+    def add_chunk(self, payload: bytes, aux: Optional[float] = None
+                  ) -> None:
+        entry = self._entry
+        with entry.cond:
+            entry.chunks.append((payload, aux))
+            entry.bytes += len(payload) + CHUNK_OVERHEAD_BYTES
+            entry.cond.notify_all()
+
+    def commit_fill(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._cache._commit(self._entry)
+
+    def abort_fill(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._cache._abort(self._entry)
+
+
+class FollowerStream:
+    """A deduplicated request streaming chunks from a filling entry as
+    the leader lands them.  Iteration yields :data:`Chunk` tuples;
+    exhaustion means the leader committed.  :class:`LeaderFailed` is
+    raised when the leader aborted or stalled past the bounded per-chunk
+    wait — the caller falls back to independent synthesis if (and only
+    if) it has not emitted audio yet."""
+
+    __slots__ = ("_cache", "_entry", "_i", "_wait_s", "_resolved")
+
+    def __init__(self, cache: "SynthCache", entry: _Entry, wait_s: float):
+        self._cache = cache
+        self._entry = entry
+        self._i = 0
+        self._wait_s = wait_s
+        self._resolved = False
+
+    def __iter__(self) -> Iterator[Chunk]:
+        return self
+
+    def __next__(self) -> Chunk:
+        entry = self._entry
+        with entry.cond:
+            deadline = time.monotonic() + self._wait_s
+            while True:
+                if self._i < len(entry.chunks):
+                    chunk = entry.chunks[self._i]
+                    self._i += 1
+                    return chunk
+                if entry.state == _COMPLETE:
+                    self._resolve(hit=True)
+                    raise StopIteration
+                if entry.state == _FAILED:
+                    self._resolve(hit=False)
+                    raise LeaderFailed(
+                        "synthesis cache leader failed while filling "
+                        f"entry {entry.key[:12]}")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._resolve(hit=False)
+                    raise LeaderFailed(
+                        "synthesis cache leader stalled past the "
+                        f"{self._wait_s:g}s follower wait "
+                        f"({CACHE_WAIT_S_ENV})")
+                entry.cond.wait(timeout=remaining)
+
+    def abandon(self) -> None:
+        """Resolve a follower the caller walked away from mid-follow
+        (client disconnect) as a miss, so every follower lookup reaches
+        exactly one terminal count.  No-op once resolved."""
+        self._resolve(hit=False)
+
+    def _resolve(self, hit: bool) -> None:
+        """Count this follower exactly once at its terminal state: a
+        follower served whole from the entry is a hit; one that must
+        fall back (or fail, or is abandoned) is a miss."""
+        if self._resolved:
+            return
+        self._resolved = True
+        self._cache._note_follower(hit)
+
+
+class SynthCache:
+    """Byte-bounded write-through LRU of finished synthesis streams
+    with single-flight fill dedup.  Thread-safe; the registry lock is
+    held only for dict bookkeeping (never across a wait or a chunk
+    copy)."""
+
+    def __init__(self, max_bytes: int, wait_s: float = DEFAULT_WAIT_S):
+        if max_bytes <= 0:
+            raise ValueError("SynthCache needs a positive byte budget "
+                             "(use from_env() for the 0=off gate)")
+        self.max_bytes = int(max_bytes)
+        self.wait_s = float(wait_s)
+        self._lock = threading.Lock()
+        #: committed entries, LRU order (oldest first)
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        #: single-flight: key -> the entry a leader is filling
+        self._filling: dict = {}
+        self._bytes = 0
+        self._closed = False
+        self._stats = {"hits": 0, "misses": 0, "inserts": 0,
+                       "evictions": 0, "follower_joins": 0,
+                       "lookup_errors": 0, "oversize_skips": 0,
+                       "invalidations": 0}
+
+    # -- the request-path surface --------------------------------------------
+    def lookup(self, key: str, tag: Optional[str] = None):
+        """Probe the cache for ``key``.  Returns one of:
+
+        - ``("hit", chunks)`` — a committed entry; ``chunks`` is its
+          frozen ordered chunk list, replayable without further locking
+          (eviction only unlinks the entry, the list stays alive with
+          its readers);
+        - ``("follow", FollowerStream)`` — another identical request is
+          filling the entry right now (counted at the follower's
+          terminal state, not here);
+        - ``("fill", FillHandle)`` — a miss; the caller is the
+          single-flight leader and must commit or abort the handle;
+        - ``("bypass", None)`` — the lookup itself failed (the
+          ``cache.lookup`` failpoint, or any unexpected internal
+          error): degrade to a normal miss that leaves the cache alone
+          — a broken cache can never fail a request.
+
+        ``tag`` labels a new fill's entry for group invalidation
+        (:meth:`drop_tag`); the frontends tag by voice id.
+        """
+        try:
+            faults.fire("cache.lookup")
+            with self._lock:
+                if self._closed:
+                    return ("bypass", None)
+                entry = self._entries.get(key)
+                if entry is not None:
+                    self._entries.move_to_end(key)
+                    self._stats["hits"] += 1
+                    return ("hit", entry.chunks)
+                filling = self._filling.get(key)
+                if filling is not None:
+                    self._stats["follower_joins"] += 1
+                    return ("follow",
+                            FollowerStream(self, filling, self.wait_s))
+                entry = _Entry(key, tag=tag)
+                self._filling[key] = entry
+                self._stats["misses"] += 1
+                return ("fill", FillHandle(self, entry))
+        except Exception:
+            # injected or real: one probe degrades, the request lives
+            with self._lock:
+                self._stats["lookup_errors"] += 1
+                self._stats["misses"] += 1
+            log.debug("synth-cache lookup degraded to a miss",
+                      exc_info=True)
+            return ("bypass", None)
+
+    # -- fill resolution (FillHandle calls these) ----------------------------
+    def _commit(self, entry: _Entry) -> None:
+        evicted = []
+        with self._lock:
+            self._filling.pop(entry.key, None)
+            if entry.invalidated:
+                # the tag was dropped mid-fill (voice unload/reload):
+                # the stream served its clients, the entry must not land
+                self._stats["invalidations"] += 1
+            elif not self._closed and entry.bytes <= self.max_bytes:
+                self._entries[entry.key] = entry
+                self._entries.move_to_end(entry.key)
+                self._bytes += entry.bytes
+                self._stats["inserts"] += 1
+                while self._bytes > self.max_bytes:
+                    _k, old = self._entries.popitem(last=False)
+                    self._bytes -= old.bytes
+                    self._stats["evictions"] += 1
+                    evicted.append(old.key[:12])
+            elif not self._closed:
+                # one stream bigger than the whole budget: caching it
+                # would evict everything and immediately evict itself
+                self._stats["oversize_skips"] += 1
+        with entry.cond:
+            entry.state = _COMPLETE
+            entry.cond.notify_all()
+        if evicted:
+            log.debug("synth-cache evicted %d entr%s (budget %d bytes)",
+                      len(evicted), "y" if len(evicted) == 1 else "ies",
+                      self.max_bytes)
+
+    def _abort(self, entry: _Entry) -> None:
+        with self._lock:
+            self._filling.pop(entry.key, None)
+        with entry.cond:
+            entry.state = _FAILED
+            entry.cond.notify_all()
+
+    def _note_follower(self, hit: bool) -> None:
+        with self._lock:
+            self._stats["hits" if hit else "misses"] += 1
+
+    # -- invalidation --------------------------------------------------------
+    def drop_tag(self, tag: Optional[str]) -> int:
+        """Drop every committed entry filed under ``tag`` (the frontends
+        tag by voice id: UnloadVoice must purge the voice's streams, or
+        a model reloaded at the same config path — same voice id —
+        would replay the OLD model's audio as hits).  A fill still in
+        flight keeps streaming to its clients, but its entry is marked
+        invalidated so its commit refuses to insert.  Returns the number
+        of committed entries dropped."""
+        if tag is None:
+            return 0
+        with self._lock:
+            doomed = [k for k, e in self._entries.items() if e.tag == tag]
+            for k in doomed:
+                self._bytes -= self._entries.pop(k).bytes
+            self._stats["invalidations"] += len(doomed)
+            for e in self._filling.values():
+                if e.tag == tag:
+                    e.invalidated = True
+            return len(doomed)
+
+    # -- introspection / metrics ---------------------------------------------
+    def stat(self, name: str) -> float:
+        with self._lock:
+            return float(self._stats[name])
+
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    @property
+    def entry_count(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def hit_ratio(self) -> Optional[float]:
+        """hits / (hits + misses), or None before any lookup resolved."""
+        with self._lock:
+            total = self._stats["hits"] + self._stats["misses"]
+            if total == 0:
+                return None
+            return self._stats["hits"] / total
+
+    def cache_view(self) -> dict:
+        """One snapshot for the scope plane's ``synth_cache`` rows."""
+        with self._lock:
+            ratio = None
+            total = self._stats["hits"] + self._stats["misses"]
+            if total:
+                ratio = round(self._stats["hits"] / total, 6)
+            return {**self._stats, "hit_ratio": ratio,
+                    "bytes": self._bytes, "entries": len(self._entries),
+                    "max_bytes": self.max_bytes,
+                    "filling": len(self._filling)}
+
+    def bind_metrics(self, registry) -> None:
+        """Attach the cache's series as scrape-time callbacks.  The
+        series exist only on cache-enabled processes (the knob/metric
+        pair appears and disappears together); they are process-lifetime
+        like the failpoint counters, so there is no per-voice teardown
+        to record — :meth:`close` ends the process's cache story whole."""
+        registry.counter(
+            "sonata_synth_cache_hits_total",
+            "Synthesis-cache lookups served from a committed entry "
+            "(including single-flight followers served whole from a "
+            "filling entry)."
+        ).set_function(lambda: self.stat("hits"))
+        registry.counter(
+            "sonata_synth_cache_misses_total",
+            "Synthesis-cache lookups that ran a real synthesis "
+            "(including degraded lookups and follower fallbacks)."
+        ).set_function(lambda: self.stat("misses"))
+        registry.counter(
+            "sonata_synth_cache_inserts_total",
+            "Fully-successful synthesis streams committed into the "
+            "cache (write-through; failed/cancelled streams never "
+            "insert)."
+        ).set_function(lambda: self.stat("inserts"))
+        registry.counter(
+            "sonata_synth_cache_evictions_total",
+            "Entries evicted LRU-first to hold the "
+            "SONATA_SYNTH_CACHE_MB byte budget."
+        ).set_function(lambda: self.stat("evictions"))
+        registry.gauge(
+            "sonata_synth_cache_bytes",
+            "Committed synthesis-cache bytes (chunk payloads + "
+            "per-chunk overhead) currently resident."
+        ).set_function(lambda: float(self.bytes_used))
+
+    def close(self) -> None:
+        """Drop every committed entry and refuse further inserts.
+        In-flight fills resolve against their own entry objects; their
+        commit lands on a closed registry and is discarded."""
+        with self._lock:
+            self._closed = True
+            self._entries.clear()
+            self._bytes = 0

@@ -1,0 +1,720 @@
+"""sonata-synthcache tests (ISSUE 15): content-addressed request-level
+synthesis cache with single-flight dedup.
+
+Three layers:
+
+- key derivation: whitespace/casing-normalized variants of one text map
+  to ONE key; differing speaker/scales/voice/output params map to
+  distinct keys; the derivation is pinned stable across processes
+  (golden blake2b digest + a fresh-interpreter check — never Python
+  ``hash()``);
+- the :class:`~sonata_tpu.serving.synthcache.SynthCache` registry:
+  write-through-on-success-only, byte-bounded LRU-first eviction,
+  single-flight follower streaming with bounded waits and
+  leader-failure semantics, the ``cache.lookup`` failpoint degrading to
+  a miss, and the metric callbacks;
+- the gRPC wiring: bit-identical chunk-exact replay on both streaming
+  RPCs, the ``cache-hit`` span with zero dispatch spans, N concurrent
+  identical requests admitting exactly ONE synthesizer, leader failure
+  failing only the leader's client typed while followers recover via
+  independent synthesis, and ``SONATA_SYNTH_CACHE_MB`` unset/0 leaving
+  ``runtime.synth_cache`` None (the pre-cache path).
+"""
+
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from sonata_tpu.serving import MetricsRegistry, parse_prometheus_text
+from sonata_tpu.serving import faults
+from sonata_tpu.serving import synthcache as sc
+from sonata_tpu.serving.synthcache import (
+    FollowerStream,
+    LeaderFailed,
+    SynthCache,
+    canonical_text,
+    request_key,
+)
+
+from voices import write_tiny_voice
+
+
+def key_of(text="Hello world.", **over):
+    kw = dict(rpc="realtime", voice_id="v1", speaker=None,
+              length_scale=1.0, noise_scale=0.667, noise_w=0.8,
+              sample_rate=16000, sample_width=2, channels=1,
+              mode=0, chunk_size=55, chunk_padding=3, speech_args=None)
+    kw.update(over)
+    return request_key(text=text, **kw)
+
+
+# ---------------------------------------------------------------------------
+# key derivation
+# ---------------------------------------------------------------------------
+
+def test_canonical_text_collapses_whitespace_and_case():
+    assert canonical_text("  Hello\n\tWORLD  ") == "hello world"
+    assert canonical_text("hello world") == "hello world"
+    # NFC: decomposed and precomposed é are one identity
+    assert canonical_text("café") == canonical_text("café")
+
+
+def test_normalized_variants_map_to_one_key():
+    base = key_of("Your package has shipped.")
+    for variant in ("your  package has\tshipped.",
+                    " YOUR PACKAGE HAS SHIPPED. ",
+                    "Your package\nhas shipped."):
+        assert key_of(variant) == base
+
+
+@pytest.mark.parametrize("field,value", [
+    ("speaker", 3),
+    ("length_scale", 1.2),
+    ("noise_scale", 0.5),
+    ("noise_w", 0.9),
+    ("voice_id", "v2"),
+    ("sample_rate", 22050),
+    ("sample_width", 4),
+    ("channels", 2),
+    ("rpc", "utterance"),
+    ("mode", 2),
+    ("chunk_size", 10),
+    ("chunk_padding", 2),
+    ("speech_args", (10, 50, 50, 0)),
+])
+def test_differing_request_params_map_to_distinct_keys(field, value):
+    assert key_of(**{field: value}) != key_of()
+
+
+def test_different_texts_map_to_distinct_keys():
+    assert key_of("Hello world.") != key_of("Hello there.")
+
+
+#: golden digest: the canonical-tuple derivation is part of the cache's
+#: cross-process contract — a drift here silently empties every warm
+#: cache on the next deploy, so it fails loudly instead
+GOLDEN_KEY = request_key(
+    rpc="realtime", text=" Pinned  KEY derivation. ", voice_id="1234",
+    speaker=2, length_scale=1.0, noise_scale=0.667, noise_w=0.8,
+    sample_rate=16000, sample_width=2, channels=1, mode=0,
+    chunk_size=55, chunk_padding=3, speech_args=(10, 50, 50, 0))
+
+
+def test_key_derivation_pinned_stable():
+    assert GOLDEN_KEY == "f06f8b601e8dd3c8fd15358661b4215f"
+
+
+def test_key_stable_across_processes():
+    """A fresh interpreter with a different PYTHONHASHSEED derives the
+    same key — the derivation hashes the canonical tuple with blake2b,
+    never Python ``hash()``."""
+    code = (
+        "from sonata_tpu.serving.synthcache import request_key;"
+        "print(request_key(rpc='realtime', text=' Pinned  KEY derivation. ',"
+        "voice_id='1234', speaker=2, length_scale=1.0, noise_scale=0.667,"
+        "noise_w=0.8, sample_rate=16000, sample_width=2, channels=1,"
+        "mode=0, chunk_size=55, chunk_padding=3,"
+        "speech_args=(10, 50, 50, 0)))")
+    import os
+    env = dict(os.environ, PYTHONHASHSEED="12345", JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == GOLDEN_KEY
+
+
+# ---------------------------------------------------------------------------
+# registry: fill / commit / abort / LRU
+# ---------------------------------------------------------------------------
+
+def fill_entry(cache, key, chunks):
+    outcome, handle = cache.lookup(key)
+    assert outcome == "fill"
+    for payload, aux in chunks:
+        handle.add_chunk(payload, aux)
+    handle.commit_fill()
+    return handle
+
+
+def test_miss_fill_commit_hit_replays_chunk_exact():
+    cache = SynthCache(max_bytes=1 << 20)
+    chunks = [(b"aa", 0.5), (b"bbb", None), (b"c", 1.5)]
+    fill_entry(cache, key_of(), chunks)
+    outcome, got = cache.lookup(key_of())
+    assert outcome == "hit"
+    assert list(got) == chunks  # same payloads, same order, same count
+    assert cache.stat("hits") == 1 and cache.stat("misses") == 1
+    assert cache.stat("inserts") == 1
+
+
+def test_abort_never_caches_a_truncated_result():
+    cache = SynthCache(max_bytes=1 << 20)
+    outcome, handle = cache.lookup(key_of())
+    assert outcome == "fill"
+    handle.add_chunk(b"partial")
+    handle.abort_fill()
+    assert cache.entry_count == 0 and cache.bytes_used == 0
+    assert cache.stat("inserts") == 0
+    # the next identical request is a fresh miss with its own fill
+    outcome, handle = cache.lookup(key_of())
+    assert outcome == "fill"
+    handle.abort_fill()
+
+
+def test_commit_then_abort_is_idempotent_one_way():
+    cache = SynthCache(max_bytes=1 << 20)
+    _outcome, handle = cache.lookup(key_of())
+    handle.add_chunk(b"x")
+    handle.commit_fill()
+    handle.abort_fill()  # no-op: the fill already resolved
+    assert cache.entry_count == 1
+
+
+def test_lru_eviction_is_byte_bounded_and_lru_first():
+    overhead = sc.CHUNK_OVERHEAD_BYTES
+    # room for exactly 3 one-chunk entries of 36 payload bytes each
+    cache = SynthCache(max_bytes=3 * (36 + overhead))
+    keys = [key_of(f"text number {i}.") for i in range(4)]
+    for k in keys[:3]:
+        fill_entry(cache, k, [(b"x" * 36, None)])
+    assert cache.entry_count == 3 and cache.stat("evictions") == 0
+    # touch entry 0 so entry 1 becomes least-recently-used
+    assert cache.lookup(keys[0])[0] == "hit"
+    fill_entry(cache, keys[3], [(b"x" * 36, None)])
+    assert cache.entry_count == 3
+    assert cache.stat("evictions") == 1
+    assert cache.lookup(keys[1])[0] == "fill"   # the LRU entry went
+    assert cache.lookup(keys[0])[0] == "hit"    # the refreshed one stayed
+    assert cache.lookup(keys[3])[0] == "hit"
+    assert cache.bytes_used <= cache.max_bytes
+
+
+def test_oversize_entry_is_skipped_not_inserted():
+    cache = SynthCache(max_bytes=64)
+    _o, handle = cache.lookup(key_of())
+    handle.add_chunk(b"y" * 256)
+    handle.commit_fill()
+    assert cache.entry_count == 0 and cache.bytes_used == 0
+    assert cache.stat("oversize_skips") == 1
+
+
+def test_close_refuses_inserts_and_empties_the_registry():
+    cache = SynthCache(max_bytes=1 << 20)
+    fill_entry(cache, key_of(), [(b"z", None)])
+    _o, handle = cache.lookup(key_of("another text"))
+    cache.close()
+    assert cache.entry_count == 0
+    handle.add_chunk(b"late")
+    handle.commit_fill()  # lands on a closed registry: discarded
+    assert cache.entry_count == 0
+    assert cache.lookup(key_of())[0] == "bypass"
+
+
+# ---------------------------------------------------------------------------
+# single-flight followers
+# ---------------------------------------------------------------------------
+
+def test_follower_streams_chunks_as_they_land():
+    cache = SynthCache(max_bytes=1 << 20, wait_s=5.0)
+    _o, leader = cache.lookup(key_of())
+    outcome, follower = cache.lookup(key_of())
+    assert outcome == "follow" and isinstance(follower, FollowerStream)
+    got, done = [], threading.Event()
+
+    def consume():
+        for chunk in follower:
+            got.append(chunk)
+        done.set()
+
+    t = threading.Thread(target=consume)
+    t.start()
+    leader.add_chunk(b"one", 0.1)
+    time.sleep(0.05)
+    leader.add_chunk(b"two", 0.2)
+    leader.commit_fill()
+    assert done.wait(5.0)
+    t.join(5.0)
+    assert got == [(b"one", 0.1), (b"two", 0.2)]
+    # follower served whole from the entry counts as a hit
+    assert cache.stat("hits") == 1
+    assert cache.stat("follower_joins") == 1
+
+
+def test_follower_gets_leader_failed_on_abort():
+    cache = SynthCache(max_bytes=1 << 20, wait_s=5.0)
+    _o, leader = cache.lookup(key_of())
+    _o, follower = cache.lookup(key_of())
+    errs = []
+
+    def consume():
+        try:
+            list(follower)
+        except LeaderFailed as e:
+            errs.append(e)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.05)
+    leader.abort_fill()
+    t.join(5.0)
+    assert len(errs) == 1
+    assert cache.stat("misses") == 2  # the leader's and the follower's
+
+
+def test_follower_wait_is_bounded():
+    """A stalled leader (never commits, never aborts) cannot hold a
+    follower past the per-chunk wait bound."""
+    cache = SynthCache(max_bytes=1 << 20, wait_s=0.2)
+    cache.lookup(key_of())            # leader wedges, never resolves
+    _o, follower = cache.lookup(key_of())
+    t0 = time.monotonic()
+    with pytest.raises(LeaderFailed, match="stalled"):
+        next(follower)
+    assert 0.15 <= time.monotonic() - t0 < 2.0
+
+
+def test_follower_counts_once_at_terminal_state():
+    cache = SynthCache(max_bytes=1 << 20, wait_s=0.1)
+    cache.lookup(key_of())
+    _o, follower = cache.lookup(key_of())
+    with pytest.raises(LeaderFailed):
+        next(follower)
+    with pytest.raises(LeaderFailed):
+        next(follower)  # re-draining the dead follower must not recount
+    assert cache.stat("misses") == 2
+
+
+# ---------------------------------------------------------------------------
+# cache.lookup failpoint: a broken cache can never fail a request
+# ---------------------------------------------------------------------------
+
+def test_lookup_failpoint_error_degrades_to_a_miss():
+    cache = SynthCache(max_bytes=1 << 20)
+    fill_entry(cache, key_of(), [(b"cached", None)])
+    reg = faults.registry()
+    reg.arm("cache.lookup", "error", rate=1.0, max_hits=1)
+    try:
+        outcome, handle = cache.lookup(key_of())
+    finally:
+        reg.disarm("cache.lookup")
+    assert outcome == "bypass" and handle is None
+    assert cache.stat("lookup_errors") == 1
+    # degraded lookups count as misses; the entry itself survives
+    assert cache.stat("misses") == 2
+    assert cache.lookup(key_of())[0] == "hit"
+
+
+# ---------------------------------------------------------------------------
+# env gate + metrics
+# ---------------------------------------------------------------------------
+
+def test_from_env_default_off(monkeypatch):
+    monkeypatch.delenv(sc.CACHE_MB_ENV, raising=False)
+    assert sc.from_env() is None
+    monkeypatch.setenv(sc.CACHE_MB_ENV, "0")
+    assert sc.from_env() is None
+    monkeypatch.setenv(sc.CACHE_MB_ENV, "nope")
+    assert sc.from_env() is None
+    monkeypatch.setenv(sc.CACHE_MB_ENV, "0.5")
+    cache = sc.from_env()
+    assert cache is not None and cache.max_bytes == 512 * 1024
+
+
+def test_bind_metrics_series_and_values():
+    registry = MetricsRegistry()
+    cache = SynthCache(max_bytes=1 << 20)
+    cache.bind_metrics(registry)
+    fill_entry(cache, key_of(), [(b"abc", None)])
+    assert cache.lookup(key_of())[0] == "hit"
+    parsed = parse_prometheus_text(registry.render())
+    assert parsed["sonata_synth_cache_hits_total"][0][1] == 1.0
+    assert parsed["sonata_synth_cache_misses_total"][0][1] == 1.0
+    assert parsed["sonata_synth_cache_inserts_total"][0][1] == 1.0
+    assert parsed["sonata_synth_cache_evictions_total"][0][1] == 0.0
+    assert parsed["sonata_synth_cache_bytes"][0][1] == float(
+        3 + sc.CHUNK_OVERHEAD_BYTES)
+
+
+# ---------------------------------------------------------------------------
+# gRPC wiring (in-process service, Ctx doubles)
+# ---------------------------------------------------------------------------
+
+class Ctx:
+    def __init__(self, request_id=None):
+        self._rid = request_id
+
+    def invocation_metadata(self):
+        return (("x-request-id", self._rid),) if self._rid else ()
+
+    def abort(self, code, msg):
+        raise RuntimeError(f"{code.name}: {msg}")
+
+
+@pytest.fixture
+def cached_service(tmp_path, monkeypatch):
+    from sonata_tpu.frontends import grpc_server as srv
+
+    monkeypatch.setenv(sc.CACHE_MB_ENV, "8")
+    cfg = str(write_tiny_voice(tmp_path))
+    service = srv.SonataGrpcService()
+    assert service.runtime.synth_cache is not None
+    yield service, cfg
+    service.shutdown()
+
+
+def _pb():
+    from sonata_tpu.frontends import grpc_messages as pb
+
+    return pb
+
+
+def test_runtime_cache_default_off(tmp_path, monkeypatch):
+    """SONATA_SYNTH_CACHE_MB unset (the default) leaves the runtime
+    without a cache: every RPC takes the pre-cache body directly."""
+    from sonata_tpu.frontends import grpc_server as srv
+
+    monkeypatch.delenv(sc.CACHE_MB_ENV, raising=False)
+    service = srv.SonataGrpcService()
+    try:
+        assert service.runtime.synth_cache is None
+    finally:
+        service.shutdown()
+
+
+def test_realtime_hit_is_bit_identical_with_cache_hit_span(cached_service):
+    pb = _pb()
+    service, cfg = cached_service
+    info = service.LoadVoice(pb.VoicePath(config_path=cfg), Ctx())
+    req = pb.Utterance(voice_id=info.voice_id,
+                       text="Replay me bit for bit.")
+    miss = [m.wav_samples for m in service.SynthesizeUtteranceRealtime(
+        req, Ctx("sc-miss"))]
+    hit = [m.wav_samples for m in service.SynthesizeUtteranceRealtime(
+        req, Ctx("sc-hit"))]
+    assert miss and hit == miss  # same bytes AND same chunk boundaries
+    tracer = service.runtime.tracer
+    t_hit = next(t for t in tracer.recent_traces()
+                 if t.request_id == "sc-hit")
+    names = t_hit.span_names()
+    assert "cache-hit" in names
+    assert "dispatch" not in names and "phonemize" not in names
+    t_miss = next(t for t in tracer.recent_traces()
+                  if t.request_id == "sc-miss")
+    assert "cache-hit" not in t_miss.span_names()
+
+
+def test_utterance_hit_replays_results_and_rtf(cached_service):
+    pb = _pb()
+    service, cfg = cached_service
+    info = service.LoadVoice(pb.VoicePath(config_path=cfg), Ctx())
+    req = pb.Utterance(voice_id=info.voice_id,
+                       text="One sentence. Two sentences.")
+    miss = [(m.wav_samples, m.rtf)
+            for m in service.SynthesizeUtterance(req, Ctx())]
+    hit = [(m.wav_samples, m.rtf)
+           for m in service.SynthesizeUtterance(req, Ctx())]
+    assert len(miss) == 2 and hit == miss
+
+
+def test_changed_scales_miss_distinct_entry(cached_service):
+    pb = _pb()
+    service, cfg = cached_service
+    info = service.LoadVoice(pb.VoicePath(config_path=cfg), Ctx())
+    cache = service.runtime.synth_cache
+    req = pb.Utterance(voice_id=info.voice_id, text="Scale sensitive.")
+    list(service.SynthesizeUtterance(req, Ctx()))
+    service.SetSynthesisOptions(pb.VoiceSynthesisOptions(
+        voice_id=info.voice_id,
+        synthesis_options=pb.SynthesisOptions(length_scale=1.3)), Ctx())
+    list(service.SynthesizeUtterance(req, Ctx()))
+    # two distinct identities, no cross-hit
+    assert cache.stat("misses") == 2 and cache.stat("hits") == 0
+
+
+def test_single_flight_admits_exactly_one_synthesizer(cached_service):
+    """The acceptance pin: N concurrent identical requests → exactly 1
+    synthesis dispatch; every client gets the identical chunk list."""
+    pb = _pb()
+    service, cfg = cached_service
+    info = service.LoadVoice(pb.VoicePath(config_path=cfg), Ctx())
+    v = service._voices[info.voice_id]
+    real = v.voice.stream_synthesis
+    calls, gate = [], threading.Event()
+
+    def gated(phonemes, chunk_size, chunk_padding, deadline=None):
+        calls.append(1)
+        gate.wait(10.0)
+        return real(phonemes, chunk_size, chunk_padding)
+
+    v.voice.stream_synthesis = gated
+    req = pb.Utterance(voice_id=info.voice_id,
+                       text="Exactly one synthesis, please.")
+    outs, errs = {}, []
+
+    def run(i):
+        try:
+            outs[i] = [m.wav_samples for m in
+                       service.SynthesizeUtteranceRealtime(req, Ctx())]
+        except Exception as e:  # pragma: no cover - failure detail
+            errs.append(e)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)  # all four admitted: 1 leader + 3 followers
+    gate.set()
+    for t in threads:
+        t.join(30.0)
+    assert not errs and len(outs) == 4
+    assert len(calls) == 1  # one real synthesis for four clients
+    assert all(outs[i] == outs[0] and outs[0] for i in outs)
+    cache = service.runtime.synth_cache
+    assert cache.stat("follower_joins") == 3
+
+
+def test_leader_failure_fails_only_leader_followers_recover(
+        cached_service):
+    """Leader failure must not fan out: the leader's client fails
+    typed; followers (no audio emitted yet) each recover via an
+    independent synthesis."""
+    pb = _pb()
+    service, cfg = cached_service
+    info = service.LoadVoice(pb.VoicePath(config_path=cfg), Ctx())
+    v = service._voices[info.voice_id]
+    real = v.voice.stream_synthesis
+    calls, release = [], threading.Event()
+    from sonata_tpu.core import OperationError
+
+    def flaky(phonemes, chunk_size, chunk_padding, deadline=None):
+        calls.append(1)
+        if len(calls) == 1:  # the leader: hold until followers joined
+            release.wait(10.0)
+            raise OperationError("injected leader failure")
+        return real(phonemes, chunk_size, chunk_padding)
+
+    v.voice.stream_synthesis = flaky
+    req = pb.Utterance(voice_id=info.voice_id,
+                       text="Leader fails, followers recover.")
+    results, failures = {}, {}
+
+    def run(i):
+        try:
+            results[i] = [m.wav_samples for m in
+                          service.SynthesizeUtteranceRealtime(req, Ctx())]
+        except RuntimeError as e:
+            failures[i] = str(e)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+    threads[0].start()
+    deadline = time.monotonic() + 5.0
+    while not calls and time.monotonic() < deadline:
+        time.sleep(0.01)  # leader inside the synthesis
+    for t in threads[1:]:
+        t.start()
+    time.sleep(0.3)  # followers joined the filling entry
+    release.set()
+    for t in threads:
+        t.join(30.0)
+    # exactly the leader failed, typed (OperationError → ABORTED)
+    assert list(failures) == [0] and "ABORTED" in failures[0]
+    # every follower recovered with real audio via its own synthesis
+    assert sorted(results) == [1, 2, 3]
+    assert all(results[i] for i in results)
+    assert len(calls) == 4  # 1 failed leader + 3 independent fallbacks
+    # nothing truncated was cached
+    assert service.runtime.synth_cache.entry_count == 0
+
+
+def test_client_disconnect_mid_stream_never_caches(cached_service):
+    pb = _pb()
+    service, cfg = cached_service
+    info = service.LoadVoice(pb.VoicePath(config_path=cfg), Ctx())
+    cache = service.runtime.synth_cache
+    text = ("A much longer sentence with very many words so the chunker "
+            "must produce several chunks for this stream.")
+    req = pb.Utterance(voice_id=info.voice_id, text=text,
+                       realtime_chunk_size=10, realtime_chunk_padding=2)
+    gen = service.SynthesizeUtteranceRealtime(req, Ctx())
+    first = next(gen)
+    assert len(first.wav_samples) > 0
+    gen.close()  # client hangs up mid-stream
+    assert cache.entry_count == 0 and cache.stat("inserts") == 0
+    # the retry is a miss that fills the full stream
+    full = [m.wav_samples for m in
+            service.SynthesizeUtteranceRealtime(req, Ctx())]
+    assert len(full) > 1 and cache.stat("inserts") == 1
+
+
+def test_cache_rows_on_the_scope_plane(cached_service):
+    pb = _pb()
+    service, cfg = cached_service
+    rt = service.runtime
+    assert rt.scope is not None
+    info = service.LoadVoice(pb.VoicePath(config_path=cfg), Ctx())
+    req = pb.Utterance(voice_id=info.voice_id, text="Scope rows.")
+    list(service.SynthesizeUtterance(req, Ctx()))
+    list(service.SynthesizeUtterance(req, Ctx()))
+    doc = rt.scope.quantiles_snapshot()
+    rows = doc.get("synth_cache")
+    assert rows is not None
+    assert rows["hits"] == 1 and rows["misses"] == 1
+    assert rows["hit_ratio"] == 0.5 and rows["bytes"] > 0
+    # the flight recorder carries the hit-ratio probe
+    snap = rt.scope.tick()
+    assert snap.get("cache_hit_ratio") == 0.5
+    assert snap.get("cache_bytes", 0) > 0
+
+
+def test_cancel_flag_truncated_stream_never_commits(cached_service):
+    """Review-pass pin: a client disconnect surfacing as the deadline's
+    cancel flag makes the miss body RETURN normally mid-stream — the
+    wrapper must read that as truncation and abort the fill, never
+    commit the partial chunk list as a hit-able entry."""
+    pb = _pb()
+    service, cfg = cached_service
+    info = service.LoadVoice(pb.VoicePath(config_path=cfg), Ctx())
+    cache = service.runtime.synth_cache
+
+    class CancelCtx(Ctx):
+        def __init__(self):
+            super().__init__()
+            self.callbacks = []
+
+        def add_callback(self, cb):
+            self.callbacks.append(cb)
+            return True
+
+    ctx = CancelCtx()
+    text = ("A much longer sentence with very many words so the chunker "
+            "must produce several chunks before this stream finishes.")
+    req = pb.Utterance(voice_id=info.voice_id, text=text,
+                       realtime_chunk_size=10, realtime_chunk_padding=2)
+    gen = service.SynthesizeUtteranceRealtime(req, ctx)
+    assert len(next(gen).wav_samples) > 0
+    for cb in ctx.callbacks:  # the client hangs up: grpc fires these
+        cb()
+    drained = list(gen)  # body returns early on the cancel flag
+    full = [m.wav_samples for m in
+            service.SynthesizeUtteranceRealtime(req, Ctx())]
+    assert len(drained) + 1 < len(full)  # genuinely truncated mid-way
+    # the truncated stream never committed: the full request above was
+    # a miss that inserted the first COMPLETE entry
+    assert cache.stat("inserts") == 1
+    assert len(full) > 1
+
+
+def test_unload_voice_purges_cached_entries(cached_service):
+    """Review-pass pin: a voice reloaded at the same config path reuses
+    the voice id — UnloadVoice must purge the voice's entries so the
+    new model never replays the old model's audio as hits."""
+    pb = _pb()
+    service, cfg = cached_service
+    info = service.LoadVoice(pb.VoicePath(config_path=cfg), Ctx())
+    cache = service.runtime.synth_cache
+    req = pb.Utterance(voice_id=info.voice_id, text="Purge on unload.")
+    list(service.SynthesizeUtterance(req, Ctx()))
+    assert cache.entry_count == 1
+    service.UnloadVoice(pb.VoiceIdentifier(voice_id=info.voice_id),
+                        Ctx())
+    assert cache.entry_count == 0
+    assert cache.cache_view()["invalidations"] == 1
+    info2 = service.LoadVoice(pb.VoicePath(config_path=cfg), Ctx())
+    assert info2.voice_id == info.voice_id  # same path ⇒ same id
+    list(service.SynthesizeUtterance(req, Ctx()))
+    # the reloaded voice's request was a fresh miss, not a stale hit
+    assert cache.stat("hits") == 0 and cache.stat("misses") == 2
+
+
+def test_drop_tag_invalidates_in_flight_fill():
+    """A fill in flight across drop_tag keeps streaming but must not
+    insert (the unload-mid-fill race)."""
+    cache = SynthCache(max_bytes=1 << 20)
+    _o, handle = cache.lookup(key_of(), tag="voice-1")
+    handle.add_chunk(b"mid-fill")
+    assert cache.drop_tag("voice-1") == 0  # nothing committed yet
+    handle.add_chunk(b"more")
+    handle.commit_fill()
+    assert cache.entry_count == 0 and cache.stat("inserts") == 0
+    assert cache.cache_view()["invalidations"] == 1
+
+
+def test_mid_fill_scale_change_aborts_instead_of_committing(
+        cached_service):
+    """Review-pass pin: the lazy miss path re-reads the live fallback
+    config, so a SetSynthesisOptions landing mid-fill can change the
+    audio after the key was derived — the commit re-derives the key and
+    aborts on drift instead of filing new-scale audio under the old
+    key."""
+    import threading as _threading
+
+    pb = _pb()
+    service, cfg = cached_service
+    info = service.LoadVoice(pb.VoicePath(config_path=cfg), Ctx())
+    cache = service.runtime.synth_cache
+    v = service._voices[info.voice_id]
+    real = v.voice.stream_synthesis
+    entered, release = _threading.Event(), _threading.Event()
+
+    def gated(phonemes, chunk_size, chunk_padding, deadline=None):
+        entered.set()
+        release.wait(10.0)
+        return real(phonemes, chunk_size, chunk_padding)
+
+    v.voice.stream_synthesis = gated
+    req = pb.Utterance(voice_id=info.voice_id, text="Drifting scales.")
+    out = {}
+
+    def run():
+        out["chunks"] = [m.wav_samples for m in
+                         service.SynthesizeUtteranceRealtime(req, Ctx())]
+
+    t = _threading.Thread(target=run)
+    t.start()
+    assert entered.wait(10.0)  # the fill is mid-synthesis
+    service.SetSynthesisOptions(pb.VoiceSynthesisOptions(
+        voice_id=info.voice_id,
+        synthesis_options=pb.SynthesisOptions(length_scale=1.5)), Ctx())
+    release.set()
+    t.join(30.0)
+    assert out["chunks"]           # the stream itself served fine
+    assert cache.entry_count == 0  # but identity drifted: no insert
+    assert cache.stat("inserts") == 0
+
+
+def test_abandoned_follower_counts_as_a_miss():
+    """Review-pass pin: a follower whose client walks away mid-follow
+    resolves exactly once (as a miss) via abandon(), so hits+misses
+    keeps accounting for every resolved lookup."""
+    cache = SynthCache(max_bytes=1 << 20, wait_s=5.0)
+    _o, leader = cache.lookup(key_of())
+    _o, follower = cache.lookup(key_of())
+    leader.add_chunk(b"one")
+    assert next(follower) == (b"one", None)
+    follower.abandon()   # client disconnected mid-follow
+    follower.abandon()   # idempotent
+    assert cache.stat("misses") == 2  # leader's + the abandoned follower
+    leader.commit_fill()
+    assert cache.stat("hits") == 0
+
+
+def test_degraded_lookup_still_serves_over_the_service(cached_service):
+    """The chaos contract at the service layer: an armed cache.lookup
+    error degrades the probe to a miss and the request serves."""
+    pb = _pb()
+    service, cfg = cached_service
+    info = service.LoadVoice(pb.VoicePath(config_path=cfg), Ctx())
+    req = pb.Utterance(voice_id=info.voice_id, text="Degrade, serve.")
+    baseline = [m.wav_samples for m in
+                service.SynthesizeUtteranceRealtime(req, Ctx())]
+    reg = faults.registry()
+    reg.arm("cache.lookup", "error", rate=1.0, max_hits=1)
+    try:
+        served = [m.wav_samples for m in
+                  service.SynthesizeUtteranceRealtime(req, Ctx())]
+    finally:
+        reg.disarm("cache.lookup")
+    assert baseline and served  # degraded probe, request still serves
+    assert service.runtime.synth_cache.stat("lookup_errors") == 1

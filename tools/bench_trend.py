@@ -9,9 +9,11 @@ time-to-ready from the serving smoke's lattice phase — a warmup-cost
 regression is a deploy-latency regression and gets flagged like any
 other), the ``MESH_rNN.json`` fleet-tier artifact (router-hop TTFB
 overhead + the kill-phase reroute/drop counters from
-tools/bench_mesh.py), and the ``FLEET_rNN.json`` fleet-observability
+tools/bench_mesh.py), the ``FLEET_rNN.json`` fleet-observability
 artifact (scope-export scrape cost + the node-side export-enabled
-overhead ratio from tools/bench_fleet.py), but nothing reads them
+overhead ratio from tools/bench_fleet.py), and the ``CACHE_rNN.json``
+synthesis-cache artifact (hit-vs-miss TTFB + Zipf hit ratio from
+``bench_streaming.py --cache-artifact``), but nothing reads them
 *across* revisions — a slow 10% drift
 per PR is invisible until someone diffs artifacts by hand.  This tool:
 
@@ -21,13 +23,19 @@ per PR is invisible until someone diffs artifacts by hand.  This tool:
    preceding revision (direction-aware: TTFB/RTF/overhead down is
    good, audio-throughput up is good; metrics with no known direction
    are reported but never flagged);
-3. writes the machine-readable fold to ``BENCH_TREND.json`` (committed
+3. subtracts the committed **waiver list** (``BENCH_WAIVERS.json``:
+   one entry per historical flag, with the reason the flag is noise
+   rather than a regression) — waived flags are reported separately
+   and never fail the run, while a waiver matching nothing is STALE
+   and fails loudly so the list cannot rot;
+4. writes the machine-readable fold to ``BENCH_TREND.json`` (committed
    like the per-rev artifacts) and prints one markdown table per
    family.
 
-Run: ``python tools/bench_trend.py`` (wired into tools/run_ci_local.sh
-as a *reported, non-blocking* step).  Exit code: 0 clean, 2 when a
-regression was flagged — informational for CI, gating for nobody.
+Run: ``python tools/bench_trend.py`` (a *blocking* CI step since
+ISSUE 15).  Exit code: 0 when every flag is waived and no waiver is
+stale, 2 otherwise — a clean tree exits 0, so only NEW regressions
+(or a rotted waiver list) fail the lane.
 """
 
 from __future__ import annotations
@@ -40,16 +48,19 @@ from typing import Dict, List, Optional
 
 REPO = Path(__file__).resolve().parent.parent
 TREND_PATH = REPO / "BENCH_TREND.json"
+WAIVERS_PATH = REPO / "BENCH_WAIVERS.json"
 REGRESSION_THRESHOLD = 0.20
 
-_REV_RE = re.compile(r"^((?:BENCH|WARMUP|MESH|FLEET)[A-Z_]*)_r(\d+)\.json$")
+_REV_RE = re.compile(
+    r"^((?:BENCH|WARMUP|MESH|FLEET|CACHE)[A-Z_]*)_r(\d+)\.json$")
 
 #: metric-name fragments → comparison direction
 _LOWER_IS_BETTER = ("ttfb", "rtf", "overhead", "latency", "wall",
                     "time_to_ready", "cold_compiles", "padding_ratio",
                     "dropped")
 _HIGHER_IS_BETTER = ("audio_s_per_s", "audio_seconds_per_second",
-                     "throughput", "speedup", "fetch_overlap")
+                     "throughput", "speedup", "fetch_overlap",
+                     "hit_ratio")
 
 
 def direction(metric: str) -> Optional[str]:
@@ -98,7 +109,8 @@ def collect() -> Dict[str, Dict]:
     paths = sorted(list(REPO.glob("BENCH*_r*.json"))
                    + list(REPO.glob("WARMUP_r*.json"))
                    + list(REPO.glob("MESH_r*.json"))
-                   + list(REPO.glob("FLEET_r*.json")))
+                   + list(REPO.glob("FLEET_r*.json"))
+                   + list(REPO.glob("CACHE_r*.json")))
     for path in paths:
         m = _REV_RE.match(path.name)
         if m is None:
@@ -151,6 +163,49 @@ def find_regressions(families: Dict[str, Dict]) -> List[dict]:
     return flags
 
 
+def load_waivers() -> List[dict]:
+    """The committed waiver list: each entry names one historical flag
+    — ``{family, metric, from_rev, to_rev, reason}`` — that review
+    established as host noise (or a deliberately-slow contrast arm),
+    not a regression.  Missing file = no waivers."""
+    try:
+        data = json.loads(WAIVERS_PATH.read_text(encoding="utf-8"))
+    except OSError:
+        return []
+    out = []
+    for entry in data.get("waivers", ()):
+        missing = [k for k in ("family", "metric", "from_rev", "to_rev",
+                               "reason") if not entry.get(k)]
+        if missing:
+            raise ValueError(
+                f"{WAIVERS_PATH.name}: waiver {entry!r} is missing "
+                f"{', '.join(missing)} — every waiver carries the flag "
+                "it covers AND the reason it is noise")
+        out.append(entry)
+    return out
+
+
+def apply_waivers(flags: List[dict], waivers: List[dict]
+                  ) -> tuple:
+    """Split ``flags`` into (active, waived) and return the stale
+    waivers (entries matching no flag — the artifact they excused
+    changed or vanished, so the entry must go)."""
+    def key(d: dict) -> tuple:
+        return (d["family"], d["metric"], d["from_rev"], d["to_rev"])
+
+    by_key = {key(w): w for w in waivers}
+    active, waived, used = [], [], set()
+    for f in flags:
+        w = by_key.get(key(f))
+        if w is None:
+            active.append(f)
+        else:
+            used.add(key(f))
+            waived.append({**f, "reason": w["reason"]})
+    stale = [w for w in waivers if key(w) not in used]
+    return active, waived, stale
+
+
 def _fmt(v: Optional[float]) -> str:
     if v is None:
         return "—"
@@ -159,7 +214,9 @@ def _fmt(v: Optional[float]) -> str:
     return f"{v:.3g}"
 
 
-def markdown(families: Dict[str, Dict], flags: List[dict]) -> str:
+def markdown(families: Dict[str, Dict], flags: List[dict],
+             waived: Optional[List[dict]] = None,
+             stale: Optional[List[dict]] = None) -> str:
     flagged = {(f["family"], f["metric"], f["to_rev"]) for f in flags}
     lines: List[str] = []
     for family, fam in sorted(families.items()):
@@ -188,8 +245,19 @@ def markdown(families: Dict[str, Dict], flags: List[dict]) -> str:
                 f"{f['to_rev']}: {_fmt(f['from'])} → {_fmt(f['to'])} "
                 f"({pct})")
     else:
-        lines.append("No regressions > "
+        lines.append("No unwaived regressions > "
                      f"{REGRESSION_THRESHOLD:.0%} between adjacent revs.")
+    for w in waived or ():
+        pct = ("rose from 0" if w["change_pct"] is None
+               else f"{w['change_pct']:+.1f}%")
+        lines.append(f"- waived: {w['family']} `{w['metric']}` "
+                     f"{w['from_rev']}→{w['to_rev']} ({pct}) — "
+                     f"{w['reason']}")
+    for w in stale or ():
+        lines.append(f"- **STALE waiver**: {w['family']} "
+                     f"`{w['metric']}` {w['from_rev']}→{w['to_rev']} "
+                     "matches no flag — remove it from "
+                     "BENCH_WAIVERS.json")
     return "\n".join(lines)
 
 
@@ -198,19 +266,24 @@ def main(argv=None) -> int:
     if not families:
         print("bench-trend: no BENCH*_r*.json artifacts found")
         return 0
-    flags = find_regressions(families)
+    active, waived, stale = apply_waivers(find_regressions(families),
+                                          load_waivers())
     # no generated-at timestamp: the artifact is committed, and a fresh
     # wall-clock stamp would dirty it on every CI run even when no
     # bench number changed — content is a pure function of the inputs
     TREND_PATH.write_text(json.dumps({
         "regression_threshold": REGRESSION_THRESHOLD,
         "families": families,
-        "regressions": flags,
+        "regressions": active,
+        "waived_regressions": waived,
+        "stale_waivers": stale,
     }, indent=1, sort_keys=True) + "\n", encoding="utf-8")
-    print(markdown(families, flags))
+    print(markdown(families, active, waived, stale))
     print(f"\nbench-trend: wrote {TREND_PATH.name} "
-          f"({len(families)} families, {len(flags)} regression flag(s))")
-    return 2 if flags else 0
+          f"({len(families)} families, {len(active)} regression "
+          f"flag(s), {len(waived)} waived, {len(stale)} stale "
+          "waiver(s))")
+    return 2 if (active or stale) else 0
 
 
 if __name__ == "__main__":

@@ -492,10 +492,12 @@ def main() -> int:
     # deterministic sweep: every registered site fires at least once per
     # run, whatever the random draw skipped (warmup fired in phase B;
     # the mesh.* sites need a router in front of this server — phase M
-    # fires them, and the all-sites check runs after it)
+    # fires them; cache.lookup needs a cache-enabled server — phase CC
+    # fires it; the all-sites check runs after both)
     fired = fires_total()
     for site in faults.SITES:
-        if fired.get(site, 0) > 0 or site.startswith("mesh."):
+        if fired.get(site, 0) > 0 or site.startswith("mesh.") \
+                or site == "cache.lookup":
             continue
         arm_spec(f"{site}:error:1::1")
         if site == "metrics.scrape":
@@ -505,9 +507,10 @@ def main() -> int:
         disarm_all()
         heal_pool()
     fired = fires_total()
-    check("every non-mesh site fired this run",
+    check("every non-mesh, non-cache site fired this run",
           all(fired.get(s, 0) > 0 for s in faults.SITES
-              if not s.startswith("mesh.")), f"({fired})")
+              if not s.startswith("mesh.") and s != "cache.lookup"),
+          f"({fired})")
     _e, _t, results, err = synth(TEXTS[0])
     check("clean request serves after disarm",
           err is None and results and len(results[0].wav_samples) > 0)
@@ -842,8 +845,80 @@ def main() -> int:
     disarm_all()
     prouter.close()
 
+    # ---- phase CC: synthesis cache (ISSUE 15) — the cache.lookup
+    # failpoint must degrade every probe to a normal miss: a broken
+    # cache can NEVER fail a request.  A second in-process server is
+    # booted with SONATA_SYNTH_CACHE_MB armed (the main server runs
+    # cache-off on purpose: the seeded schedule above reuses four
+    # texts, and a cache would dedup them away from the armed sites).
+    os.environ["SONATA_SYNTH_CACHE_MB"] = "4"
+    try:
+        cache_server, cache_port = create_server(
+            0, metrics_port=0, request_timeout_s=REQUEST_TIMEOUT_S)
+    finally:
+        del os.environ["SONATA_SYNTH_CACHE_MB"]
+    cache_server.start()
+    cache_rt = cache_server.sonata_runtime
+    check("cache: runtime constructed the synth cache",
+          cache_rt.synth_cache is not None)
+    cache_channel = grpc.insecure_channel(f"127.0.0.1:{cache_port}")
+    cache_load = cache_channel.unary_unary(
+        "/sonata_grpc.sonata_grpc/LoadVoice",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.VoiceInfo.decode)
+    cache_synth_rpc = cache_channel.unary_stream(
+        "/sonata_grpc.sonata_grpc/SynthesizeUtterance",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.SynthesisResult.decode)
+    cache_info = cache_load(pb.VoicePath(config_path=cfg), timeout=120.0)
+    cache_server.sonata_service.warmup_and_mark_ready()
+
+    def cache_synth(text: str):
+        try:
+            return [r.wav_samples for r in cache_synth_rpc(
+                pb.Utterance(voice_id=cache_info.voice_id, text=text),
+                timeout=RPC_TIMEOUT_S)], None
+        except grpc.RpcError as e:
+            return None, e
+
+    first, err = cache_synth(TEXTS[0])
+    again, err2 = cache_synth(TEXTS[0])
+    check("cache: clean repeat request hits bit-identically",
+          err is None and err2 is None and first and again == first
+          and cache_rt.synth_cache.stat("hits") == 1,
+          f"({cache_rt.synth_cache.cache_view()})")
+    lookups0 = fires_total().get("cache.lookup", 0)
+    arm_spec("cache.lookup:error:1::2")
+    served, err = cache_synth(TEXTS[0])   # cached — but the probe errors
+    check("cache: armed cache.lookup error degrades to a normal miss "
+          "(request still serves)",
+          err is None and served and len(served[0]) > 0,
+          f"({err.code().name if err else 'ok'})")
+    served2, err = cache_synth(TEXTS[1])  # uncached — probe errors too
+    check("cache: degraded probe on an uncached text also serves",
+          err is None and served2 and len(served2[0]) > 0)
+    check("cache: cache.lookup fires counted and degradations visible",
+          fires_total().get("cache.lookup", 0) == lookups0 + 2
+          and cache_rt.synth_cache.stat("lookup_errors") == 2,
+          f"({fires_total()})")
+    disarm_all()
+    served3, err = cache_synth(TEXTS[0])
+    check("cache: disarmed probe hits the surviving entry again",
+          err is None and served3 == first,
+          f"({cache_rt.synth_cache.cache_view()})")
+    cache_channel.close()
+    cache_server.stop(grace=None)
+    cache_server.sonata_service.shutdown()
+    # the cache runtime's construction installed ITS ladder/scope
+    # process-globally (latest wins); re-install the main server's so
+    # the remaining phases observe the plane the earlier ones did
+    degradation_mod.install(runtime.degradation)
+    if runtime.scope is not None:
+        scope_mod.install(runtime.scope)
+
     fired = fires_total()
-    check("every registered site fired this run (mesh sites included)",
+    check("every registered site fired this run (mesh and cache sites "
+          "included)",
           all(fired.get(s, 0) > 0 for s in faults.SITES), f"({fired})")
 
     # ---- phase G: no request outlived its budget; registry symmetry ----

@@ -598,6 +598,122 @@ def main(args=None) -> int:
     server.stop(grace=None)
     server.sonata_service.shutdown()
 
+    # ---- synthesis-cache phase (ISSUE 15): content-addressed replay ----
+    # A fresh server with a deliberately tiny byte budget (~10 KB) so
+    # the over-budget workload below actually evicts.  The contract:
+    # a repeat request replays bit-identical bytes AND chunk
+    # boundaries, hits stamp a cache-hit span and produce ZERO new
+    # dispatch spans, the hit/miss/bytes series populate, hit-ratio
+    # rows ride /debug/quantiles, and eviction is LRU-first.
+    import json
+
+    os.environ["SONATA_SYNTH_CACHE_MB"] = "0.01"
+    try:
+        server, port = create_server(0, metrics_port=0,
+                                     request_timeout_s=60.0)
+    finally:
+        del os.environ["SONATA_SYNTH_CACHE_MB"]
+    server.start()
+    runtime = server.sonata_runtime
+    base = f"http://127.0.0.1:{runtime.http_port}"
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    check("cache: runtime constructed the synth cache",
+          runtime.synth_cache is not None)
+    info = unary("LoadVoice", pb.VoicePath(config_path=cfg), pb.VoiceInfo)
+    server.sonata_service.warmup_and_mark_ready()
+    code, _ = http_get(base + "/readyz")
+    check("cache: readyz 200 after warmup", code == 200, f"(code {code})")
+    realtime = channel.unary_stream(
+        "/sonata_grpc.sonata_grpc/SynthesizeUtteranceRealtime",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.WaveSamples.decode)
+    synthesize = channel.unary_stream(
+        "/sonata_grpc.sonata_grpc/SynthesizeUtterance",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.SynthesisResult.decode)
+
+    def cache_metrics() -> dict:
+        parsed = parse_prometheus_text(http_get(base + "/metrics")[1])
+        return {name[len("sonata_synth_cache_"):]: sum(
+                    v for _l, v in parsed.get(name, []))
+                for name in ("sonata_synth_cache_hits_total",
+                             "sonata_synth_cache_misses_total",
+                             "sonata_synth_cache_inserts_total",
+                             "sonata_synth_cache_evictions_total",
+                             "sonata_synth_cache_bytes")}
+
+    def dispatches_total() -> int:
+        code, body = http_get(base + "/debug/buckets")
+        # loud, not a sentinel: -1 == -1 would make the zero-dispatch
+        # check below pass vacuously on a broken debug endpoint
+        assert code == 200, f"/debug/buckets answered {code}"
+        return json.loads(body)["dispatches_total"]
+
+    cache_req = pb.Utterance(voice_id=info.voice_id,
+                             text="Cache this exact stream.")
+    miss_chunks = [c.wav_samples for c in realtime(
+        cache_req, metadata=(("x-request-id", "cache-miss-1"),))]
+    d_after_miss = dispatches_total()
+    hit_chunks = [c.wav_samples for c in realtime(
+        cache_req, metadata=(("x-request-id", "cache-hit-1"),))]
+    check("cache: hit replays bit-identical bytes and chunk boundaries",
+          bool(miss_chunks) and hit_chunks == miss_chunks,
+          f"({len(miss_chunks)} vs {len(hit_chunks)} chunks)")
+    check("cache: hit produced zero new device dispatches",
+          dispatches_total() == d_after_miss,
+          f"({d_after_miss} -> {dispatches_total()})")
+    code, body = http_get(base + "/debug/traces")
+    traces = json.loads(body).get("traces", []) if code == 200 else []
+    t_hit = next((t for t in traces
+                  if t["request_id"] == "cache-hit-1"), None)
+    hit_names = {s["name"] for s in (t_hit or {}).get("spans", [])}
+    check("cache: hit trace stamps a cache-hit span",
+          t_hit is not None and "cache-hit" in hit_names,
+          f"({sorted(hit_names)})")
+    check("cache: hit trace carries zero dispatch spans",
+          t_hit is not None and "dispatch" not in hit_names
+          and "phonemize" not in hit_names)
+    # utterance mode: repeat request, bit-identical WAV bytes
+    utt_req = pb.Utterance(voice_id=info.voice_id,
+                           text="Utterance replay. Second sentence.")
+    utt_miss = [(r.wav_samples, r.rtf) for r in synthesize(utt_req)]
+    utt_hit = [(r.wav_samples, r.rtf) for r in synthesize(utt_req)]
+    check("cache: utterance hit is bit-identical WAV bytes hit-vs-miss",
+          len(utt_miss) == 2 and utt_hit == utt_miss)
+    m = cache_metrics()
+    check("cache: hit/miss/insert/bytes metrics populated",
+          m["hits_total"] >= 2 and m["misses_total"] >= 2
+          and m["inserts_total"] >= 2 and m["bytes"] > 0, f"({m})")
+    code, body = http_get(base + "/debug/quantiles")
+    qdoc = json.loads(body) if code == 200 else {}
+    crows = qdoc.get("synth_cache") or {}
+    check("cache: hit-ratio rows on the scope plane",
+          crows.get("hit_ratio") is not None
+          and crows.get("bytes", 0) > 0, f"({crows})")
+    # over-budget workload: distinct texts past the ~10 KB budget must
+    # evict LRU-first — the oldest entry misses again, the newest hits
+    evict_reqs = [pb.Utterance(voice_id=info.voice_id,
+                               text=f"Evict workload sentence {i}.")
+                  for i in range(8)]
+    for r in evict_reqs:
+        list(realtime(r))
+    m = cache_metrics()
+    check("cache: over-budget workload evicted entries",
+          m["evictions_total"] >= 1
+          and m["bytes"] <= 0.01 * 1024 * 1024, f"({m})")
+    before = cache_metrics()
+    list(realtime(evict_reqs[0]))   # the oldest: evicted ⇒ a miss
+    mid = cache_metrics()
+    list(realtime(evict_reqs[-1]))  # the newest: resident ⇒ a hit
+    after = cache_metrics()
+    check("cache: eviction is LRU-first (oldest misses, newest hits)",
+          mid["misses_total"] == before["misses_total"] + 1
+          and after["hits_total"] == mid["hits_total"] + 1,
+          f"({before} -> {mid} -> {after})")
+
+    server.stop(grace=None)
+    server.sonata_service.shutdown()
+
     # ---- iteration-mode phase (PR 10): continuous batching ----
     # A real SUBPROCESS boot (the mode + full-lattice env must be set
     # before the process's first compile) with SONATA_BATCH_MODE=
