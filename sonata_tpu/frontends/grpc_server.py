@@ -458,20 +458,14 @@ class SonataGrpcService:
         sc = v.voice.get_fallback_synthesis_config()
         sid = sc.speaker[1] if sc.speaker else None
         info = v.voice.audio_output_info()
-        sa = request.speech_args
-        realtime = kind == "realtime"
-        return synthcache.request_key(
-            rpc=kind, text=request.text, voice_id=v.voice_id, speaker=sid,
+        # the request-shape half of the derivation is shared with the
+        # mesh router's affinity tier (synthcache.utterance_key), so the
+        # two sides cannot drift on how an Utterance maps into the key
+        return synthcache.utterance_key(
+            kind, request, voice_id=v.voice_id, speaker=sid,
             length_scale=sc.length_scale, noise_scale=sc.noise_scale,
             noise_w=sc.noise_w, sample_rate=info.sample_rate,
-            sample_width=info.sample_width, channels=info.num_channels,
-            mode=request.synthesis_mode or 0,
-            chunk_size=(request.realtime_chunk_size or 55) if realtime
-            else 0,
-            chunk_padding=(request.realtime_chunk_padding or 3)
-            if realtime else 0,
-            speech_args=None if sa is None else (
-                sa.rate, sa.volume, sa.pitch, sa.appended_silence_ms))
+            sample_width=info.sample_width, channels=info.num_channels)
 
     def _cached_stream(self, cache, request, context, *, rpc: str,
                        kind: str, body, to_msg, payload_of):

@@ -67,8 +67,10 @@ from ..serving import (
     Overloaded,
     ServingRuntime,
     faults,
+    synthcache,
     tracing,
 )
+from ..serving import fleetcache as fleetcache_mod
 from ..serving.fleetscope import FleetScope
 from ..serving.logs import configure_logging
 from ..serving.mesh import MeshRouter, parse_backends, resolve_node_id
@@ -158,6 +160,7 @@ class SonataMeshService:
                  runtime: Optional[ServingRuntime] = None):
         self.router = router
         self.runtime = runtime if runtime is not None else ServingRuntime()
+        self.fleetcache = None  # built after the fleet scope (ISSUE 16)
         self._channels: dict = {}
         #: (addr, method) -> stream multicallable: building one per
         #: request costs real TTFB on the hop (measured by bench_mesh)
@@ -198,12 +201,24 @@ class SonataMeshService:
         self.fleet.bind_metrics(rt.registry)
         rt.fleet = self.fleet  # the HTTP plane serves /debug/fleet
         self.fleet.start()
+        #: sonata-fleetcache (ISSUE 16): cache-affinity routing, router
+        #: single-flight, and hot-set replication.  Opt-in via
+        #: SONATA_FLEETCACHE=1 — off, the router's routing decisions and
+        #: stream path are byte-for-byte the PR-12 ones.
+        if fleetcache_mod.resolve_enabled():
+            self.fleetcache = fleetcache_mod.FleetCache(
+                router, fleet=self.fleet)
+            self.fleetcache.set_replicate_transport(self._replicate_stream)
+            router.attach_fleetcache(self.fleetcache)
+            self.fleetcache.bind_metrics(rt.registry)
 
     # -- placement replay transport (the plane's apply_* callables) ----------
     def _apply_load(self, node, config_path: str):
-        return self._call_unary(
+        info = self._call_unary(
             node, "LoadVoice", pb.VoicePath(config_path=config_path),
             pb.VoiceInfo, 600.0)  # a replayed load may compile cold
+        self._learn_voice(info)
+        return info
 
     def _apply_unload(self, node, voice_id: str) -> None:
         try:
@@ -217,10 +232,31 @@ class SonataMeshService:
                 raise  # already gone there == retired
 
     def _apply_options(self, node, payload: bytes):
-        return self._call_unary(
-            node, "SetSynthesisOptions",
-            pb.VoiceSynthesisOptions.decode(payload),
-            pb.SynthesisOptions, 30.0)
+        req = pb.VoiceSynthesisOptions.decode(payload)
+        resp = self._call_unary(node, "SetSynthesisOptions", req,
+                                pb.SynthesisOptions, 30.0)
+        # a replayed option change moves the node's cache key: keep the
+        # router's per-voice key inputs in lock-step (ISSUE 16)
+        if self.fleetcache is not None and resp is not None:
+            self.fleetcache.update_options(req.voice_id, resp)
+        return resp
+
+    # -- fleet-cache plumbing (serving/fleetcache.py, ISSUE 16) --------------
+    def _replicate_stream(self, node, rpc_name: str, payload: bytes,
+                          key: str) -> None:
+        """Replay a remembered synthesis request to ``node`` so its
+        PR-15 cache warms the template (hot-set replication transport).
+        The audio is drained and dropped — the side effect is the fill."""
+        fn = self._stream_stub(node, rpc_name)
+        md = (("x-request-id", f"replicate-{key[:12]}"),)
+        for _ in fn(payload, timeout=60.0, metadata=md):
+            pass
+
+    def _learn_voice(self, info) -> None:
+        """Teach the affinity tier a voice's cache-key inputs from a
+        node's VoiceInfo response (scales, speaker map, audio shape)."""
+        if self.fleetcache is not None and info is not None:
+            self.fleetcache.learn_voice(info)
 
     def _register_metrics(self) -> None:
         r = self.runtime.registry
@@ -381,6 +417,7 @@ class SonataMeshService:
             context.abort(grpc.StatusCode.UNAVAILABLE,
                           f"mesh {self.router.name!r}: no reachable "
                           "backend node to load the voice on")
+        self._learn_voice(info)
         return info
 
     def UnloadVoice(self, request: pb.VoiceIdentifier,
@@ -423,6 +460,8 @@ class SonataMeshService:
             self.placement.forget_unload(vid)
             context.abort(grpc.StatusCode.NOT_FOUND,
                           f"no voice with id {vid}")
+        if self.fleetcache is not None:
+            self.fleetcache.forget_voice(vid)
         return pb.Empty()
 
     def SetSynthesisOptions(self, request: pb.VoiceSynthesisOptions,
@@ -436,9 +475,12 @@ class SonataMeshService:
         PR-12 fan-out path."""
         vid = request.voice_id
         if not self.placement.has_voice(vid):
-            return self._fanout("SetSynthesisOptions", request,
+            last = self._fanout("SetSynthesisOptions", request,
                                 pb.SynthesisOptions, context,
                                 timeout_s=30.0)
+            if self.fleetcache is not None and last is not None:
+                self.fleetcache.update_options(vid, last)
+            return last
         self.runtime.drain.raise_if_draining()
         last, last_err = None, None
         applied_nodes = []
@@ -468,6 +510,8 @@ class SonataMeshService:
         self.placement.record_options(vid, request.encode())
         for node in applied_nodes:
             self.placement.note_applied(node, vid)
+        if self.fleetcache is not None:
+            self.fleetcache.update_options(vid, last)
         return last
 
     def _forward_one(self, name: str, request, resp_cls, context,
@@ -547,24 +591,88 @@ class SonataMeshService:
                         return fn(payload, timeout=timeout_s,
                                   metadata=md)
 
-                    first = True
-                    with tracing.span("stream-emit") as emit_sp:
-                        n_chunks = 0
-                        for chunk in self.router.route_stream(
-                                start, deadline=deadline,
-                                request_id=rid,
-                                classify=_classify_rpc_error,
-                                voice=request.voice_id or None):
-                            n_chunks += 1
-                            if first:
-                                first = False
-                                ttfb = time.monotonic() - t0
-                                rt.ttfb.observe(ttfb)
-                                emit_sp.annotate(
-                                    ttfb_ms=round(ttfb * 1e3, 3))
-                            yield chunk
-                        emit_sp.annotate(chunks=n_chunks)
-                    rt.synth_latency.observe(time.monotonic() - t0)
+                    # fleet cache tier (ISSUE 16): derive the PR-15
+                    # canonical key at the router.  ckey is None when
+                    # the tier is off, the voice is unknown/uncacheable,
+                    # or derivation failed — every None keeps the PR-12
+                    # routing and stream path byte-for-byte.
+                    fc = self.router.fleetcache
+                    ckey = None
+                    if fc is not None:
+                        kind = ("realtime"
+                                if name == "SynthesizeUtteranceRealtime"
+                                else "utterance")
+                        ckey = fc.routing_key(kind, request)
+                    outcome, flight = "bypass", None
+                    if fc is not None and ckey is not None:
+                        # remember the encoded request so hot-set
+                        # replication can replay it to a peer later
+                        fc.note_payload(ckey, name, payload)
+                        outcome, flight = fc.begin_stream(ckey)
+                    if outcome == "follow":
+                        # router single-flight follower: ride the
+                        # leader's fill instead of re-synthesizing
+                        n = 0
+                        try:
+                            with tracing.span("fleetcache-follow") as fsp:
+                                first = True
+                                for chunk, _aux in flight:
+                                    n += 1
+                                    if first:
+                                        first = False
+                                        ttfb = time.monotonic() - t0
+                                        rt.ttfb.observe(ttfb)
+                                        fsp.annotate(
+                                            ttfb_ms=round(ttfb * 1e3, 3))
+                                    yield chunk
+                                fsp.annotate(chunks=n)
+                            rt.synth_latency.observe(
+                                time.monotonic() - t0)
+                            return
+                        except synthcache.LeaderFailed:
+                            if n > 0:
+                                # audio already streamed: the client
+                                # stream is poisoned, fail typed (the
+                                # never-resend-after-first-chunk rule)
+                                raise
+                            # leader died before our first chunk: fall
+                            # through to an independent routed synth
+                        finally:
+                            flight.abandon()
+
+                    fill = flight if outcome == "fill" else None
+                    committed = False
+                    try:
+                        first = True
+                        with tracing.span("stream-emit") as emit_sp:
+                            n_chunks = 0
+                            for chunk in self.router.route_stream(
+                                    start, deadline=deadline,
+                                    request_id=rid,
+                                    classify=_classify_rpc_error,
+                                    voice=request.voice_id or None,
+                                    affinity_key=ckey):
+                                n_chunks += 1
+                                if first:
+                                    first = False
+                                    ttfb = time.monotonic() - t0
+                                    rt.ttfb.observe(ttfb)
+                                    emit_sp.annotate(
+                                        ttfb_ms=round(ttfb * 1e3, 3))
+                                if fill is not None:
+                                    fill.add_chunk(chunk)
+                                yield chunk
+                            emit_sp.annotate(chunks=n_chunks)
+                        if fill is not None:
+                            fill.commit_fill()
+                            committed = True
+                        rt.synth_latency.observe(time.monotonic() - t0)
+                    finally:
+                        if fill is not None and not committed:
+                            # error, deadline, or client hangup
+                            # (GeneratorExit): wake followers so they
+                            # fall back instead of waiting out the clock
+                            fill.abort_fill()
                     if served[0] is not None:
                         # forward the serving node's identity to OUR
                         # client, like the backend does for us — a
@@ -626,6 +734,8 @@ class SonataMeshService:
                      waited_ms=round((time.monotonic() - t0) * 1e3, 1),
                      stragglers=rt.admission.in_flight)
         self.router.close()
+        if self.fleetcache is not None:
+            self.fleetcache.close()  # wakes single-flight followers
         self.fleet.close()
         self.placement.close()
         self.unregister_node_series()
@@ -641,6 +751,8 @@ class SonataMeshService:
         self.runtime.drain.begin("shutdown")
         self.runtime.health.set_not_ready("shutting down")
         self.router.close()
+        if self.fleetcache is not None:
+            self.fleetcache.close()
         self.fleet.close()
         self.placement.close()
         self.unregister_node_series()

@@ -31,6 +31,10 @@ exercised by at least one test):
   (an injected error counts toward that node's breaker on its own
   consecutive reconcile-failure counter — separate, so probe successes
   cannot launder it; a hang stalls only that node's prober thread);
+- ``mesh.cache_affinity`` — inside the mesh router's cache-key
+  derivation / affinity pick (``serving/fleetcache.py``): an injected
+  error degrades that request to plain least-outstanding routing — a
+  broken affinity tier can never fail a request;
 - ``cache.lookup``        — inside every synthesis-cache probe
   (``serving/synthcache.py``): an injected error degrades that lookup
   to a normal miss — a broken cache can never fail a request.
@@ -96,6 +100,7 @@ SITES = (
     "mesh.route",
     "mesh.health",
     "mesh.reconcile",
+    "mesh.cache_affinity",
     "cache.lookup",
 )
 

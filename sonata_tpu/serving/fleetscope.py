@@ -151,12 +151,13 @@ class _NodeScope:
 
     __slots__ = ("node_id", "scraped_mono", "wall_offset_s", "rtt_s",
                  "export_bytes", "stage_rings", "slo_rings", "totals",
-                 "top_waste_buckets")
+                 "top_waste_buckets", "synth_cache")
 
     def __init__(self, node_id: str, scraped_mono: float,
                  wall_offset_s: float, rtt_s: float, export_bytes: int,
                  stage_rings: dict, slo_rings: dict, totals: dict,
-                 top_waste_buckets: list):
+                 top_waste_buckets: list,
+                 synth_cache: Optional[dict] = None):
         self.node_id = node_id
         self.scraped_mono = scraped_mono
         #: node wall clock minus router wall clock, measured against the
@@ -170,6 +171,10 @@ class _NodeScope:
         self.slo_rings = slo_rings
         self.totals = totals
         self.top_waste_buckets = top_waste_buckets
+        #: the node's synthcache view (hit counters, bytes, hot_keys) —
+        #: None on cache-off nodes; the fleet-cache replication pass
+        #: reads hot_keys from here via node_cache_view
+        self.synth_cache = synth_cache
 
 
 class FleetScope:
@@ -411,7 +416,10 @@ class FleetScope:
             slo_rings=slo_rings,
             totals=dict(payload.get("totals") or {}),
             top_waste_buckets=list(payload.get("top_waste_buckets")
-                                   or ()))
+                                   or ()),
+            synth_cache=(dict(payload["synth_cache"])
+                         if isinstance(payload.get("synth_cache"), dict)
+                         else None))
         with self._lock:
             self._nodes[node.index] = ns
             self._no_scope.discard(node.index)
@@ -600,6 +608,8 @@ class FleetScope:
                 entry["delta_p99_5m"] = {
                     stage: _round6(self.node_delta(node, stage))
                     for stage in STAGES}
+                if ns.synth_cache is not None:
+                    entry["synth_cache"] = ns.synth_cache
             nodes_out.append(entry)
         fleet_quant = {
             stage: {window: self._merged(stage, window).to_dict()
@@ -619,6 +629,13 @@ class FleetScope:
         plane = getattr(self.router, "placement", None)
         placement = (plane.placement_view() if plane is not None
                      else None)
+        # fleet cache tier (ISSUE 16): the router-side affinity/
+        # replication view plus the node cache-counter rollup — one
+        # /debug/fleet load answers "is the fleet cache working"
+        fleetcache = getattr(self.router, "fleetcache", None)
+        cache_rollup = self._cache_rollup(by_index.values())
+        if fleetcache is not None:
+            cache_rollup["router"] = fleetcache.snapshot()
         return {
             "name": view["name"],
             "routable": view["routable"],
@@ -631,9 +648,40 @@ class FleetScope:
                 "nodes_reporting": len(by_index),
                 "stage_quantiles": fleet_quant,
                 "slo": fleet_slo,
+                "cache": cache_rollup,
                 "top_waste_buckets": self._merged_waste_rows(
                     by_index.values()),
             }}
+
+    # -- fleet cache rollup (ISSUE 16) -----------------------------------------
+    def node_cache_view(self, node) -> Optional[dict]:
+        """The node's last-scraped synthcache view (None before one
+        lands or on cache-off nodes) — the fleet-cache replication
+        pass reads ``hot_keys`` from here."""
+        with self._lock:
+            ns = self._nodes.get(node.index)
+        return None if ns is None else ns.synth_cache
+
+    @staticmethod
+    def _cache_rollup(node_scopes) -> dict:
+        """Sum the reporting nodes' cache counters into the fleet view:
+        fleet hit ratio (total hits over total resolved lookups),
+        resident bytes/entries, and the reporting population."""
+        hits = misses = bytes_used = entries = with_cache = 0
+        for ns in node_scopes:
+            sc = ns.synth_cache
+            if not sc:
+                continue
+            with_cache += 1
+            hits += int(sc.get("hits") or 0)
+            misses += int(sc.get("misses") or 0)
+            bytes_used += int(sc.get("bytes") or 0)
+            entries += int(sc.get("entries") or 0)
+        total = hits + misses
+        return {"nodes_with_cache": with_cache, "hits": hits,
+                "misses": misses, "bytes": bytes_used,
+                "entries": entries,
+                "hit_ratio": (round(hits / total, 6) if total else None)}
 
     def _burn_of(self, ns: _NodeScope, spec) -> Optional[float]:
         g, b = self._node_totals(ns, spec.name, FAST_WINDOW[0])

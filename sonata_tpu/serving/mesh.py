@@ -393,6 +393,10 @@ class MeshRouter:
         #: attached voice-placement plane (ISSUE 14) — reconciles on
         #: the prober threads, restricts voice-aware routing
         self._placement = None
+        #: attached fleet cache tier (ISSUE 16) — biases routing of
+        #: cacheable requests toward their rendezvous owner, replicates
+        #: hot entries on the prober threads; None costs one read
+        self._fleetcache = None
         self._probers: list = []
         if start_probers:
             for node in self.nodes:
@@ -440,6 +444,26 @@ class MeshRouter:
     @property
     def placement(self):
         return self._placement
+
+    # -- fleet cache attachment (ISSUE 16) ------------------------------------
+    def attach_fleetcache(self, fleetcache) -> None:
+        """Attach the fleet cache tier: ``pick(affinity_key=...)``
+        consults it for the rendezvous owner of a cacheable request,
+        and each node's prober calls
+        ``fleetcache.on_probe_cycle(node)`` after every health cycle
+        (hot-set replication rides the prober threads at the tier's own
+        slower cadence, like the placement reconciler)."""
+        self._fleetcache = fleetcache
+
+    @property
+    def fleetcache(self):
+        return self._fleetcache
+
+    def routable_nodes(self) -> list:
+        """Snapshot of the nodes currently accepting traffic (the
+        replication pass targets peers from this list)."""
+        with self._lock:
+            return [n for n in self.nodes if self._routable_locked(n)]
 
     def voice_load_view(self, node: MeshNode) -> tuple:
         """(actual loaded-voice set or None, per-voice router-side
@@ -735,6 +759,16 @@ class MeshRouter:
                     log.exception(
                         "mesh %s: placement reconcile error (node %s)",
                         self.name, node.node_id)
+            fleetcache = self._fleetcache
+            if fleetcache is not None:
+                try:
+                    # replication is advisory anti-entropy: failures
+                    # are counted inside, this guard catches tier bugs
+                    fleetcache.on_probe_cycle(node)
+                except Exception:
+                    log.exception(
+                        "mesh %s: fleet-cache replication error "
+                        "(node %s)", self.name, node.node_id)
             self._wake.wait(timeout=self.probe_interval_s)
 
     # -- routing --------------------------------------------------------------
@@ -754,7 +788,8 @@ class MeshRouter:
         return (node.outstanding, -self._headroom(node), node.index)
 
     def pick(self, exclude: tuple = (),
-             voice: Optional[str] = None) -> MeshNode:
+             voice: Optional[str] = None,
+             affinity_key: Optional[str] = None) -> MeshNode:
         """Reserve the best routable node (caller must :meth:`release`).
 
         A half-open node with nothing outstanding takes the request as
@@ -762,9 +797,14 @@ class MeshRouter:
         attached, candidates are restricted to converged holders of
         that voice; zero converged holders of a known voice raises the
         typed :class:`VoiceWarming` refusal (``route_stream`` absorbs
-        it with the bounded placement wait).  Raises typed
-        :class:`Draining` when every candidate is mid-deploy,
-        :class:`Overloaded` when none is healthy."""
+        it with the bounded placement wait).  With ``affinity_key`` set
+        and a fleet cache attached, the key's rendezvous owner among
+        the healthy candidates wins (unless its load skew trips the
+        guard) — trial precedence and every exclusion/restriction
+        above still apply, so affinity only ever biases WITHIN the
+        routable set.  Raises typed :class:`Draining` when every
+        candidate is mid-deploy, :class:`Overloaded` when none is
+        healthy."""
         with self._lock:
             allowed = None
             if voice is not None and self._placement is not None:
@@ -805,6 +845,11 @@ class MeshRouter:
                     f"mesh {self.name!r}: no healthy node available "
                     f"({sum(1 for n in self.nodes if self._routable_locked(n))}"
                     f" of {len(self.nodes)} routable)")
+            if affinity_key is not None and self._fleetcache is not None:
+                choice = self._fleetcache.affinity_choice_locked(
+                    affinity_key, routable)
+                if choice is not None:
+                    return self._reserve_locked(choice, voice)
             best = min(routable, key=self._rank_locked)
             return self._reserve_locked(best, voice)
 
@@ -887,7 +932,8 @@ class MeshRouter:
                      deadline: Optional[Deadline] = None,
                      request_id: Optional[str] = None,
                      classify: Optional[Callable] = None,
-                     voice: Optional[str] = None) -> Iterator:
+                     voice: Optional[str] = None,
+                     affinity_key: Optional[str] = None) -> Iterator:
         """Route one streaming request across the fleet; yields chunks.
 
         ``start(node, timeout_s)`` opens the stream on ``node`` and
@@ -902,7 +948,11 @@ class MeshRouter:
         separate from the retry budget — a warming voice is not a
         fault) before failing typed.  The caller holds its own
         admission slot; this method holds the per-node outstanding
-        count.
+        count.  ``affinity_key`` (the fleet cache tier) biases every
+        attempt's pick toward the key's rendezvous owner — on failover
+        the dead owner sits in the exclusion list, so HRW over the
+        remaining nodes lands on the key's next preference, which is
+        exactly the hot-set replication peer.
         """
         classify = classify if classify is not None else default_classify
         tried: list = []
@@ -914,7 +964,8 @@ class MeshRouter:
             if deadline is not None:
                 deadline.raise_if_expired()
             try:
-                node = self.pick(exclude=tuple(tried), voice=voice)
+                node = self.pick(exclude=tuple(tried), voice=voice,
+                                 affinity_key=affinity_key)
             except VoiceWarming as e:
                 now = time.monotonic()
                 if warming_until is None:

@@ -123,6 +123,40 @@ class VoiceWarming(OperationError):
     — clients retry, exactly like a ``draining`` refusal."""
 
 
+class ProbeCadence:
+    """Per-node cadence gate for work that rides the mesh prober
+    threads at a slower interval than the health probe itself.
+
+    The prober calls its plane hooks every ``probe_interval_s``; a
+    plane that wants its own (slower) cadence per node gates each call
+    through :meth:`due`.  Factored out of this module's reconciler so
+    the anti-entropy passes that ride the probers — voice-placement
+    reconcile (here) and hot-set cache replication
+    (``serving/fleetcache.py``) — share one gating implementation.
+    Thread-safe: each prober thread gates its own node, but membership
+    churn can interleave indexes."""
+
+    __slots__ = ("interval_s", "_clock", "_lock", "_attempt_at")
+
+    def __init__(self, interval_s: float, clock=None):
+        self.interval_s = float(interval_s)
+        self._clock = clock if clock is not None else time.monotonic
+        self._lock = threading.Lock()
+        #: node index -> monotonic stamp of the last gated attempt
+        self._attempt_at: Dict[int, float] = {}
+
+    def due(self, index: int) -> bool:
+        """True (and stamp the attempt) when ``index``'s cadence has
+        elapsed — the first call for a node is always due."""
+        now = self._clock()
+        with self._lock:
+            last = self._attempt_at.get(index)
+            if last is None or now - last >= self.interval_s:
+                self._attempt_at[index] = now
+                return True
+            return False
+
+
 class _DesiredVoice:
     """One voice's desired state: config path to replay loads from,
     the last recorded synthesis-options payload, and revisions."""
@@ -201,8 +235,9 @@ class PlacementPlane:
         self._applied_opts: Dict[tuple, int] = {}
         #: voice_id -> monotonic stamp of the last pick (the LRU clock)
         self._last_used: Dict[str, float] = {}
-        #: node index -> monotonic stamp of the last reconcile attempt
-        self._attempt_at: Dict[int, float] = {}
+        #: per-node reconcile cadence riding the prober threads
+        self._cadence = ProbeCadence(self.reconcile_interval_s,
+                                     clock=self._clock)
         self.stats = {"cycles": 0, "reconcile_failures": 0,
                       "op_failures": 0, "ops_load": 0, "ops_unload": 0,
                       "ops_set_options": 0, "evictions_ram_budget": 0,
@@ -454,14 +489,7 @@ class PlacementPlane:
         """Called by the router's prober after every health cycle:
         run one reconcile cycle for ``node`` when the (slower)
         reconcile cadence is due."""
-        now = self._clock()
-        with self._lock:
-            last = self._attempt_at.get(node.index)
-            due = (last is None
-                   or now - last >= self.reconcile_interval_s)
-            if due:
-                self._attempt_at[node.index] = now
-        if due:
+        if self._cadence.due(node.index):
             self.run_cycle(node)
 
     def run_cycle(self, node) -> bool:

@@ -731,7 +731,7 @@ class Scope:
                     self._buckets.items(),
                     key=lambda kv: kv[1]["waste_seconds"],
                     reverse=True)[:8]]
-        return {
+        doc = {
             "v": EXPORT_VERSION,
             "wall_time": time.time(),
             "windows": [label for label, _s, _n in WINDOWS],
@@ -747,6 +747,14 @@ class Scope:
             "slo_table": [spec.to_dict() for spec in self.slos],
             "totals": totals,
             "top_waste_buckets": top_rows}
+        # the synthesis cache's view (hit counters, byte usage, and the
+        # hot_keys LRU head the fleet-cache replication pass consumes)
+        # rides the same export; absent on cache-off nodes — importers
+        # ignore unknown/missing keys, so no EXPORT_VERSION bump
+        cache = self.cache_snapshot()
+        if cache is not None:
+            doc["synth_cache"] = cache
+        return doc
 
     def timeline_chrome(self) -> dict:
         """Counter-track export: load next to ``/debug/traces``' chrome

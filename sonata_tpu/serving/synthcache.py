@@ -57,6 +57,7 @@ from __future__ import annotations
 import hashlib
 import logging
 import os
+import struct
 import threading
 import time
 import unicodedata
@@ -70,6 +71,7 @@ log = logging.getLogger("sonata.serving")
 
 CACHE_MB_ENV = "SONATA_SYNTH_CACHE_MB"
 CACHE_WAIT_S_ENV = "SONATA_SYNTH_CACHE_WAIT_S"
+CASEFOLD_ENV = "SONATA_SYNTH_CACHE_CASEFOLD"
 
 DEFAULT_WAIT_S = 10.0
 #: per-chunk bookkeeping estimate added to the payload length so a
@@ -78,8 +80,17 @@ CHUNK_OVERHEAD_BYTES = 64
 
 #: key-schema version: bump whenever the canonical tuple changes shape,
 #: so stale cross-process assumptions about identity fail to collide
-#: instead of colliding wrong
-KEY_VERSION = "v1"
+#: instead of colliding wrong.  v2: the voice scales are canonicalized
+#: through float32 (the wire precision of SynthesisOptions), so a key
+#: derived at the mesh router from wire-learned options is byte-identical
+#: to the node's key derived from its float64 config.
+KEY_VERSION = "v2"
+
+#: how many LRU-head keys :meth:`SynthCache.cache_view` advertises for
+#: fleet hot-set replication (sonata-fleetcache) — a view shape, not a
+#: replication policy (``SONATA_FLEETCACHE_REPLICATE_K`` bounds how many
+#: the router actually replicates)
+HOT_KEYS_MAX = 16
 
 _FILLING, _COMPLETE, _FAILED = "filling", "complete", "failed"
 
@@ -94,6 +105,23 @@ class LeaderFailed(OperationError):
     wait) while this follower was streaming from its filling entry."""
 
 
+def resolve_casefold() -> bool:
+    """``SONATA_SYNTH_CACHE_CASEFOLD`` (the one default-defining read):
+    1 / unset / unparseable = casefold (the PR-15 behavior), 0 = keep
+    case as part of textual identity.  Read at canonicalization time so
+    the trade-off can be flipped per process without a restart dance in
+    tests."""
+    raw = os.environ.get(CASEFOLD_ENV, "").strip()
+    if not raw:
+        return True
+    try:
+        return int(raw) != 0
+    except ValueError:
+        log.warning("ignoring non-numeric %s=%r (casefold stays on)",
+                    CASEFOLD_ENV, raw)
+        return True
+
+
 def canonical_text(text: str) -> str:
     """The cache's one definition of textual identity: Unicode NFC,
     casefolded, whitespace runs collapsed to single spaces, stripped.
@@ -102,8 +130,15 @@ def canonical_text(text: str) -> str:
     Casefolding is a documented trade-off (DEPLOY.md): eSpeak can
     pronounce casing ("US" vs "us"), so case-divergent texts share the
     entry of whoever synthesized first — template traffic is
-    case-stable, which is what this cache exists for."""
-    return " ".join(unicodedata.normalize("NFC", text).casefold().split())
+    case-stable, which is what this cache exists for.  Deployments whose
+    traffic IS case-sensitive opt out with
+    ``SONATA_SYNTH_CACHE_CASEFOLD=0``: case-divergent texts then
+    address separate entries (no key-schema change needed — the texts
+    simply stop collapsing)."""
+    normalized = unicodedata.normalize("NFC", text)
+    if resolve_casefold():
+        normalized = normalized.casefold()
+    return " ".join(normalized.split())
 
 
 def _num(v) -> str:
@@ -113,6 +148,18 @@ def _num(v) -> str:
         return "-"
     f = float(v)
     return str(int(f)) if f.is_integer() else repr(f)
+
+
+def _f32(v: Optional[float]) -> Optional[float]:
+    """Round-trip a scale through IEEE float32 — the precision the
+    SynthesisOptions wire fields carry.  The node configures scales as
+    float64 (``0.667``) but the mesh router learns them from protobuf
+    floats (``0.6669999957…``); canonicalizing BOTH sides through
+    float32 makes the router-derived affinity key byte-identical to the
+    node-derived cache key (pinned by tests/test_fleetcache.py)."""
+    if v is None:
+        return None
+    return struct.unpack("<f", struct.pack("<f", float(v)))[0]
 
 
 def request_key(*, rpc: str, text: str, voice_id: str,
@@ -133,12 +180,42 @@ def request_key(*, rpc: str, text: str, voice_id: str,
     sa = "-" if speech_args is None else ",".join(
         _num(x) for x in speech_args)
     parts = (KEY_VERSION, rpc, canonical_text(text), voice_id,
-             _num(speaker), _num(length_scale), _num(noise_scale),
-             _num(noise_w), _num(sample_rate), _num(sample_width),
+             _num(speaker), _num(_f32(length_scale)),
+             _num(_f32(noise_scale)), _num(_f32(noise_w)),
+             _num(sample_rate), _num(sample_width),
              _num(channels), _num(mode), _num(chunk_size),
              _num(chunk_padding), sa)
     blob = "\x1f".join(parts).encode("utf-8")
     return hashlib.blake2b(blob, digest_size=16).hexdigest()
+
+
+def utterance_key(kind: str, request, *, voice_id: str,
+                  speaker: Optional[int], length_scale: float,
+                  noise_scale: float, noise_w: float, sample_rate: int,
+                  sample_width: int, channels: int) -> str:
+    """:func:`request_key` for one decoded ``pb.Utterance`` plus the
+    per-voice identity fields the caller holds.
+
+    This is THE shared derivation for the request-shape half of the key
+    (synthesis mode, realtime chunk-schedule defaults, speech-args
+    flattening): the node frontend (``grpc_server._cache_key_for``) and
+    the mesh router (``serving/fleetcache.py``) both call it, so the
+    two sides cannot drift on how an Utterance maps into the canonical
+    tuple — only on the per-voice fields, which the key-parity tests
+    pin separately."""
+    sa = request.speech_args
+    realtime = kind == "realtime"
+    return request_key(
+        rpc=kind, text=request.text, voice_id=voice_id, speaker=speaker,
+        length_scale=length_scale, noise_scale=noise_scale,
+        noise_w=noise_w, sample_rate=sample_rate,
+        sample_width=sample_width, channels=channels,
+        mode=request.synthesis_mode or 0,
+        chunk_size=(request.realtime_chunk_size or 55) if realtime else 0,
+        chunk_padding=(request.realtime_chunk_padding or 3) if realtime
+        else 0,
+        speech_args=None if sa is None else (
+            sa.rate, sa.volume, sa.pitch, sa.appended_silence_ms))
 
 
 def resolve_cache_mb() -> float:
@@ -465,16 +542,24 @@ class SynthCache:
             return self._stats["hits"] / total
 
     def cache_view(self) -> dict:
-        """One snapshot for the scope plane's ``synth_cache`` rows."""
+        """One snapshot for the scope plane's ``synth_cache`` rows.
+
+        ``hot_keys`` is the LRU head — up to :data:`HOT_KEYS_MAX` keys,
+        most-recently-used first.  It rides the scope export so the mesh
+        router's fleetcache can see each node's hot set and replicate it
+        to the rendezvous peer (sonata-fleetcache)."""
         with self._lock:
             ratio = None
             total = self._stats["hits"] + self._stats["misses"]
             if total:
                 ratio = round(self._stats["hits"] / total, 6)
+            hot = list(self._entries)[-HOT_KEYS_MAX:]
+            hot.reverse()
             return {**self._stats, "hit_ratio": ratio,
                     "bytes": self._bytes, "entries": len(self._entries),
                     "max_bytes": self.max_bytes,
-                    "filling": len(self._filling)}
+                    "filling": len(self._filling),
+                    "hot_keys": hot}
 
     def bind_metrics(self, registry) -> None:
         """Attach the cache's series as scrape-time callbacks.  The

@@ -832,7 +832,15 @@ def test_iteration_streams_share_iterations(iteration_env):
         assert all(r is not None and len(r) > 0 for r in results)
         stats = v.dispatch_stats()
         assert stats["batch_mode"] == "iteration"
-        it = stats["iteration"]
+        # a consumer's retire is a message the loop thread processes on
+        # its next gather, so "retired" can lag the joins briefly —
+        # poll for the book balance instead of reading it once
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            it = v.dispatch_stats()["iteration"]
+            if it["retired"] == 4:
+                break
+            time.sleep(0.05)
         assert it["joined"] == 4 and it["retired"] == 4
         assert it["dispatches"] < it["requests"]  # rows shared iterations
         # graduated ladder: padding stays below the canonical-max rule's

@@ -1,0 +1,777 @@
+"""sonata-fleetcache tests (ISSUE 16): cache-affinity routing, router
+single-flight, and hot-set replication over the mesh.
+
+Four layers:
+
+- key parity: the router-derived cache key
+  (:meth:`~sonata_tpu.serving.fleetcache.FleetCache.routing_key`, fed
+  from wire-decoded float32 options) is byte-identical to the
+  node-derived one (float64 config values) for every parametrized
+  request shape — the v2 float32 canonicalization contract — pinned
+  in-process AND across a fresh interpreter;
+- rendezvous routing units: HRW stability under churn (only the
+  departed node's keys move), the skew guard firing at its bound and
+  recovering, trip/drain/rejoin affinity behavior through
+  ``MeshRouter.pick``, and the ``mesh.cache_affinity`` failpoint
+  degrading to least-outstanding routing;
+- router-side single-flight + replication units over fakes: one leader
+  fill feeds followers with PR-15 semantics, replication replays a hot
+  key to its next rendezvous peer exactly once and retargets after
+  membership change;
+- integration: two real cache-enabled backends behind a real
+  fleetcache-enabled router — repeats stick to one node and hit warm, 4
+  concurrent identical requests admit exactly ONE backend synthesis
+  fleet-wide, and a drained affinity owner's hottest template is served
+  warm from the replication peer with zero client-visible errors.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from sonata_tpu.serving import faults
+from sonata_tpu.serving import fleetcache as flc
+from sonata_tpu.serving import synthcache as sc
+from sonata_tpu.serving.fleetcache import (
+    FleetCache,
+    VoiceKeyInfo,
+    hrw_score,
+)
+from sonata_tpu.serving.mesh import MeshRouter, NodeSpec, parse_backends
+from sonata_tpu.serving.replicas import CLOSED, OPEN
+
+from sonata_tpu.frontends import grpc_messages as pb
+
+
+def make_router(n_nodes=3, **kw):
+    specs = [NodeSpec("127.0.0.1", 40000 + i, 41000 + i)
+             for i in range(n_nodes)]
+    kw.setdefault("start_probers", False)
+    kw.setdefault("retry_backoff_ms", 1.0)
+    return MeshRouter(specs, **kw)
+
+
+def wire_voice_info(voice_id="v1", speaker=None, length_scale=1.0,
+                    noise_scale=0.667, noise_w=0.8, sample_rate=16000,
+                    sample_width=2, channels=1, speakers=None):
+    """A VoiceInfo as the ROUTER sees it: encoded then decoded, so the
+    scales carry wire (float32) precision like a real LoadVoice
+    response."""
+    info = pb.VoiceInfo(
+        voice_id=voice_id,
+        synth_options=pb.SynthesisOptions(
+            speaker=speaker, length_scale=length_scale,
+            noise_scale=noise_scale, noise_w=noise_w),
+        speakers=speakers or {},
+        audio=pb.AudioInfo(sample_rate=sample_rate,
+                           num_channels=channels,
+                           sample_width=sample_width))
+    return pb.VoiceInfo.decode(info.encode())
+
+
+def wire_request(**fields):
+    """An Utterance round-tripped through the codec (what the router
+    decodes off the wire)."""
+    fields.setdefault("voice_id", "v1")
+    return pb.Utterance.decode(pb.Utterance(**fields).encode())
+
+
+def node_key(kind, request, *, voice_id="v1", speaker_id=None):
+    """What ``grpc_server._cache_key_for`` derives on the node: float64
+    config scales, the speaker already resolved to its int id."""
+    return sc.utterance_key(
+        kind, request, voice_id=voice_id, speaker=speaker_id,
+        length_scale=1.0, noise_scale=0.667, noise_w=0.8,
+        sample_rate=16000, sample_width=2, channels=1)
+
+
+@pytest.fixture
+def fc_router():
+    r = make_router(3)
+    fc = FleetCache(r, skew=4)
+    r.attach_fleetcache(fc)
+    yield fc, r
+    r.close()
+
+
+# ---------------------------------------------------------------------------
+# key parity: router derivation == node derivation
+# ---------------------------------------------------------------------------
+
+SHAPES = [
+    ("utterance", dict(text="Hello world.")),
+    ("realtime", dict(text="Hello world.")),
+    ("realtime", dict(text="  MiXeD \t CASE  text ")),
+    ("realtime", dict(text="Chunked.", realtime_chunk_size=10,
+                      realtime_chunk_padding=2)),
+    ("utterance", dict(text="Moded.", synthesis_mode=2)),
+    ("utterance", dict(text="Prosody.",
+                       speech_args=pb.SpeechArgs(
+                           rate=10, volume=50, pitch=50,
+                           appended_silence_ms=120))),
+]
+
+
+@pytest.mark.parametrize("kind,fields", SHAPES)
+def test_router_key_matches_node_key(fc_router, kind, fields):
+    """The acceptance pin: router keys (float32 wire scales) are
+    byte-identical to node keys (float64 config scales) for every
+    request shape — otherwise affinity routes repeats to a node that
+    then misses."""
+    fc, _r = fc_router
+    fc.learn_voice(wire_voice_info())
+    request = wire_request(**fields)
+    assert fc.routing_key(kind, request) == node_key(kind, request)
+
+
+def test_router_key_matches_node_key_named_speaker(fc_router):
+    """The router resolves the wire's speaker NAME to the int id the
+    node keys on, via the inverted VoiceInfo speakers map."""
+    fc, _r = fc_router
+    fc.learn_voice(wire_voice_info(speaker="alice",
+                                   speakers={3: "alice"}))
+    request = wire_request(text="Named speaker.")
+    assert fc.routing_key("utterance", request) == node_key(
+        "utterance", request, speaker_id=3)
+
+
+def test_router_key_numeric_speaker_name_fallback(fc_router):
+    """A literal numeric speaker name resolves like the node's
+    ``isdigit`` fallback even when the map does not carry it."""
+    fc, _r = fc_router
+    fc.learn_voice(wire_voice_info(speaker="7"))
+    request = wire_request(text="Numeric speaker.")
+    assert fc.routing_key("realtime", request) == node_key(
+        "realtime", request, speaker_id=7)
+
+
+def test_unresolvable_speaker_is_not_cacheable(fc_router):
+    """A speaker name the router cannot map must NOT guess a key that
+    could disagree with the node's — the voice routes PR-12 style."""
+    fc, _r = fc_router
+    fc.learn_voice(wire_voice_info(speaker="ghost"))
+    assert fc.routing_key("utterance",
+                          wire_request(text="Ghost.")) is None
+    assert fc.stat("uncacheable") == 1
+
+
+def test_unknown_and_forgotten_voices_are_not_cacheable(fc_router):
+    fc, _r = fc_router
+    assert fc.routing_key("utterance",
+                          wire_request(text="Who?")) is None
+    fc.learn_voice(wire_voice_info())
+    assert fc.routing_key("utterance",
+                          wire_request(text="Known.")) is not None
+    fc.forget_voice("v1")
+    assert fc.routing_key("utterance",
+                          wire_request(text="Known.")) is None
+
+
+def test_update_options_moves_the_key(fc_router):
+    """A SetSynthesisOptions response folds into the derivation — the
+    router's key moves exactly when the node's does."""
+    fc, _r = fc_router
+    fc.learn_voice(wire_voice_info())
+    request = wire_request(text="Scale sensitive.")
+    before = fc.routing_key("utterance", request)
+    resp = pb.SynthesisOptions.decode(pb.SynthesisOptions(
+        length_scale=1.3, noise_scale=0.667, noise_w=0.8).encode())
+    fc.update_options("v1", resp)
+    after = fc.routing_key("utterance", request)
+    assert before != after
+    assert after == sc.utterance_key(
+        "utterance", request, voice_id="v1", speaker=None,
+        length_scale=1.3, noise_scale=0.667, noise_w=0.8,
+        sample_rate=16000, sample_width=2, channels=1)
+
+
+def test_casefold_knob_keeps_both_sides_agreeing(fc_router, monkeypatch):
+    """SONATA_SYNTH_CACHE_CASEFOLD=0: case becomes identity on BOTH
+    derivations at once (the knob lives in synthcache, which both
+    sides share)."""
+    fc, _r = fc_router
+    fc.learn_voice(wire_voice_info())
+    upper = wire_request(text="SAME Text.")
+    lower = wire_request(text="same text.")
+    assert fc.routing_key("utterance", upper) == \
+        fc.routing_key("utterance", lower)
+    monkeypatch.setenv(sc.CASEFOLD_ENV, "0")
+    assert fc.routing_key("utterance", upper) != \
+        fc.routing_key("utterance", lower)
+    assert fc.routing_key("utterance", upper) == node_key(
+        "utterance", upper)
+
+
+def test_router_key_stable_across_processes(fc_router):
+    """A fresh interpreter (different PYTHONHASHSEED) learning the same
+    wire bytes derives the same routing key the node derives here."""
+    fc, _r = fc_router
+    info = wire_voice_info(voice_id="1234", speaker="bob",
+                           speakers={2: "bob"})
+    request = wire_request(voice_id="1234",
+                           text=" Pinned  KEY derivation. ",
+                           speech_args=pb.SpeechArgs(
+                               rate=10, volume=50, pitch=50,
+                               appended_silence_ms=0))
+    expected = node_key("realtime", request, voice_id="1234",
+                        speaker_id=2)
+    code = (
+        "from sonata_tpu.frontends import grpc_messages as pb;"
+        "from sonata_tpu.serving.fleetcache import FleetCache;"
+        "from sonata_tpu.serving.mesh import MeshRouter, NodeSpec;"
+        "r = MeshRouter([NodeSpec('127.0.0.1', 40000, 41000)],"
+        " start_probers=False);"
+        "fc = FleetCache(r);"
+        f"fc.learn_voice(pb.VoiceInfo.decode(bytes.fromhex("
+        f"'{info.encode().hex()}')));"
+        f"req = pb.Utterance.decode(bytes.fromhex("
+        f"'{request.encode().hex()}'));"
+        "print(fc.routing_key('realtime', req));"
+        "r.close()")
+    env = dict(os.environ, PYTHONHASHSEED="54321", JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == expected
+
+
+# ---------------------------------------------------------------------------
+# failpoint: a broken affinity tier can never fail a request
+# ---------------------------------------------------------------------------
+
+def test_cache_affinity_failpoint_degrades_to_plain_routing(fc_router):
+    fc, r = fc_router
+    fc.learn_voice(wire_voice_info())
+    request = wire_request(text="Degrade me.")
+    reg = faults.registry()
+    reg.arm("mesh.cache_affinity", "error", rate=1.0, max_hits=1)
+    try:
+        assert fc.routing_key("utterance", request) is None
+    finally:
+        reg.disarm("mesh.cache_affinity")
+    assert fc.stat("affinity_errors") == 1
+    # with the fault spent, derivation works again
+    assert fc.routing_key("utterance", request) is not None
+    # and a None key keeps pick() on plain least-outstanding
+    assert r.pick(affinity_key=None).outstanding == 1
+
+
+# ---------------------------------------------------------------------------
+# rendezvous: stability, skew guard, churn
+# ---------------------------------------------------------------------------
+
+def test_hrw_churn_moves_only_the_departed_nodes_keys():
+    addrs = [f"10.0.0.{i}:49314" for i in range(5)]
+    keys = [f"key-{i}" for i in range(200)]
+    owner = {k: max(addrs, key=lambda a: hrw_score(k, a)) for k in keys}
+    departed = addrs[-1]
+    assert any(owner[k] == departed for k in keys)  # it owned some
+    survivors = addrs[:-1]
+    for k in keys:
+        after = max(survivors, key=lambda a: hrw_score(k, a))
+        if owner[k] != departed:
+            assert after == owner[k]  # unaffected keys do not move
+
+
+def test_pick_affinity_routes_to_rendezvous_owner(fc_router):
+    fc, r = fc_router
+    key = "template-key-1"
+    owner_addr = max(r.nodes,
+                     key=lambda n: hrw_score(key, n.spec.addr)).spec.addr
+    picked = [r.pick(affinity_key=key) for _ in range(3)]
+    assert all(n.spec.addr == owner_addr for n in picked)
+    assert fc.stat("affinity_hits") == 3
+    assert fc.snapshot()["affinity_share"] == {owner_addr: 3}
+
+
+def test_skew_guard_fires_at_bound_and_recovers():
+    r = make_router(3)
+    try:
+        fc = FleetCache(r, skew=2)
+        r.attach_fleetcache(fc)
+        key = "hot-template"
+        owner = max(r.nodes, key=lambda n: hrw_score(key, n.spec.addr))
+        # within the bound: picks 1..3 pile onto the owner (diff 0,1,2)
+        for _ in range(3):
+            assert r.pick(affinity_key=key) is owner
+        # at the bound: owner is 3 over an idle floor > skew=2 -> the
+        # guard fires and the pick falls back to least-outstanding
+        n = r.pick(affinity_key=key)
+        assert n is not owner
+        assert fc.stat("skew_fallbacks") == 1
+        # recovery: the owner's streams finish -> affinity resumes
+        owner.outstanding = 0
+        assert r.pick(affinity_key=key) is owner
+        assert fc.stat("affinity_hits") == 4
+    finally:
+        r.close()
+
+
+def test_affinity_failover_on_trip_and_rejoin(fc_router):
+    """Breaker trip moves the key to its NEXT rendezvous choice (where
+    replication put the warm copy); rejoin moves it home."""
+    fc, r = fc_router
+    key = "failover-template"
+    ranked = sorted(r.nodes,
+                    key=lambda n: hrw_score(key, n.spec.addr),
+                    reverse=True)
+    assert r.pick(affinity_key=key) is ranked[0]
+    ranked[0].state = OPEN  # breaker trip
+    assert r.pick(affinity_key=key) is ranked[1]
+    ranked[1].draining = True  # drain the failover too
+    assert r.pick(affinity_key=key) is ranked[2]
+    ranked[0].state = CLOSED  # rejoin
+    ranked[1].draining = False
+    assert r.pick(affinity_key=key) is ranked[0]
+
+
+# ---------------------------------------------------------------------------
+# router-side single-flight
+# ---------------------------------------------------------------------------
+
+def test_single_flight_follower_rides_the_leader():
+    r = make_router(2)
+    try:
+        fc = FleetCache(r, wait_s=5.0)
+        outcome, fill = fc.begin_stream("k1")
+        assert outcome == "fill"
+        outcome2, follower = fc.begin_stream("k1")
+        assert outcome2 == "follow"
+        got, done = [], threading.Event()
+
+        def consume():
+            for chunk, _aux in follower:
+                got.append(chunk)
+            done.set()
+
+        t = threading.Thread(target=consume)
+        t.start()
+        fill.add_chunk(b"one")
+        fill.add_chunk(b"two")
+        fill.commit_fill()
+        assert done.wait(5.0)
+        t.join(5.0)
+        assert got == [b"one", b"two"]
+        assert fc.stat("singleflight_leads") == 1
+        assert fc.stat("singleflight_follows") == 1
+        assert fc.stat("follower_hits") == 1
+        # the router never STORES committed streams: the next identical
+        # request leads a fresh fill (backend caches hold the bytes)
+        assert fc.begin_stream("k1")[0] == "fill"
+        assert fc.snapshot()["in_flight"] == 1
+    finally:
+        r.close()
+
+
+def test_single_flight_leader_failure_releases_followers():
+    r = make_router(2)
+    try:
+        fc = FleetCache(r, wait_s=5.0)
+        _o, fill = fc.begin_stream("k2")
+        _o, follower = fc.begin_stream("k2")
+        errs = []
+
+        def consume():
+            try:
+                list(follower)
+            except sc.LeaderFailed as e:
+                errs.append(e)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        time.sleep(0.05)
+        fill.abort_fill()
+        t.join(5.0)
+        assert len(errs) == 1
+        assert fc.stat("follower_fallbacks") == 1
+        assert fc.snapshot()["in_flight"] == 0
+    finally:
+        r.close()
+
+
+def test_begin_stream_bypasses_on_none_key_and_after_close():
+    r = make_router(2)
+    try:
+        fc = FleetCache(r)
+        assert fc.begin_stream(None) == ("bypass", None)
+        _o, follower = None, None
+        _o, fill = fc.begin_stream("k3")
+        _o2, follower = fc.begin_stream("k3")
+        fc.close()
+        assert fc.begin_stream("k3") == ("bypass", None)
+        # close failed the in-flight entry: the follower unblocks
+        with pytest.raises(sc.LeaderFailed):
+            next(follower)
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# hot-set replication (fakes)
+# ---------------------------------------------------------------------------
+
+class FakeFleet:
+    def __init__(self):
+        self.views = {}
+
+    def node_cache_view(self, node):
+        return self.views.get(node.index)
+
+
+def owned_key(router, node, base: str) -> str:
+    """A key whose HRW owner over the router's membership is ``node``
+    — replication only pushes keys the advertising node owns."""
+    for i in range(1000):
+        k = f"{base}-{i}"
+        if max(router.nodes,
+               key=lambda n: hrw_score(k, n.spec.addr)) is node:
+            return k
+    raise AssertionError(f"no {base!r} key owned by {node.spec.addr}")
+
+
+def test_replication_targets_next_rendezvous_peer_once():
+    r = make_router(3)
+    try:
+        fleet = FakeFleet()
+        fc = FleetCache(r, fleet=fleet, replicate_k=2,
+                        replicate_interval_s=0.0)
+        calls = []
+        fc.set_replicate_transport(
+            lambda node, rpc, payload, key:
+            calls.append((node.spec.addr, rpc, payload, key)))
+        holder = r.nodes[0]
+        key = owned_key(r, holder, "hot")
+        fc.note_payload(key, "SynthesizeUtterance", b"req-bytes")
+        fleet.views[holder.index] = {"hot_keys": [key]}
+        fc.on_probe_cycle(holder)
+        peers = [n for n in r.nodes if n is not holder]
+        expected = max(peers,
+                       key=lambda n: hrw_score(key, n.spec.addr))
+        assert calls == [(expected.spec.addr, "SynthesizeUtterance",
+                          b"req-bytes", key)]
+        assert fc.stat("replications") == 1
+        # the target is exactly the affinity failover choice: HRW with
+        # the holder excluded
+        assert expected is max(peers, key=lambda n: hrw_score(
+            key, n.spec.addr))
+        # a second cycle re-replicates nothing (already placed)
+        fc.on_probe_cycle(holder)
+        assert len(calls) == 1
+        # and the TARGET advertising its received copy replicates
+        # nothing back — it does not own the key (the ping-pong guard:
+        # without it the copy bounces between holders every cycle,
+        # starving every other hot key of its one replay per cycle)
+        fleet.views[expected.index] = {"hot_keys": [key]}
+        fc.replicate_for_node(expected)
+        assert len(calls) == 1 and fc.stat("replications") == 1
+    finally:
+        r.close()
+
+
+def test_replication_retargets_after_membership_change():
+    r = make_router(3)
+    try:
+        fleet = FakeFleet()
+        fc = FleetCache(r, fleet=fleet, replicate_k=2,
+                        replicate_interval_s=0.0)
+        calls = []
+        fc.set_replicate_transport(
+            lambda node, rpc, payload, key:
+            calls.append(node.spec.addr))
+        holder = r.nodes[0]
+        key = owned_key(r, holder, "hot2")
+        fc.note_payload(key, "SynthesizeUtteranceRealtime", b"rb")
+        fleet.views[holder.index] = {"hot_keys": [key]}
+        fc.replicate_for_node(holder)
+        first_target_addr = calls[0]
+        first_target = next(n for n in r.nodes
+                            if n.spec.addr == first_target_addr)
+        # the replica holder trips out of membership: the key's warm
+        # copy must move to the next peer in HRW order
+        first_target.state = OPEN
+        fc.replicate_for_node(holder)
+        remaining = [n for n in r.nodes
+                     if n is not holder and n is not first_target]
+        assert calls == [first_target_addr, remaining[0].spec.addr]
+        assert fc.stat("replications") == 2
+    finally:
+        r.close()
+
+
+def test_replication_one_replay_per_cycle_and_failures_counted():
+    r = make_router(2)
+    try:
+        fleet = FakeFleet()
+        fc = FleetCache(r, fleet=fleet, replicate_k=4,
+                        replicate_interval_s=0.0)
+        calls = []
+        holder = r.nodes[0]
+        bad = owned_key(r, holder, "bad")
+        good = owned_key(r, holder, "good")
+
+        def flaky(node, rpc, payload, key):
+            calls.append(key)
+            if key == bad:
+                raise ConnectionError("refused")
+
+        fc.set_replicate_transport(flaky)
+        fc.note_payload(bad, "SynthesizeUtterance", b"x")
+        fc.note_payload(good, "SynthesizeUtterance", b"y")
+        fleet.views[holder.index] = {"hot_keys": [bad, good]}
+        fc.replicate_for_node(holder)  # anti-entropy: ONE replay/cycle
+        assert calls == [bad]
+        assert fc.stat("replication_failures") == 1
+        fc.replicate_for_node(holder)  # failed replica retries next
+        assert calls == [bad, bad]
+    finally:
+        r.close()
+
+
+def test_payload_memory_is_lru_bounded(monkeypatch):
+    monkeypatch.setattr(flc, "PAYLOAD_MEMORY_MAX", 2)
+    r = make_router(2)
+    try:
+        fc = FleetCache(r)
+        for i in range(4):
+            fc.note_payload(f"k{i}", "SynthesizeUtterance", b"p")
+        assert fc.snapshot()["payload_memory"] == 2
+        fc.note_payload(None, "SynthesizeUtterance", b"p")  # no-op
+        assert fc.snapshot()["payload_memory"] == 2
+    finally:
+        r.close()
+
+
+def test_voice_key_info_speaker_resolution_unit():
+    vki = VoiceKeyInfo("v1")
+    vki.name_to_id = {"alice": 3}
+    vki.resolve_speaker("alice")
+    assert vki.speaker == 3 and vki.cacheable
+    vki.resolve_speaker("9")
+    assert vki.speaker == 9 and vki.cacheable
+    vki.resolve_speaker("ghost")
+    assert vki.speaker is None and not vki.cacheable
+    vki.resolve_speaker(None)
+    assert vki.speaker is None and vki.cacheable
+
+
+# ---------------------------------------------------------------------------
+# integration: 2 cache-enabled backends behind a fleetcache router
+# ---------------------------------------------------------------------------
+
+grpc = pytest.importorskip("grpc")
+
+from sonata_tpu.frontends.grpc_server import create_server  # noqa: E402
+from sonata_tpu.frontends.mesh_server import create_mesh_server  # noqa: E402
+
+from voices import write_tiny_voice  # noqa: E402
+
+FLEET_ENV = {
+    "SONATA_SYNTH_CACHE_MB": "8",
+    "SONATA_FLEETCACHE": "1",
+    "SONATA_FLEETCACHE_REPLICATE_K": "4",
+    "SONATA_FLEET_SCRAPE_INTERVAL_S": "0.2",
+}
+
+
+@pytest.fixture(scope="module")
+def fleet_cluster(tmp_path_factory):
+    saved = {k: os.environ.get(k) for k in FLEET_ENV}
+    os.environ.update(FLEET_ENV)
+    backends, mesh_server, channel = [], None, None
+    try:
+        cfg = str(write_tiny_voice(tmp_path_factory.mktemp("fc_voice")))
+        for _ in range(2):
+            server, port = create_server(0, continuous_batching=True,
+                                         metrics_port=0,
+                                         request_timeout_s=60.0)
+            server.start()
+            backends.append((server, port))
+        specs = []
+        for server, port in backends:
+            server.sonata_service.warmup_and_mark_ready()
+            specs.append(
+                f"127.0.0.1:{port}/{server.sonata_runtime.http_port}")
+        router = MeshRouter(parse_backends(",".join(specs)),
+                            probe_interval_s=0.2, name="test-fleetcache")
+        mesh_server, mesh_port = create_mesh_server(
+            0, router=router, metrics_port=0, request_timeout_s=60.0)
+        mesh_server.start()
+        service = mesh_server.sonata_service
+        assert service.fleetcache is not None
+        # fast replication cadence for the test clock
+        service.fleetcache._cadence.interval_s = 0.2
+        channel = grpc.insecure_channel(f"127.0.0.1:{mesh_port}")
+        # load THROUGH the router so the fleetcache learns the voice's
+        # key inputs off the wire (the production path)
+        info = channel.unary_unary(
+            "/sonata_grpc.sonata_grpc/LoadVoice",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=pb.VoiceInfo.decode)(
+                pb.VoicePath(config_path=cfg))
+        yield {"channel": channel, "voice_id": info.voice_id,
+               "backends": backends, "mesh_server": mesh_server,
+               "router": router}
+    finally:
+        if channel is not None:
+            channel.close()
+        if mesh_server is not None:
+            mesh_server.stop(grace=None)
+            mesh_server.sonata_service.shutdown()
+        for server, _port in backends:
+            server.stop(grace=None)
+            server.sonata_service.shutdown()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _synth_call(cluster, text, rid=None):
+    fn = cluster["channel"].unary_stream(
+        "/sonata_grpc.sonata_grpc/SynthesizeUtterance",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.SynthesisResult.decode)
+    md = (("x-request-id", rid),) if rid else None
+    return fn(pb.Utterance(voice_id=cluster["voice_id"], text=text),
+              metadata=md, timeout=60.0)
+
+
+def _backend_caches(cluster):
+    return [s.sonata_runtime.synth_cache for s, _ in cluster["backends"]]
+
+
+def test_affinity_repeats_stick_and_hit_warm(fleet_cluster):
+    text = "Affinity keeps template repeats on one node."
+    hits0 = sum(c.stat("hits") for c in _backend_caches(fleet_cluster))
+    node_ids = []
+    for _ in range(3):
+        call = _synth_call(fleet_cluster, text)
+        results = list(call)
+        assert results and len(results[0].wav_samples) > 0
+        trailers = {k: v for k, v in (call.trailing_metadata() or ())}
+        node_ids.append(trailers.get("x-sonata-node-id"))
+    assert len(set(node_ids)) == 1  # every repeat landed on the owner
+    fc = fleet_cluster["mesh_server"].sonata_service.fleetcache
+    assert fc.stat("affinity_hits") >= 3
+    # repeats 2 and 3 were served warm from that node's PR-15 cache
+    hits = sum(c.stat("hits") for c in _backend_caches(fleet_cluster))
+    assert hits - hits0 >= 2
+
+
+def test_four_concurrent_identicals_one_backend_synthesis(fleet_cluster):
+    """The churn pin: 4 concurrent identical requests across 2 backends
+    admit exactly ONE backend synthesis fleet-wide (router single-flight
+    plus affinity plus the backend caches make this race-proof: however
+    the threads interleave, only the first miss synthesizes)."""
+    text = "Exactly one backend synthesis fleet-wide, please."
+    caches = _backend_caches(fleet_cluster)
+    fc = fleet_cluster["mesh_server"].sonata_service.fleetcache
+    # pause background hot-set replication: a replay of an EARLIER
+    # test's template landing mid-test would add an unrelated miss
+    saved_k, fc.replicate_k = fc.replicate_k, 0
+    # an in-flight replay is a real synthesis on the peer — wait for
+    # the fleet's miss counters to go quiet instead of a fixed sleep
+    last, quiet_since = -1.0, time.monotonic()
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        cur = sum(c.stat("misses") for c in caches)
+        if cur != last:
+            last, quiet_since = cur, time.monotonic()
+        elif time.monotonic() - quiet_since >= 1.0:
+            break
+        time.sleep(0.1)
+    try:
+        misses0 = sum(c.stat("misses") for c in caches)
+        inserts0 = sum(c.stat("inserts") for c in caches)
+        outs, errs = {}, []
+
+        def run(i):
+            try:
+                outs[i] = [m.wav_samples for m in
+                           _synth_call(fleet_cluster, text)]
+            except Exception as e:  # pragma: no cover - failure detail
+                errs.append(e)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60.0)
+        assert not errs and len(outs) == 4
+        assert all(outs[i] == outs[0] and outs[0] for i in outs)
+        assert sum(c.stat("misses") for c in caches) - misses0 == 1
+        assert sum(c.stat("inserts") for c in caches) - inserts0 == 1
+    finally:
+        fc.replicate_k = saved_k
+
+
+def test_debug_fleet_carries_cache_rollup(fleet_cluster):
+    import json
+    import urllib.request
+
+    http_port = fleet_cluster["mesh_server"].sonata_runtime.http_port
+    deadline = time.monotonic() + 15.0
+    doc = {}
+    while time.monotonic() < deadline:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{http_port}/debug/fleet",
+                timeout=5) as resp:
+            doc = json.loads(resp.read())
+        cache = doc.get("fleet", {}).get("cache", {})
+        if cache.get("nodes_with_cache", 0) >= 2:
+            break
+        time.sleep(0.1)
+    cache = doc["fleet"]["cache"]
+    assert cache["nodes_with_cache"] == 2
+    assert cache["hits"] >= 1 and cache["bytes"] > 0
+    router_view = cache["router"]
+    assert router_view["stats"]["affinity_hits"] >= 1
+    assert router_view["affinity_share"]
+
+
+def test_replication_survives_owner_drain(fleet_cluster):
+    """LAST test in the module (it drains the affinity owner for good):
+    the owner's hottest template is replicated to the rendezvous peer;
+    after the owner drains, the repeat is served WARM from the peer —
+    a hit, not a re-synthesis — with zero client-visible errors."""
+    text = "The hottest template must survive its owner."
+    call = _synth_call(fleet_cluster, text, rid="fc-rep-1")
+    results1 = list(call)
+    assert results1
+    trailers = {k: v for k, v in (call.trailing_metadata() or ())}
+    owner_id = trailers["x-sonata-node-id"]
+    owner_server = next(s for s, p in fleet_cluster["backends"]
+                        if f"127.0.0.1:{p}" == owner_id)
+    peer_server = next(s for s, p in fleet_cluster["backends"]
+                       if f"127.0.0.1:{p}" != owner_id)
+    peer_cache = peer_server.sonata_runtime.synth_cache
+    fc = fleet_cluster["mesh_server"].sonata_service.fleetcache
+    key = fc.routing_key("utterance", pb.Utterance(
+        voice_id=fleet_cluster["voice_id"], text=text))
+    assert key is not None
+    # the prober-riding replication pass replays the hot template to
+    # the peer (scrape advertises hot_keys -> replay fills its cache)
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        if key in (peer_cache.cache_view().get("hot_keys") or ()):
+            break
+        time.sleep(0.1)
+    assert key in (peer_cache.cache_view().get("hot_keys") or ()), \
+        "hot template never replicated to the rendezvous peer"
+    assert fc.stat("replications") >= 1
+    # the owner drains (rolling deploy): affinity failover = HRW over
+    # the remaining nodes = exactly where the warm copy sits
+    owner_server.sonata_runtime.begin_drain("fleet failover test")
+    peer_hits0 = peer_cache.stat("hits")
+    call2 = _synth_call(fleet_cluster, text, rid="fc-rep-2")
+    results2 = list(call2)
+    assert results2 and len(results2[0].wav_samples) > 0
+    trailers2 = {k: v for k, v in (call2.trailing_metadata() or ())}
+    assert trailers2.get("x-sonata-node-id") != owner_id
+    assert peer_cache.stat("hits") - peer_hits0 >= 1  # warm, not cold

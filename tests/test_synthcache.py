@@ -62,6 +62,33 @@ def test_canonical_text_collapses_whitespace_and_case():
     assert canonical_text("café") == canonical_text("café")
 
 
+def test_casefold_opt_out_keeps_case_distinct(monkeypatch):
+    """SONATA_SYNTH_CACHE_CASEFOLD=0 (ISSUE 16): case stays part of the
+    identity — a voice whose delivery differs by capitalization keeps
+    distinct cache entries.  Whitespace/NFC normalization is unaffected."""
+    monkeypatch.setenv(sc.CASEFOLD_ENV, "0")
+    assert canonical_text("  Hello\n\tWORLD  ") == "Hello WORLD"
+    assert canonical_text("café") == canonical_text("café")  # NFC stays
+    assert key_of("Hello world.") != key_of("HELLO WORLD.")
+
+
+def test_casefold_default_on(monkeypatch):
+    """Unset / empty / =1 all keep the PR-15 folding default; an
+    unparseable value warns and keeps the default rather than silently
+    splitting the fleet's key space."""
+    for value in (None, "", "1"):
+        if value is None:
+            monkeypatch.delenv(sc.CASEFOLD_ENV, raising=False)
+        else:
+            monkeypatch.setenv(sc.CASEFOLD_ENV, value)
+        assert sc.resolve_casefold() is True
+        assert canonical_text("MiXeD Case") == "mixed case"
+    monkeypatch.setenv(sc.CASEFOLD_ENV, "nope")
+    assert sc.resolve_casefold() is True
+    monkeypatch.setenv(sc.CASEFOLD_ENV, "0")
+    assert sc.resolve_casefold() is False
+
+
 def test_normalized_variants_map_to_one_key():
     base = key_of("Your package has shipped.")
     for variant in ("your  package has\tshipped.",
@@ -104,7 +131,9 @@ GOLDEN_KEY = request_key(
 
 
 def test_key_derivation_pinned_stable():
-    assert GOLDEN_KEY == "f06f8b601e8dd3c8fd15358661b4215f"
+    # v2 (ISSUE 16): scales canonicalize through float32 so router-side
+    # keys (from float32 wire values) and node-side keys agree
+    assert GOLDEN_KEY == "3f752ca4f09880b14864b068052d1410"
 
 
 def test_key_stable_across_processes():

@@ -26,6 +26,24 @@ pinned-port node boot the CI mesh phase and bench_mesh use), sharing
 one ``SONATA_JAX_CACHE_DIR`` so boots after the first are warm.
 
 Run: ``JAX_PLATFORMS=cpu python tools/bench_fleet.py --out FLEET_r01.json``
+
+``--cache-artifact`` (ISSUE 16) instead produces the committed
+``FLEETCACHE_rNN.json``: a fleet of THREE cache-enabled backends behind
+the router, driven by the same seeded Zipf(1.1) template workload the
+single-node ``CACHE_rNN.json`` pins (16 templates, 80 draws, 4
+concurrent clients), once with cache-affinity routing off (plain
+least-outstanding spreads each template's first hit across the fleet —
+the cold-miss dilution this PR exists to kill) and once with
+``SONATA_FLEETCACHE=1``.  The fleet hit ratio is computed from the
+summed per-node ``sonata_synth_cache_{hits,misses}_total`` deltas, so
+router-side single-flight followers (admitted without touching a
+backend) are reported separately rather than flattering the ratio.
+Acceptance bar: the affinity arm's fleet ratio stays >= 0.9x the
+single-node CACHE_r01 ratio (0.825 -> >= 0.7425) while the plain arm
+dilutes below it.
+
+Run: ``JAX_PLATFORMS=cpu python tools/bench_fleet.py --cache-artifact \\
+--out FLEETCACHE_r01.json``
 """
 
 from __future__ import annotations
@@ -60,13 +78,229 @@ STREAMS_PER_RUN = 3
 SCRAPER_PERIOD_S = 0.5
 
 
+N_TEMPLATES = 16
+N_DRAWS = 80
+ZIPF_EXPONENT = 1.1
+CACHE_CLIENTS = 4          # stays under the affinity skew guard (4)
+SINGLE_NODE_RATIO = 0.825  # the committed CACHE_r01 zipf_hit_ratio
+CACHE_BAR = round(0.9 * SINGLE_NODE_RATIO, 4)
+
+
+def cache_main(args) -> int:
+    """The ``--cache-artifact`` mode: fleet-of-3 Zipf hit ratio with
+    cache-affinity routing off vs on (see module docstring)."""
+    import queue
+    import random
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import grpc
+
+    from sonata_tpu.frontends import grpc_messages as pb
+    from sonata_tpu.frontends.mesh_server import create_mesh_server
+    from sonata_tpu.serving import parse_prometheus_text
+    from voices import write_tiny_voice
+
+    cfg = str(write_tiny_voice(
+        Path(tempfile.mkdtemp(prefix="fleetcache_bench"))))
+    cache = tempfile.mkdtemp(prefix="fleetcache_bench_cache")
+    ports = [(free_port(), free_port()) for _ in range(3)]
+    logs = [open(os.path.join(cache, f"node{i}.log"), "w")
+            for i in range(3)]
+
+    def boot(i: int) -> subprocess.Popen:
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   SMOKE_VOICE_CFG=cfg, SONATA_JAX_CACHE_DIR=cache,
+                   SONATA_SYNTH_CACHE_MB="16",
+                   MESH_NODE_GRPC_PORT=str(ports[i][0]),
+                   MESH_NODE_METRICS_PORT=str(ports[i][1]))
+        return subprocess.Popen(
+            [sys.executable, str(SMOKE), "--mesh-node-boot"],
+            env=env, stdout=logs[i], stderr=logs[i])
+
+    print("fleet-bench[cache]: booting 3 cache-enabled backend nodes...")
+    procs = [boot(i) for i in range(3)]
+    for i in range(3):
+        if not wait_readyz(ports[i][1], 300.0):
+            raise RuntimeError(f"backend {i} never became ready")
+    specs = [f"127.0.0.1:{g}/{m}" for g, m in ports]
+
+    def fleet_counter(family: str) -> float:
+        total = 0.0
+        for _g, m in ports:
+            parsed = parse_prometheus_text(
+                http_get(f"http://127.0.0.1:{m}/metrics")[1])
+            total += sum(v for _lbl, v in parsed.get(family, []))
+        return total
+
+    def run_arm(tag: str, affinity_on: bool) -> dict:
+        """One arm: its own router (fleetcache on/off via env), the
+        seeded Zipf draw sequence over tag-prefixed templates (distinct
+        texts per arm so arms can never hit each other's entries), 4
+        concurrent clients, hit ratio from node-counter deltas."""
+        if affinity_on:
+            os.environ["SONATA_FLEETCACHE"] = "1"
+        try:
+            server, port = create_mesh_server(
+                0, backends=specs, metrics_port=0,
+                request_timeout_s=120.0)
+        finally:
+            os.environ.pop("SONATA_FLEETCACHE", None)
+        server.start()
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        synth = channel.unary_stream(
+            "/sonata_grpc.sonata_grpc/SynthesizeUtterance",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=pb.SynthesisResult.decode)
+        load = channel.unary_unary(
+            "/sonata_grpc.sonata_grpc/LoadVoice",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=pb.VoiceInfo.decode)
+        # through the router: the affinity tier learns the voice's key
+        # inputs from the wire (inert for voices it has not seen)
+        voice_id = load(pb.VoicePath(config_path=cfg),
+                        timeout=120.0).voice_id
+
+        texts = [f"{tag}-arm fleet cache bench template {i} repeats."
+                 for i in range(N_TEMPLATES)]
+        weights = [1.0 / (rank + 1) ** ZIPF_EXPONENT
+                   for rank in range(N_TEMPLATES)]
+        rng = random.Random(args.seed)
+        draws = rng.choices(range(N_TEMPLATES), weights=weights,
+                            k=N_DRAWS)
+        h0 = fleet_counter("sonata_synth_cache_hits_total")
+        m0 = fleet_counter("sonata_synth_cache_misses_total")
+        work: queue.Queue = queue.Queue()
+        for idx in draws:
+            work.put(idx)
+        errors: list = []
+
+        def client() -> None:
+            while True:
+                try:
+                    idx = work.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    results = list(synth(
+                        pb.Utterance(voice_id=voice_id,
+                                     text=texts[idx]),
+                        timeout=120.0))
+                    if not results or not results[0].wav_samples:
+                        errors.append("empty")
+                except grpc.RpcError as e:
+                    errors.append(e.code().name)
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=client)
+                   for _ in range(CACHE_CLIENTS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600.0)
+        wall = time.monotonic() - t0
+        if errors:
+            raise RuntimeError(f"{tag} arm saw errors: {errors[:5]}")
+        hits = fleet_counter("sonata_synth_cache_hits_total") - h0
+        misses = fleet_counter("sonata_synth_cache_misses_total") - m0
+        fcs = server.sonata_service.fleetcache
+        snap = dict(fcs.snapshot()["stats"]) if fcs is not None else {}
+        channel.close()
+        server.stop(grace=None)
+        server.sonata_service.shutdown()
+        ratio = hits / max(hits + misses, 1)
+        print(f"fleet-bench[cache]: {tag} arm: {int(hits)} hits / "
+              f"{int(misses)} misses over {N_DRAWS} draws "
+              f"({len(set(draws))} unique templates) -> fleet ratio "
+              f"{ratio:.4f} in {wall:.1f}s "
+              f"(followers={snap.get('singleflight_follows', 0)}, "
+              f"skew_fallbacks={snap.get('skew_fallbacks', 0)})")
+        return {"ratio": round(ratio, 4), "hits": int(hits),
+                "misses": int(misses),
+                "unique_templates": len(set(draws)),
+                "wall_s": round(wall, 2), "snap": snap}
+
+    # plain arm first: the dilution baseline this PR kills
+    off = run_arm("off", affinity_on=False)
+    on = run_arm("on", affinity_on=True)
+
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=60.0)
+        except subprocess.TimeoutExpired:
+            p.kill()
+    for f in logs:
+        f.close()
+
+    results = [
+        {"metric": "fleet_zipf_hit_ratio_affinity", "value": on["ratio"]},
+        {"metric": "fleet_zipf_hit_ratio_plain", "value": off["ratio"]},
+        {"metric": "fleet_zipf_misses_affinity", "value": on["misses"]},
+        {"metric": "fleet_zipf_misses_plain", "value": off["misses"]},
+        {"metric": "zipf_unique_templates",
+         "value": on["unique_templates"]},
+        {"metric": "affinity_picks",
+         "value": int(on["snap"].get("affinity_hits", 0))},
+        {"metric": "affinity_skew_fallbacks",
+         "value": int(on["snap"].get("skew_fallbacks", 0))},
+        {"metric": "singleflight_follower_joins",
+         "value": int(on["snap"].get("singleflight_follows", 0))},
+    ]
+    artifact = {
+        "bench": "fleetcache",
+        "host": "ci-cpu",
+        "notes": (
+            "bench_fleet --cache-artifact (ISSUE 16): 3 cache-enabled "
+            "backend subprocesses (SONATA_SYNTH_CACHE_MB=16, shared "
+            "jax cache) behind the mesh router; the CACHE_r01 seeded "
+            "Zipf workload (16 templates, rank^-1.1 weights, 80 draws, "
+            "seed %d) over %d concurrent clients, once with plain "
+            "least-outstanding routing and once with cache-affinity "
+            "routing (SONATA_FLEETCACHE=1), distinct per-arm text "
+            "prefixes so the arms share no cache entries.  Fleet hit "
+            "ratio is summed per-node synth-cache counter deltas; "
+            "router-side single-flight followers are reported "
+            "separately (they are admissions served without touching "
+            "a backend, so folding them in would flatter the ratio).  "
+            "Acceptance: affinity arm >= %.4f (0.9x the single-node "
+            "CACHE_r01 zipf_hit_ratio of %.3f) with the plain arm "
+            "diluted below the affinity arm; hot-set replication is "
+            "left at its default (off) so replica priming cannot "
+            "pollute the measured counters."
+            % (args.seed, CACHE_CLIENTS, CACHE_BAR, SINGLE_NODE_RATIO)),
+        "configs": {"fleetcache": {"results": results}},
+    }
+    if args.out:
+        Path(args.out).write_text(
+            json.dumps(artifact, indent=1, sort_keys=True) + "\n",
+            encoding="utf-8")
+        print(f"fleet-bench[cache]: wrote {args.out}")
+    ok = on["ratio"] >= CACHE_BAR and off["ratio"] < on["ratio"]
+    print(f"fleet-bench[cache]: {'PASS' if ok else 'FAIL'} "
+          f"(affinity {on['ratio']:.4f} >= {CACHE_BAR:.4f}, "
+          f"plain {off['ratio']:.4f} diluted)")
+    return 0 if ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=None,
                     help="write the artifact here (e.g. FLEET_r01.json);"
                          " omitted = print only")
     ap.add_argument("--runs", type=int, default=RUNS_PER_ARM)
+    ap.add_argument("--cache-artifact", action="store_true",
+                    help="produce FLEETCACHE_rNN.json instead: fleet-"
+                         "of-3 Zipf hit ratio, affinity off vs on")
+    ap.add_argument("--seed", type=int, default=1234,
+                    help="Zipf draw seed for --cache-artifact")
     args = ap.parse_args()
+
+    if args.cache_artifact:
+        return cache_main(args)
 
     import jax
 

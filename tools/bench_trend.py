@@ -110,6 +110,7 @@ def collect() -> Dict[str, Dict]:
                    + list(REPO.glob("WARMUP_r*.json"))
                    + list(REPO.glob("MESH_r*.json"))
                    + list(REPO.glob("FLEET_r*.json"))
+                   + list(REPO.glob("FLEETCACHE_r*.json"))
                    + list(REPO.glob("CACHE_r*.json")))
     for path in paths:
         m = _REV_RE.match(path.name)

@@ -615,9 +615,15 @@ def main() -> int:
     from sonata_tpu.serving import degradation as degradation_mod
     from sonata_tpu.serving import scope as scope_mod
 
-    mesh_server_obj, mesh_port = create_mesh_server(
-        0, backends=[f"127.0.0.1:{port}/{runtime.http_port}"],
-        metrics_port=0, request_timeout_s=REQUEST_TIMEOUT_S)
+    # the fleet-cache tier (ISSUE 16) rides this router so phase M can
+    # drive the mesh.cache_affinity failpoint end to end
+    os.environ["SONATA_FLEETCACHE"] = "1"
+    try:
+        mesh_server_obj, mesh_port = create_mesh_server(
+            0, backends=[f"127.0.0.1:{port}/{runtime.http_port}"],
+            metrics_port=0, request_timeout_s=REQUEST_TIMEOUT_S)
+    finally:
+        del os.environ["SONATA_FLEETCACHE"]
     mesh_server_obj.start()
     mesh_rt = mesh_server_obj.sonata_runtime
     mrouter = mesh_server_obj.sonata_service.router
@@ -727,6 +733,25 @@ def main() -> int:
     code, _ = http_get(mbase + "/readyz")
     check("mesh: router readyz recovers with the node", code == 200,
           f"(code {code})")
+
+    # fleet-cache affinity tier (ISSUE 16): ANY error inside routing-key
+    # derivation must degrade the request to plain least-outstanding
+    # routing — a broken affinity tier can never fail a request
+    mfc = mesh_server_obj.sonata_service.fleetcache
+    check("mesh: fleetcache tier constructed under SONATA_FLEETCACHE=1",
+          mfc is not None)
+    arm_spec("mesh.cache_affinity:error:1::1")
+    results, trailers, err = mesh_synth(TEXTS[3])
+    check("mesh: armed cache_affinity error degrades to plain routing",
+          err is None and results and len(results[0].wav_samples) > 0,
+          f"({err.code().name if err else 'ok'})")
+    fired_now = fires_total()
+    check("mesh: affinity degradation counted and site fired",
+          mfc is not None and mfc.stat("affinity_errors") >= 1
+          and fired_now.get("mesh.cache_affinity", 0) >= 1,
+          f"(errors={mfc.stat('affinity_errors') if mfc else '-'}, "
+          f"fires={fired_now.get('mesh.cache_affinity', 0)})")
+    disarm_all()
 
     mesh_channel.close()
     mesh_server_obj.stop(grace=None)

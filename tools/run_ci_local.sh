@@ -31,7 +31,12 @@
 #      synthesis-cache phase (ISSUE 15): repeat requests replay
 #      bit-identical bytes and chunk boundaries with zero new
 #      dispatches, hit/miss/bytes metrics + /debug/quantiles hit-ratio
-#      rows populate, and an over-budget workload evicts LRU-first
+#      rows populate, and an over-budget workload evicts LRU-first;
+#      plus the fleetcache phase (ISSUE 16): cache-affinity routing
+#      pins template repeats to one owner (warm fleet hits), the hot
+#      set replicates to the rendezvous peer, and SIGKILLing the
+#      affinity holder mid-workload serves its hottest template warm
+#      from the peer with zero client-visible errors
 #      (tools/serving_smoke.py)
 #   5. "Multi-device lane" — test_replicas on a forced 4-device CPU
 #      host (the replica-pool acceptance shape), plus test_parallel on
@@ -43,7 +48,9 @@
 #      SIGTERM restart drain (readyz 503 before the listener closes,
 #      in-flight streams finish, pinned shutdown-phase log order), and
 #      the cache.lookup arm (ISSUE 15): an injected cache-probe error
-#      degrades to a normal miss — a broken cache never fails a request
+#      degrades to a normal miss — a broken cache never fails a
+#      request — and the mesh.cache_affinity arm (ISSUE 16): an
+#      injected affinity-derivation error degrades to plain routing
 #
 # The workflow's dependency-install step is intentionally skipped: this
 # environment (and any dev box that can run the suite at all) already has

@@ -1289,6 +1289,221 @@ def main(args=None) -> int:
     for f in node_logs:
         f.close()
 
+    # ---- fleetcache phase (ISSUE 16): the synthesis cache becomes a
+    # fleet property.  Cache-affinity routing pins each template to one
+    # rendezvous owner (repeats hit that node's cache warm), the
+    # owner's hot set replicates to its rendezvous peer riding the
+    # prober threads, and SIGKILLing the affinity holder mid-workload
+    # leaves zero client-visible errors — the hottest template's next
+    # repeat is served WARM by the replication peer.
+    fc_ports = [(free_port(), free_port()) for _ in range(2)]
+    fc_logs = [open(os.path.join(mesh_cache, f"fcnode{i}.log"), "w")
+               for i in range(2)]
+
+    def boot_fc_node(i: int) -> subprocess.Popen:
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   SMOKE_VOICE_CFG=cfg,
+                   SONATA_JAX_CACHE_DIR=mesh_cache,
+                   SONATA_SYNTH_CACHE_MB="8",
+                   MESH_NODE_GRPC_PORT=str(fc_ports[i][0]),
+                   MESH_NODE_METRICS_PORT=str(fc_ports[i][1]),
+                   MESH_NODE_EMPTY="0")
+        return subprocess.Popen(
+            [sys.executable, __file__, "--mesh-node-boot"],
+            env=env, stdout=fc_logs[i], stderr=fc_logs[i])
+
+    fc_procs = [boot_fc_node(0), boot_fc_node(1)]
+    check("fleetcache: cache-enabled backends boot ready",
+          wait_readyz(fc_ports[0][1]) and wait_readyz(fc_ports[1][1]))
+
+    os.environ["SONATA_FLEETCACHE"] = "1"
+    os.environ["SONATA_FLEETCACHE_REPLICATE_K"] = "4"
+    os.environ["SONATA_FLEET_SCRAPE_INTERVAL_S"] = "0.5"
+    os.environ["SONATA_MESH_PROBE_INTERVAL_S"] = "0.5"
+    try:
+        fc_server, fc_grpc_port = create_mesh_server(
+            0, backends=[f"127.0.0.1:{g}/{m}" for g, m in fc_ports],
+            metrics_port=0, request_timeout_s=60.0)
+    finally:
+        for k in ("SONATA_FLEETCACHE", "SONATA_FLEETCACHE_REPLICATE_K",
+                  "SONATA_MESH_PROBE_INTERVAL_S"):
+            del os.environ[k]
+    fc_server.start()
+    fcs = fc_server.sonata_service.fleetcache
+    fc_router = fc_server.sonata_service.router
+    fc_fleet = fc_server.sonata_service.fleet
+    fc_base = f"http://127.0.0.1:{fc_server.sonata_runtime.http_port}"
+    check("fleetcache: router built the fleet-cache tier "
+          "(SONATA_FLEETCACHE=1)", fcs is not None)
+    fc_channel = grpc.insecure_channel(f"127.0.0.1:{fc_grpc_port}")
+    fc_synth = fc_channel.unary_stream(
+        "/sonata_grpc.sonata_grpc/SynthesizeUtterance",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.SynthesisResult.decode)
+    fc_load = fc_channel.unary_unary(
+        "/sonata_grpc.sonata_grpc/LoadVoice",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.VoiceInfo.decode)
+    # LoadVoice THROUGH the router: the fleet-cache tier learns the
+    # voice's key inputs (options, speaker map, audio shape) from the
+    # wire — affinity routing is inert for voices it has not seen
+    fc_info = fc_load(pb.VoicePath(config_path=cfg), timeout=120.0)
+    fc_voice = fc_info.voice_id
+
+    def fc_node_metric(i: int, family: str) -> float:
+        parsed = parse_prometheus_text(
+            http_get(f"http://127.0.0.1:{fc_ports[i][1]}/metrics")[1])
+        return sum(v for _lbl, v in parsed.get(family, []))
+
+    # hot-template workload: each template's repeats must stick to the
+    # one rendezvous owner and hit its synthesis cache warm
+    templates = [f"Fleet cache template number {i} stays hot."
+                 for i in range(4)]
+    owner_of: dict = {}
+    sticky = True
+    for _rep in range(3):
+        for text in templates:
+            call = fc_synth(pb.Utterance(voice_id=fc_voice, text=text),
+                            timeout=60.0)
+            results = list(call)
+            sticky = sticky and bool(results) \
+                and len(results[0].wav_samples) > 0
+            nid = dict(call.trailing_metadata() or ()).get(
+                "x-sonata-node-id")
+            owner_of.setdefault(text, set()).add(nid)
+    check("fleetcache: every template's repeats stick to one affinity "
+          "owner", sticky and all(len(s) == 1 and None not in s
+                                  for s in owner_of.values()),
+          f"({ {t[:24]: sorted(s) for t, s in owner_of.items()} })")
+    check("fleetcache: affinity picks counted on the router",
+          fcs is not None and fcs.stat("affinity_hits") >= 8,
+          f"({fcs.snapshot() if fcs else None})")
+    warm_hits = sum(fc_node_metric(i, "sonata_synth_cache_hits_total")
+                    for i in range(2))
+    check("fleetcache: repeats hit the owners' caches warm (8 of 12 "
+          "requests)", warm_hits >= 8, f"({warm_hits} fleet hits)")
+
+    # the /debug/fleet rollup carries the fleet cache view
+    fc_doc: dict = {}
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        code, body = http_get(fc_base + "/debug/fleet")
+        fc_doc = json.loads(body) if code == 200 else {}
+        cr = fc_doc.get("fleet", {}).get("cache") or {}
+        if cr.get("nodes_with_cache") == 2 and cr.get("hits", 0) >= 8:
+            break
+        time.sleep(0.5)
+    cr = fc_doc.get("fleet", {}).get("cache") or {}
+    check("fleetcache: /debug/fleet rolls up fleet hit ratio and "
+          "cache bytes",
+          cr.get("nodes_with_cache") == 2 and cr.get("hits", 0) >= 8
+          and cr.get("bytes", 0) > 0 and cr.get("hit_ratio") is not None,
+          f"({cr})")
+
+    # hot-set replication: the hottest template's entry must land on
+    # the rendezvous peer (scrape-advertised hot keys -> prober replay)
+    hot_text = templates[0]
+    hot_owner = next(iter(owner_of[hot_text]))
+    hot_key = fcs.routing_key(
+        "utterance", pb.Utterance(voice_id=fc_voice, text=hot_text))
+    owner_idx = next(i for i, (g, _m) in enumerate(fc_ports)
+                     if f"127.0.0.1:{g}" == hot_owner)
+    peer_idx = 1 - owner_idx
+    peer_node = next(n for n in fc_router.nodes
+                     if n.spec.addr != hot_owner)
+    check("fleetcache: hottest template derives a routable cache key",
+          hot_key is not None)
+    replicated = False
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline and not replicated:
+        view = fc_fleet.node_cache_view(peer_node)
+        replicated = bool(view) and hot_key in (view.get("hot_keys")
+                                                or [])
+        if not replicated:
+            time.sleep(0.5)
+    check("fleetcache: hot set replicated to the rendezvous peer",
+          replicated, f"(replications={fcs.stat('replications')}, "
+          f"failures={fcs.stat('replication_failures')})")
+
+    # SIGKILL the affinity holder mid-workload.  The workload gates
+    # issuance for the kill instant itself (a SIGKILL can truncate a
+    # stream mid-flight; the mesh phase above already pins that typed
+    # path) — the interesting path HERE is that post-kill repeats still
+    # route via affinity to the dead owner, fail pre-stream, reroute to
+    # the peer, and find its cache already warm.
+    peer_hits_before = fc_node_metric(
+        peer_idx, "sonata_synth_cache_hits_total")
+    gate = threading.Event()
+    gate.set()
+    stop_at = time.monotonic() + 8.0
+    fc_errors: list = []
+    progress: dict = {}
+
+    def hot_loop(j: int) -> None:
+        n = 0
+        while time.monotonic() < stop_at:
+            gate.wait(timeout=10.0)
+            try:
+                call = fc_synth(pb.Utterance(voice_id=fc_voice,
+                                             text=hot_text),
+                                timeout=60.0)
+                results = list(call)
+                if not results or len(results[0].wav_samples) == 0:
+                    fc_errors.append((j, "empty"))
+                n += 1
+            except grpc.RpcError as e:
+                fc_errors.append((j, e.code().name))
+            time.sleep(0.05)
+        progress[j] = n
+
+    threads = [threading.Thread(target=hot_loop, args=(j,))
+               for j in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(1.5)          # workload in full swing
+    gate.clear()             # park the loops at the gate
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and \
+            sum(n.outstanding for n in fc_router.nodes) > 0:
+        time.sleep(0.05)
+    fc_procs[owner_idx].kill()   # SIGKILL: no drain, no goodbye
+    gate.set()               # resume repeats against the dead owner
+    for t in threads:
+        t.join(timeout=120.0)
+    check("fleetcache: zero client-visible errors across the affinity "
+          "holder's SIGKILL",
+          not fc_errors and len(progress) == 4
+          and all(n > 0 for n in progress.values()),
+          f"(errors={fc_errors[:4]}, progress={progress})")
+    call = fc_synth(pb.Utterance(voice_id=fc_voice, text=hot_text),
+                    timeout=60.0)
+    results = list(call)
+    served_by = dict(call.trailing_metadata() or ()).get(
+        "x-sonata-node-id")
+    peer_hits_after = fc_node_metric(
+        peer_idx, "sonata_synth_cache_hits_total")
+    check("fleetcache: hottest template served warm from the "
+          "replication peer after the kill",
+          bool(results) and len(results[0].wav_samples) > 0
+          and served_by == f"127.0.0.1:{fc_ports[peer_idx][0]}"
+          and peer_hits_after > peer_hits_before,
+          f"(served_by={served_by}, peer hits "
+          f"{peer_hits_before}->{peer_hits_after})")
+    check("fleetcache: sonata_fleetcache_replications_total exported "
+          "on the router",
+          sum(v for _l, v in parse_prometheus_text(
+              http_get(fc_base + "/metrics")[1]).get(
+              "sonata_fleetcache_replications_total", [])) >= 1.0)
+
+    fc_channel.close()
+    fc_server.stop(grace=None)
+    fc_server.sonata_service.shutdown()
+    for p in fc_procs:
+        if p.poll() is None:
+            p.kill()
+    for f in fc_logs:
+        f.close()
+
     if failures:
         print(f"smoke: {len(failures)} FAILED: {failures}")
         return 1
