@@ -62,6 +62,7 @@ from ..serving import (
     synthcache,
     tracing,
 )
+from ..serving import tenancy as tenancy_mod
 from ..serving import warmup as serving_warmup
 from ..serving.logs import configure_logging
 from ..synth import AudioOutputConfig, SpeechSynthesizer
@@ -392,6 +393,121 @@ class SonataGrpcService:
             # the scheduler cancelled it because the client went away
             raise DeadlineExceeded("request cancelled") from None
 
+    # -- multi-tenant QoS (serving/tenancy.py, ISSUE 17) ----------------------
+    def _tenant_identity(self, context):
+        """Resolve (and memoize on the context) this request's tenant.
+        Classification runs ONCE per RPC even though the cache, quota,
+        fair-gate, and accounting paths each need it — and the
+        ``tenancy.classify`` failpoint therefore fires once too.
+        Returns None when tenancy is off."""
+        tn = self.runtime.tenancy
+        if tn is None:
+            return None
+        ident = getattr(context, "_sonata_tenant", None)
+        if ident is None:
+            ident = tn.classify_context(context)
+            try:
+                context._sonata_tenant = ident
+            except Exception:
+                pass  # frozen context double: classify again if asked
+        return ident
+
+    def _tenant_synth_gate(self, context, rpc: str):
+        """Per-tenant admission for one SYNTHESIS stream — cache hits
+        and single-flight followers never reach here, so quota is only
+        burned by work that costs a dispatch (the probe-before-charge
+        order the PR pins).  In order: the per-tenant shed rung (ahead
+        of the fleet-wide ``reject_heavy`` rung), the token-bucket
+        charge (typed RESOURCE_EXHAUSTED refusal with a
+        machine-readable ``retry-after-s`` trailer), then the
+        weighted-fair gate slot.  Returns ``(gate, tenant)`` with the
+        slot held — the caller must ``gate.leave(tenant)`` in a
+        finally — or ``(None, None)`` when tenancy is off."""
+        rt = self.runtime
+        tn = rt.tenancy
+        if tn is None:
+            return None, None
+        ident = self._tenant_identity(context)
+        name = ident.name
+        if tn.shed_rung(name, rt.degradation.current_level()):
+            # the tenancy rung sheds over-quota / background tenants
+            # BEFORE any fleet-wide degradation touches foreground work;
+            # sonata_shed_total{source="tenancy"} reads the plane's
+            # counter via set_function, so note_shed is the only bump
+            tn.note_shed(name)
+            self._abort_sonata(context, rpc, Overloaded(
+                f"degraded ({rt.degradation.level_name}): tenant "
+                f"{name!r} shed (background priority or over quota)"))
+        ok, retry_after = tn.charge(ident)
+        if not ok:
+            set_tm = getattr(context, "set_trailing_metadata", None)
+            if set_tm is not None:
+                try:
+                    set_tm(((tenancy_mod.RETRY_AFTER_TRAILER,
+                             f"{retry_after:.3f}"),))
+                except Exception:
+                    pass
+            self._abort_sonata(context, rpc, Overloaded(
+                f"tenant {name!r} over quota; retry in "
+                f"{retry_after:.3f}s"))
+        tn.note_admitted(name)
+        gate = tn.fair
+        if gate is None:
+            return None, name
+        deadline = rt.deadline_for(context)
+        rem = deadline.remaining() if deadline is not None else None
+        if not gate.enter(name, timeout_s=(max(0.0, rem)
+                                           if rem is not None else 30.0)):
+            tn.note_shed(name)
+            self._abort_sonata(context, rpc, Overloaded(
+                f"tenant {name!r}: weighted-fair queue wait exceeded "
+                "the request deadline"))
+        return gate, name
+
+    def _tenant_gated(self, request, context, rpc: str, miss_fn):
+        """Run one miss body inside the tenant synth gate (quota +
+        DRR slot); with tenancy off this is exactly ``miss_fn``."""
+        gate, name = self._tenant_synth_gate(context, rpc)
+        if gate is None:
+            yield from miss_fn(request, context)
+            return
+        try:
+            yield from miss_fn(request, context)
+        finally:
+            gate.leave(name)
+
+    def _tenant_observed(self, request, context, body):
+        """Tenant-attributed TTFB/e2e/error accounting around one
+        admitted stream body (called only with tenancy on — the off
+        path stays byte-for-byte).  Feeds the tenant's own SLO counter
+        rings on the scope plane; the global rings remain trace-fed."""
+        rt = self.runtime
+        ident = self._tenant_identity(context)
+        tenant = ident.name if ident is not None else None
+        scope = rt.scope
+        t0 = time.monotonic()
+        ok = True
+        try:
+            first = True
+            for msg in body(request, context):
+                if first:
+                    first = False
+                    if scope is not None:
+                        scope.observe_tenant(tenant, "ttfb",
+                                             time.monotonic() - t0)
+                yield msg
+            if scope is not None:
+                scope.observe_tenant(tenant, "e2e",
+                                     time.monotonic() - t0)
+        except GeneratorExit:
+            raise  # client hangup: not a server-attributed error
+        except BaseException:
+            ok = False
+            raise
+        finally:
+            if scope is not None:
+                scope.note_tenant_error(tenant, ok)
+
     def _admitted(self, request, context, rpc: str, body):
         """Run a streaming RPC body inside one admission slot and one
         request trace; sheds with RESOURCE_EXHAUSTED when the controller
@@ -438,7 +554,11 @@ class SonataGrpcService:
                                          rt.node_id),))
                             except Exception:
                                 pass  # terminated context / test double
-                    yield from body(request, context)
+                    if rt.tenancy is None:
+                        yield from body(request, context)
+                    else:
+                        yield from self._tenant_observed(request,
+                                                         context, body)
         except (Draining, Overloaded) as e:
             self._abort_sonata(context, rpc, e)
 
@@ -483,7 +603,13 @@ class SonataGrpcService:
         """
         v = self._get(request.voice_id, context)
         key = self._cache_key_for(v, request, kind)
-        outcome, handle = cache.lookup(key, tag=v.voice_id)
+        # the tenant OWNS the bytes a fill inserts (cache-share budget)
+        # but is never part of the key: identical text dedups across
+        # tenants, and a hit costs nobody quota
+        ident = self._tenant_identity(context)
+        outcome, handle = cache.lookup(
+            key, tag=v.voice_id,
+            owner=ident.name if ident is not None else None)
         if outcome == "hit":
             yield from self._replay_cached(handle, context, rpc, to_msg)
             return
@@ -599,12 +725,16 @@ class SonataGrpcService:
                               context) -> Iterator[pb.SynthesisResult]:
         cache = self.runtime.synth_cache
         if cache is None:  # default: byte-for-byte the pre-cache path
-            yield from self._synthesize_utterance_miss(request, context)
+            yield from self._tenant_gated(
+                request, context, "SynthesizeUtterance",
+                self._synthesize_utterance_miss)
             return
         yield from self._cached_stream(
             cache, request, context, rpc="SynthesizeUtterance",
             kind="utterance",
-            body=lambda: self._synthesize_utterance_miss(request, context),
+            body=lambda: self._tenant_gated(
+                request, context, "SynthesizeUtterance",
+                self._synthesize_utterance_miss),
             to_msg=lambda payload, aux: pb.SynthesisResult(
                 wav_samples=payload, rtf=aux if aux is not None else 0.0),
             payload_of=lambda msg: (msg.wav_samples, msg.rtf))
@@ -882,12 +1012,16 @@ class SonataGrpcService:
                              context) -> Iterator[pb.WaveSamples]:
         cache = self.runtime.synth_cache
         if cache is None:  # default: byte-for-byte the pre-cache path
-            yield from self._synthesize_realtime_miss(request, context)
+            yield from self._tenant_gated(
+                request, context, "SynthesizeUtteranceRealtime",
+                self._synthesize_realtime_miss)
             return
         yield from self._cached_stream(
             cache, request, context, rpc="SynthesizeUtteranceRealtime",
             kind="realtime",
-            body=lambda: self._synthesize_realtime_miss(request, context),
+            body=lambda: self._tenant_gated(
+                request, context, "SynthesizeUtteranceRealtime",
+                self._synthesize_realtime_miss),
             to_msg=lambda payload, aux: pb.WaveSamples(
                 wav_samples=payload),
             payload_of=lambda msg: (msg.wav_samples, None))

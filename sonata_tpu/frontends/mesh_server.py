@@ -71,6 +71,7 @@ from ..serving import (
     tracing,
 )
 from ..serving import fleetcache as fleetcache_mod
+from ..serving import tenancy as tenancy_mod
 from ..serving.fleetscope import FleetScope
 from ..serving.logs import configure_logging
 from ..serving.mesh import MeshRouter, parse_backends, resolve_node_id
@@ -211,6 +212,19 @@ class SonataMeshService:
             self.fleetcache.set_replicate_transport(self._replicate_stream)
             router.attach_fleetcache(self.fleetcache)
             self.fleetcache.bind_metrics(rt.registry)
+        #: sonata-tenancy (ISSUE 17): when the router runs with a
+        #: tenant table (SONATA_TENANTS — the runtime built rt.tenancy
+        #: from it), quota enforcement moves HERE: routed streams are
+        #: charged at the router and stamped with the
+        #: x-sonata-tenant-quota marker so nodes skip double-charging
+        #: (per-node buckets stay the fallback for direct traffic), and
+        #: the table itself is pushed to every node's /debug/tenants on
+        #: the prober threads — the placement desired-state pattern.
+        self.tenancy_propagator = None
+        if rt.tenancy is not None:
+            self.tenancy_propagator = tenancy_mod.ConfigPropagator(
+                rt.tenancy)
+            router.attach_tenancy(self.tenancy_propagator)
 
     # -- placement replay transport (the plane's apply_* callables) ----------
     def _apply_load(self, node, config_path: str):
@@ -581,6 +595,21 @@ class SonataMeshService:
                     deadline = rt.deadline_for(context)
                     payload = request.encode()
                     md = (("x-request-id", rid),)
+                    # sonata-tenancy (ISSUE 17): classify here, charge
+                    # AFTER the single-flight follow decision (a
+                    # follower rides a cache fill — parity with the
+                    # node's probe-before-charge order).  The forwarded
+                    # metadata names the tenant and marks quota as
+                    # router-enforced so the backend skips its bucket.
+                    tn = rt.tenancy
+                    identity = None
+                    if tn is not None:
+                        identity = tn.classify_context(context)
+                        md = md + (
+                            (tenancy_mod.ROUTER_TENANT_HEADER,
+                             identity.name),
+                            (tenancy_mod.ROUTER_ENFORCED_HEADER,
+                             tenancy_mod.ROUTER_ENFORCED_VALUE))
                     served = [None]
 
                     def start(node, timeout_s):
@@ -639,6 +668,31 @@ class SonataMeshService:
                             # through to an independent routed synth
                         finally:
                             flight.abandon()
+
+                    if tn is not None:
+                        # this stream synthesizes (bypass, fill, or a
+                        # follower whose leader died pre-first-chunk):
+                        # burn the tenant's router-side token now, and
+                        # refuse typed with a machine-readable
+                        # retry-after trailer when the bucket is dry
+                        ok, retry_after = tn.charge(
+                            identity._replace(router_enforced=False))
+                        if not ok:
+                            set_tm = getattr(
+                                context, "set_trailing_metadata", None)
+                            if set_tm is not None:
+                                try:
+                                    set_tm(((
+                                        tenancy_mod.RETRY_AFTER_TRAILER,
+                                        f"{retry_after:.3f}"),))
+                                except Exception:
+                                    pass
+                            self._abort(
+                                context, name,
+                                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                                f"tenant {identity.name!r} over quota; "
+                                f"retry in {retry_after:.3f}s")
+                        tn.note_admitted(identity.name)
 
                     fill = flight if outcome == "fill" else None
                     committed = False

@@ -42,6 +42,7 @@ from . import faults, tracing
 from . import mesh as mesh_mod
 from . import scope as scope_mod
 from . import synthcache as synthcache_mod
+from . import tenancy as tenancy_mod
 from . import warmup as warmup_mod
 from .admission import AdmissionController, Overloaded
 from .deadlines import Deadline, DeadlineExceeded, default_timeout_s
@@ -87,6 +88,7 @@ __all__ = [
     "VoiceWarming",
     "scope_mod",
     "synthcache_mod",
+    "tenancy_mod",
     "ServingRuntime",
     "Trace",
     "Tracer",
@@ -246,6 +248,30 @@ class ServingRuntime:
                 self.scope.add_probe(
                     "cache_bytes",
                     lambda: float(self.synth_cache.bytes_used))
+        #: tenant control plane (ISSUE 17): enabled by SONATA_TENANTS
+        #: (default off — every RPC path is then byte-for-byte the
+        #: pre-tenancy shape, pinned).  The fair gate sizes its slots to
+        #: the admission controller's in-flight ceiling: below it entry
+        #: is immediate, at it the DRR queues take over.
+        self.tenancy: Optional[tenancy_mod.TenantPlane] = \
+            tenancy_mod.from_env(fair_slots=self.admission.max_in_flight)
+        if self.tenancy is not None:
+            self.tenancy.bind_metrics(r)
+            self.shed.labels(source="tenancy").set_function(
+                lambda: sum(self.tenancy.stat(t, "shed")
+                            for t in self.tenancy.tenant_names()))
+            if self.scope is not None:
+                # padding-waste chargeback: the scope pro-rates each
+                # dispatch's waste over the tenants running synthesis
+                # at that moment
+                self.scope.attach_tenant_mix(self.tenancy.active_mix)
+            if self.synth_cache is not None:
+                # per-tenant insert budgets: a tenant's committed bytes
+                # are bounded to cache_share x SONATA_SYNTH_CACHE_MB
+                # (tenancy never joins the cache KEY — identical text
+                # still dedups across tenants)
+                self.synth_cache.set_share_resolver(
+                    self.tenancy.cache_share)
         #: per-voice flight-recorder probes added by register_voice, so
         #: unregister removes exactly what was added
         self._voice_probes: dict = {}
@@ -298,7 +324,8 @@ class ServingRuntime:
         self.http = start_http_server(self.registry, health=self.health,
                                       port=resolved, host=host,
                                       tracer=self.tracer, scope=self.scope,
-                                      fleet=self.fleet)
+                                      fleet=self.fleet,
+                                      tenancy=self.tenancy)
         return self.http.port
 
     @property
@@ -522,6 +549,8 @@ class ServingRuntime:
 
     def close(self) -> None:
         degradation_mod.uninstall(self.degradation)
+        if self.tenancy is not None:
+            self.tenancy.close()
         if self.synth_cache is not None:
             self.synth_cache.close()
         if self.scope is not None:

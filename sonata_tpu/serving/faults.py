@@ -102,6 +102,7 @@ SITES = (
     "mesh.reconcile",
     "mesh.cache_affinity",
     "cache.lookup",
+    "tenancy.classify",
 )
 
 MODES = ("error", "hang", "slow", "corrupt-shape")

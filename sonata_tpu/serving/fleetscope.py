@@ -151,13 +151,16 @@ class _NodeScope:
 
     __slots__ = ("node_id", "scraped_mono", "wall_offset_s", "rtt_s",
                  "export_bytes", "stage_rings", "slo_rings", "totals",
-                 "top_waste_buckets", "synth_cache")
+                 "top_waste_buckets", "synth_cache", "tenant_slos",
+                 "tenant_waste")
 
     def __init__(self, node_id: str, scraped_mono: float,
                  wall_offset_s: float, rtt_s: float, export_bytes: int,
                  stage_rings: dict, slo_rings: dict, totals: dict,
                  top_waste_buckets: list,
-                 synth_cache: Optional[dict] = None):
+                 synth_cache: Optional[dict] = None,
+                 tenant_slos: Optional[dict] = None,
+                 tenant_waste: Optional[list] = None):
         self.node_id = node_id
         self.scraped_mono = scraped_mono
         #: node wall clock minus router wall clock, measured against the
@@ -175,6 +178,11 @@ class _NodeScope:
         #: None on cache-off nodes; the fleet-cache replication pass
         #: reads hot_keys from here via node_cache_view
         self.synth_cache = synth_cache
+        #: tenant -> (slo name, window label) -> (window_s, ring) —
+        #: empty on tenancy-off nodes (ISSUE 17)
+        self.tenant_slos = tenant_slos or {}
+        #: the node's per-tenant padding-waste rows (ISSUE 17)
+        self.tenant_waste = tenant_waste or []
 
 
 class FleetScope:
@@ -405,6 +413,18 @@ class FleetScope:
                 window_s, _slot_s, ring = \
                     sketches.counter_ring_from_export(ring_payload)
                 slo_rings[(name, label)] = (window_s, ring)
+        # per-tenant SLO rings (ISSUE 17): same counter-ring format as
+        # the global slos, one layer deeper — parsed whole at ingest so
+        # a malformed tenant ring rejects the export typed, like the rest
+        tenant_slos: dict = {}
+        for tenant, tslos in (payload.get("tenant_slos") or {}).items():
+            rings: dict = {}
+            for name, windows in dict(tslos).items():
+                for label, ring_payload in dict(windows).items():
+                    window_s, _slot_s, ring = \
+                        sketches.counter_ring_from_export(ring_payload)
+                    rings[(name, label)] = (window_s, ring)
+            tenant_slos[str(tenant)] = rings
         wall = payload.get("wall_time")
         offset = 0.0
         if isinstance(wall, (int, float)) and wall_mid is not None:
@@ -419,7 +439,9 @@ class FleetScope:
                                    or ()),
             synth_cache=(dict(payload["synth_cache"])
                          if isinstance(payload.get("synth_cache"), dict)
-                         else None))
+                         else None),
+            tenant_slos=tenant_slos,
+            tenant_waste=list(payload.get("tenant_waste") or ()))
         with self._lock:
             self._nodes[node.index] = ns
             self._no_scope.discard(node.index)
@@ -636,6 +658,11 @@ class FleetScope:
         cache_rollup = self._cache_rollup(by_index.values())
         if fleetcache is not None:
             cache_rollup["router"] = fleetcache.snapshot()
+        # multi-tenant rollup (ISSUE 17): fleet-merged per-tenant burn
+        # plus the padding-waste chargeback — empty dict/list while the
+        # fleet runs tenancy-off, so the document shape is stable
+        tenant_burn = self.fleet_tenant_burn(by_index.values())
+        tenant_waste = self._merged_tenant_waste(by_index.values())
         return {
             "name": view["name"],
             "routable": view["routable"],
@@ -651,6 +678,8 @@ class FleetScope:
                 "cache": cache_rollup,
                 "top_waste_buckets": self._merged_waste_rows(
                     by_index.values()),
+                "tenants": tenant_burn,
+                "tenant_waste": tenant_waste,
             }}
 
     # -- fleet cache rollup (ISSUE 16) -----------------------------------------
@@ -689,6 +718,72 @@ class FleetScope:
         if total == 0:
             return None
         return (b / total) / spec.budget
+
+    # -- per-tenant fleet burn (ISSUE 17) ---------------------------------------
+    def _tenant_totals(self, ns: _NodeScope, tenant: str, slo: str,
+                       window: str) -> tuple:
+        entry = ns.tenant_slos.get(tenant, {}).get((slo, window))
+        if entry is None:
+            return 0, 0
+        window_s, ring = entry
+        extra = self._clock() - ns.scraped_mono
+        good = bad = 0
+        for age_s, g, b in ring:
+            if age_s + extra > window_s:
+                continue
+            good += g
+            bad += b
+        return good, bad
+
+    def fleet_tenant_burn(self, node_scopes=None) -> dict:
+        """Fleet-merged per-tenant burn: tenant -> slo -> window ->
+        bad fraction / budget (node counters summed), empty while no
+        node exports tenant rings — the /debug/fleet 'tenants' block."""
+        if node_scopes is None:
+            node_scopes = self._node_scopes()
+        tenants: set = set()
+        for ns in node_scopes:
+            tenants.update(ns.tenant_slos)
+        out: dict = {}
+        for tenant in sorted(tenants):
+            per_slo: dict = {}
+            for spec in self.slos:
+                burns: dict = {}
+                for label in (FAST_WINDOW[0], SLOW_WINDOW[0]):
+                    good = bad = 0
+                    for ns in node_scopes:
+                        g, b = self._tenant_totals(
+                            ns, tenant, spec.name, label)
+                        good += g
+                        bad += b
+                    total = good + bad
+                    if total:
+                        burns[label] = _round6(
+                            (bad / total) / spec.budget)
+                if burns:
+                    per_slo[spec.name] = burns
+            if per_slo:
+                out[tenant] = per_slo
+        return out
+
+    @staticmethod
+    def _merged_tenant_waste(node_scopes) -> list:
+        """Fleet per-tenant padding-waste chargeback: nodes' tenant
+        rows summed by tenant, ranked by waste seconds."""
+        acc: dict = {}
+        for ns in node_scopes:
+            for row in ns.tenant_waste:
+                tenant = row.get("tenant")
+                if not tenant:
+                    continue
+                slot = acc.setdefault(tenant, {
+                    "tenant": tenant, "dispatches": 0,
+                    "seconds": 0.0, "waste_seconds": 0.0})
+                slot["dispatches"] += int(row.get("dispatches", 0))
+                for k in ("seconds", "waste_seconds"):
+                    slot[k] = round(slot[k] + float(row.get(k, 0.0)), 6)
+        return sorted(acc.values(), key=lambda r: r["waste_seconds"],
+                      reverse=True)
 
     @staticmethod
     def _merged_waste_rows(node_scopes, top: int = 10) -> list:

@@ -397,6 +397,9 @@ class MeshRouter:
         #: cacheable requests toward their rendezvous owner, replicates
         #: hot entries on the prober threads; None costs one read
         self._fleetcache = None
+        #: attached tenant-config propagator (ISSUE 17) — pushes the
+        #: router's tenant table to nodes on the prober threads
+        self._tenancy_propagator = None
         self._probers: list = []
         if start_probers:
             for node in self.nodes:
@@ -458,6 +461,20 @@ class MeshRouter:
     @property
     def fleetcache(self):
         return self._fleetcache
+
+    # -- tenant-config propagation attachment (ISSUE 17) -----------------------
+    def attach_tenancy(self, propagator) -> None:
+        """Attach the tenant-config propagator: each node's prober
+        calls ``propagator.on_probe_cycle(node)`` after every health
+        cycle (the desired-state push rides the prober threads at the
+        propagator's own slower cadence, like the placement
+        reconciler), so every node converges to the router's tenant
+        table without a control-plane dependency."""
+        self._tenancy_propagator = propagator
+
+    @property
+    def tenancy_propagator(self):
+        return self._tenancy_propagator
 
     def routable_nodes(self) -> list:
         """Snapshot of the nodes currently accepting traffic (the
@@ -769,6 +786,16 @@ class MeshRouter:
                     log.exception(
                         "mesh %s: fleet-cache replication error "
                         "(node %s)", self.name, node.node_id)
+            propagator = self._tenancy_propagator
+            if propagator is not None:
+                try:
+                    # config push is idempotent desired-state: failures
+                    # are counted inside, this guard catches plane bugs
+                    propagator.on_probe_cycle(node)
+                except Exception:
+                    log.exception(
+                        "mesh %s: tenant-config push error (node %s)",
+                        self.name, node.node_id)
             self._wake.wait(timeout=self.probe_interval_s)
 
     # -- routing --------------------------------------------------------------

@@ -318,6 +318,7 @@ class _Handler(BaseHTTPRequestHandler):
     tracer = None
     scope = None
     fleet = None
+    tenancy = None
 
     def do_GET(self):  # noqa: N802 (http.server API)
         from . import faults
@@ -367,8 +368,53 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply_scope(path, query)
         elif path in ("/debug/fleet", "/debug/traces/stitched"):
             self._reply_fleet(path, query)
+        elif path == "/debug/tenants":
+            self._reply_tenants()
         else:
             self._reply(404, b"not found\n")
+
+    def do_POST(self):  # noqa: N802 (http.server API)
+        """``POST /debug/tenants`` — the mesh router's desired-state
+        tenant-config push (sonata-tenancy): a revisioned table the
+        node plane applies idempotently.  404 on tenancy-off processes
+        (enabling tenancy stays the node operator's call — the router
+        only synchronizes tables, it cannot switch the feature on)."""
+        import json
+
+        path, _, _ = self.path.partition("?")
+        if path != "/debug/tenants":
+            self._reply(404, b"not found\n")
+            return
+        if self.tenancy is None:
+            self._reply(404, b"tenancy not enabled on this server\n")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0") or 0)
+            doc = json.loads(self.rfile.read(length).decode("utf-8"))
+            applied = self.tenancy.apply_remote(doc)
+        except (ValueError, UnicodeDecodeError) as e:
+            self._reply(400, (str(e) + "\n").encode())
+            return
+        body = json.dumps({"applied": applied,
+                           "revision": self.tenancy.revision,
+                           "remote_revision":
+                               self.tenancy.remote_revision})
+        self._reply(200, body.encode("utf-8"),
+                    "application/json; charset=utf-8")
+
+    # -- tenant control plane (serving/tenancy.py) ---------------------------
+    def _reply_tenants(self) -> None:
+        """``GET /debug/tenants``: the tenant table + per-tenant
+        counters/queue state.  Same gate as the scope/tracer siblings:
+        tenancy off, no surface."""
+        import json
+
+        if self.tenancy is None:
+            self._reply(404, b"tenancy not enabled on this server\n")
+            return
+        body = json.dumps(self.tenancy.debug_doc())
+        self._reply(200, body.encode("utf-8"),
+                    "application/json; charset=utf-8")
 
     # -- aggregation plane (serving/scope.py) --------------------------------
     def _reply_scope(self, path: str, query: str) -> None:
@@ -583,7 +629,7 @@ def start_http_server(registry: MetricsRegistry, health=None,
                       port: Optional[int] = None,
                       host: Optional[str] = None,
                       tracer=None, scope=None,
-                      fleet=None) -> MetricsHTTPServer:
+                      fleet=None, tenancy=None) -> MetricsHTTPServer:
     """Serve ``/metrics``, ``/healthz``, ``/readyz`` — plus, when a
     :class:`~sonata_tpu.serving.tracing.Tracer` is given,
     ``/debug/traces``, ``/debug/slowest``, and ``/debug/profile``; when
@@ -596,7 +642,8 @@ def start_http_server(registry: MetricsRegistry, health=None,
     host = host or os.environ.get(METRICS_HOST_ENV, "127.0.0.1")
     handler = type("BoundHandler", (_Handler,),
                    {"registry": registry, "health": health,
-                    "tracer": tracer, "scope": scope, "fleet": fleet})
+                    "tracer": tracer, "scope": scope, "fleet": fleet,
+                    "tenancy": tenancy})
     httpd = ThreadingHTTPServer((host, port or 0), handler)
     httpd.daemon_threads = True
     return MetricsHTTPServer(httpd)

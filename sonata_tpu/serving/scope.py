@@ -278,6 +278,22 @@ class Scope:
                 self._latency_slos.setdefault(spec.stage, []).append(spec)
         self._error_slos = [s for s in self.slos if s.kind == "error_rate"]
 
+        #: sonata-tenancy burn accounting: tenant -> slo name -> window
+        #: label -> RollingCounter, created lazily on the tenant's first
+        #: observation (the same SONATA_SLO objectives, counted per
+        #: tenant so one tenant's burn cannot hide inside the global
+        #: ring).  Empty — zero cost beyond one dict read — on
+        #: tenancy-off processes.
+        self._tenant_lock = threading.Lock()
+        self._tenant_slo: Dict[str, Dict[str, Dict[str,
+                                                   RollingCounter]]] = {}
+        #: tenant -> padding-waste accumulators (chargeback rows on
+        #: /debug/buckets): each dispatch's waste is pro-rated over the
+        #: tenants running synthesis at that moment (the fair gate's
+        #: active mix), attached by the runtime via attach_tenant_mix
+        self._tenant_waste: Dict[str, dict] = {}
+        self._tenant_mix_fn: Optional[Callable[[], dict]] = None
+
         # dispatch-efficiency accounting
         self._bucket_lock = threading.Lock()
         #: (batch, text, frame) bucket -> accumulators
@@ -360,6 +376,72 @@ class Scope:
             for counter in self._slo_counts[spec.name].values():
                 counter.record(bad=bad)
 
+    # -- per-tenant SLO burn (sonata-tenancy) ---------------------------------
+    def _tenant_rings(self, tenant: str, slo: str) -> Dict[str,
+                                                           "RollingCounter"]:
+        with self._tenant_lock:
+            rings = self._tenant_slo.get(tenant, {}).get(slo)
+        if rings is not None:
+            return rings
+        # construct outside the lock (first observation per (tenant,
+        # slo) only); the double-checked setdefault keeps one winner
+        fresh = {label: RollingCounter(seconds, slots,
+                                       clock=self._clock)
+                 for label, seconds, slots in (FAST_WINDOW,
+                                               SLOW_WINDOW)}
+        with self._tenant_lock:
+            by_slo = self._tenant_slo.setdefault(tenant, {})
+            return by_slo.setdefault(slo, fresh)
+
+    def observe_tenant(self, tenant: Optional[str], stage: str,
+                       seconds: float) -> None:
+        """One tenant-attributed stage observation, feeding the
+        tenant's own copy of that stage's latency SLO rings.  The
+        GLOBAL rings are fed by :meth:`note_trace`/:meth:`observe` as
+        before — this is strictly additive, a no-op when ``tenant`` is
+        None (tenancy off)."""
+        if tenant is None or seconds < 0:
+            return
+        for spec in self._latency_slos.get(stage, ()):
+            bad = seconds > spec.threshold_s
+            for counter in self._tenant_rings(tenant, spec.name).values():
+                counter.record(bad=bad)
+
+    def note_tenant_error(self, tenant: Optional[str], ok: bool) -> None:
+        """One tenant-attributed request outcome for the error-rate
+        SLOs (no-op when ``tenant`` is None)."""
+        if tenant is None:
+            return
+        for spec in self._error_slos:
+            for counter in self._tenant_rings(tenant, spec.name).values():
+                counter.record(bad=not ok)
+
+    def attach_tenant_mix(self, mix_fn: Callable[[], dict]) -> None:
+        """Attach the tenancy plane's active-stream mix (tenant →
+        running synthesis streams) so dispatch padding waste can be
+        pro-rated into per-tenant chargeback rows."""
+        self._tenant_mix_fn = mix_fn
+
+    def tenant_burn_snapshot(self) -> dict:
+        """{tenant: {slo: {window: burn_rate}}} — the per-tenant rows
+        ``/debug/quantiles`` and the fleet merge serve."""
+        budgets = {spec.name: spec.budget for spec in self.slos}
+        with self._tenant_lock:
+            out = {}
+            for tenant, by_slo in sorted(self._tenant_slo.items()):
+                rows = {}
+                for slo, rings in by_slo.items():
+                    budget = budgets.get(slo)
+                    if not budget:
+                        continue
+                    rows[slo] = {
+                        label: _round6(
+                            None if (frac := ring.bad_fraction()) is None
+                            else frac / budget)
+                        for label, ring in rings.items()}
+                out[tenant] = rows
+            return out
+
     def note_trace(self, trace) -> None:
         """Feed one finished trace: per-request stages, TTFB, e2e, and
         the error-rate SLOs.  Runs at trace-finish time (after the last
@@ -427,6 +509,29 @@ class Scope:
             self.note_incident("cold-compile")
         if ratio is None:
             return  # a model that never annotated (no bucket story)
+        # per-tenant chargeback (sonata-tenancy): a dispatch batch can
+        # mix tenants' sentences, so its waste is pro-rated over the
+        # tenants with running synthesis streams at this moment
+        mix_fn = self._tenant_mix_fn
+        mix = None
+        if mix_fn is not None:
+            try:
+                mix = mix_fn() or None
+            except Exception:
+                mix = None
+        if mix is not None:
+            total_streams = sum(mix.values()) or 1
+            with self._tenant_lock:
+                for tenant, streams in mix.items():
+                    acc = self._tenant_waste.get(tenant)
+                    if acc is None:
+                        acc = self._tenant_waste[tenant] = {
+                            "dispatches": 0, "seconds": 0.0,
+                            "waste_seconds": 0.0}
+                    frac = streams / total_streams
+                    acc["dispatches"] += 1
+                    acc["seconds"] += duration_s * frac
+                    acc["waste_seconds"] += waste * frac
         with self._bucket_lock:
             self.padding_waste_seconds_total += waste
             if voice is not None:
@@ -658,6 +763,11 @@ class Scope:
         cache = self.cache_snapshot()
         if cache is not None:
             doc["synth_cache"] = cache
+        tenants = self.tenant_burn_snapshot()
+        if tenants:
+            # per-tenant SLO burn rows (sonata-tenancy); absent on
+            # tenancy-off processes, so the pre-tenancy shape is intact
+            doc["tenants"] = tenants
         return doc
 
     def slo_snapshot(self) -> dict:
@@ -691,7 +801,23 @@ class Scope:
                     "per_voice_waste_seconds": {
                         v: round(w, 6)
                         for v, w in sorted(self._voice_waste.items())},
-                    "buckets": rows}
+                    "buckets": rows,
+                    **self._tenant_waste_rows()}
+
+    def _tenant_waste_rows(self) -> dict:
+        """``{"tenant_waste": [...]}`` rows for the buckets view, or
+        ``{}`` (tenancy off — the pre-tenancy document shape holds)."""
+        with self._tenant_lock:
+            if not self._tenant_waste:
+                return {}
+            rows = [{"tenant": tenant,
+                     **{k: (round(v, 6) if isinstance(v, float) else v)
+                        for k, v in acc.items()}}
+                    for tenant, acc in sorted(
+                        self._tenant_waste.items(),
+                        key=lambda kv: kv[1]["waste_seconds"],
+                        reverse=True)]
+            return {"tenant_waste": rows}
 
     def timeline_snapshot(self) -> list:
         with self._timeline_lock:
@@ -754,6 +880,20 @@ class Scope:
         cache = self.cache_snapshot()
         if cache is not None:
             doc["synth_cache"] = cache
+        # per-tenant SLO rings + waste rows (sonata-tenancy) ride the
+        # same export, keyed additively like synth_cache: absent on
+        # tenancy-off nodes, importers use .get — no EXPORT_VERSION bump
+        with self._tenant_lock:
+            if self._tenant_slo:
+                doc["tenant_slos"] = {
+                    tenant: {
+                        slo: {label: ring.export()
+                              for label, ring in rings.items()}
+                        for slo, rings in by_slo.items()}
+                    for tenant, by_slo in self._tenant_slo.items()}
+        tenant_waste = self._tenant_waste_rows()
+        if tenant_waste:
+            doc.update(tenant_waste)
         return doc
 
     def timeline_chrome(self) -> dict:

@@ -263,15 +263,21 @@ class _Entry:
     drop_tag` can purge a voice's streams on unload/reload)."""
 
     __slots__ = ("key", "chunks", "bytes", "state", "cond", "tag",
-                 "invalidated")
+                 "invalidated", "owner")
 
-    def __init__(self, key: str, tag: Optional[str] = None):
+    def __init__(self, key: str, tag: Optional[str] = None,
+                 owner: Optional[str] = None):
         self.key = key
         self.chunks: list = []          # [(payload, aux), ...]
         self.bytes = 0
         self.state = _FILLING
         self.cond = threading.Condition()
         self.tag = tag
+        #: the tenant whose miss filled this entry (sonata-tenancy).
+        #: Ownership bounds the tenant's INSERT budget only — the key
+        #: is tenant-free, so other tenants' identical requests hit
+        #: this entry without charging anyone's share twice.
+        self.owner = owner
         #: set (under the registry lock) by drop_tag while this entry
         #: is still filling: the fill keeps streaming to its clients,
         #: but its commit must not insert — the tag's voice was
@@ -282,7 +288,7 @@ class _Entry:
     def view(self) -> dict:
         return {"key": self.key, "chunks": len(self.chunks),
                 "bytes": self.bytes, "state": self.state,
-                "tag": self.tag}
+                "tag": self.tag, "owner": self.owner}
 
 
 class FillHandle:
@@ -404,10 +410,25 @@ class SynthCache:
         self._stats = {"hits": 0, "misses": 0, "inserts": 0,
                        "evictions": 0, "follower_joins": 0,
                        "lookup_errors": 0, "oversize_skips": 0,
-                       "invalidations": 0}
+                       "invalidations": 0, "share_evictions": 0}
+        #: sonata-tenancy insert budgets: owner tenant -> committed
+        #: bytes, and the resolver mapping an owner to its fraction of
+        #: max_bytes (None = unshared).  Wired by the runtime when both
+        #: planes are enabled; absent, nothing below changes behavior.
+        self._owner_bytes: dict = {}
+        self._share_of = None
+
+    def set_share_resolver(self, share_of) -> None:
+        """Attach the tenancy plane's ``cache_share`` resolver: owner →
+        fraction of ``max_bytes`` that owner's committed entries may
+        hold (None = unshared).  Enforced at commit time — one tenant's
+        template churn then evicts its OWN least-recent entries first
+        and can never flush another tenant's hot set."""
+        self._share_of = share_of
 
     # -- the request-path surface --------------------------------------------
-    def lookup(self, key: str, tag: Optional[str] = None):
+    def lookup(self, key: str, tag: Optional[str] = None,
+               owner: Optional[str] = None):
         """Probe the cache for ``key``.  Returns one of:
 
         - ``("hit", chunks)`` — a committed entry; ``chunks`` is its
@@ -425,7 +446,10 @@ class SynthCache:
           — a broken cache can never fail a request.
 
         ``tag`` labels a new fill's entry for group invalidation
-        (:meth:`drop_tag`); the frontends tag by voice id.
+        (:meth:`drop_tag`); the frontends tag by voice id.  ``owner``
+        names the tenant whose miss fills the entry (sonata-tenancy:
+        commit-time insert budgets) — NEVER part of the key, so
+        identical requests across tenants still dedup to one entry.
         """
         try:
             faults.fire("cache.lookup")
@@ -442,7 +466,7 @@ class SynthCache:
                     self._stats["follower_joins"] += 1
                     return ("follow",
                             FollowerStream(self, filling, self.wait_s))
-                entry = _Entry(key, tag=tag)
+                entry = _Entry(key, tag=tag, owner=owner)
                 self._filling[key] = entry
                 self._stats["misses"] += 1
                 return ("fill", FillHandle(self, entry))
@@ -456,27 +480,73 @@ class SynthCache:
             return ("bypass", None)
 
     # -- fill resolution (FillHandle calls these) ----------------------------
+    def _owner_budget_locked(self, owner: Optional[str]) -> Optional[int]:
+        """The owner tenant's committed-byte ceiling, or None (unshared
+        — the pre-tenancy behavior, and the behavior for any tenant
+        with no configured ``cache_share``)."""
+        if owner is None or self._share_of is None:
+            return None
+        try:
+            share = self._share_of(owner)
+        except Exception:
+            return None
+        if share is None or share <= 0:
+            return None
+        return int(min(1.0, share) * self.max_bytes)
+
+    def _unlink_locked(self, key: str) -> "_Entry":
+        old = self._entries.pop(key)
+        self._bytes -= old.bytes
+        if old.owner is not None:
+            left = self._owner_bytes.get(old.owner, 0) - old.bytes
+            if left > 0:
+                self._owner_bytes[old.owner] = left
+            else:
+                self._owner_bytes.pop(old.owner, None)
+        return old
+
     def _commit(self, entry: _Entry) -> None:
         evicted = []
         with self._lock:
             self._filling.pop(entry.key, None)
+            budget = self._owner_budget_locked(entry.owner)
             if entry.invalidated:
                 # the tag was dropped mid-fill (voice unload/reload):
                 # the stream served its clients, the entry must not land
                 self._stats["invalidations"] += 1
-            elif not self._closed and entry.bytes <= self.max_bytes:
+            elif (not self._closed and entry.bytes <= self.max_bytes
+                    and (budget is None or entry.bytes <= budget)):
+                # per-tenant insert budget (sonata-tenancy): the owner's
+                # committed bytes stay under its share by evicting the
+                # owner's OWN least-recent entries first — a churning
+                # tenant can never flush another tenant's hot set
+                if budget is not None:
+                    while (self._owner_bytes.get(entry.owner, 0)
+                           + entry.bytes > budget):
+                        doomed = next(
+                            (k for k, e in self._entries.items()
+                             if e.owner == entry.owner), None)
+                        if doomed is None:
+                            break
+                        evicted.append(self._unlink_locked(doomed).key[:12])
+                        self._stats["evictions"] += 1
+                        self._stats["share_evictions"] += 1
                 self._entries[entry.key] = entry
                 self._entries.move_to_end(entry.key)
                 self._bytes += entry.bytes
+                if entry.owner is not None:
+                    self._owner_bytes[entry.owner] = (
+                        self._owner_bytes.get(entry.owner, 0) + entry.bytes)
                 self._stats["inserts"] += 1
                 while self._bytes > self.max_bytes:
-                    _k, old = self._entries.popitem(last=False)
-                    self._bytes -= old.bytes
+                    k = next(iter(self._entries))
+                    evicted.append(self._unlink_locked(k).key[:12])
                     self._stats["evictions"] += 1
-                    evicted.append(old.key[:12])
             elif not self._closed:
-                # one stream bigger than the whole budget: caching it
-                # would evict everything and immediately evict itself
+                # one stream bigger than the whole budget (or the
+                # owner's whole share): caching it would evict
+                # everything it is allowed to hold and immediately
+                # evict itself
                 self._stats["oversize_skips"] += 1
         with entry.cond:
             entry.state = _COMPLETE
@@ -511,7 +581,7 @@ class SynthCache:
         with self._lock:
             doomed = [k for k, e in self._entries.items() if e.tag == tag]
             for k in doomed:
-                self._bytes -= self._entries.pop(k).bytes
+                self._unlink_locked(k)
             self._stats["invalidations"] += len(doomed)
             for e in self._filling.values():
                 if e.tag == tag:
@@ -555,11 +625,17 @@ class SynthCache:
                 ratio = round(self._stats["hits"] / total, 6)
             hot = list(self._entries)[-HOT_KEYS_MAX:]
             hot.reverse()
-            return {**self._stats, "hit_ratio": ratio,
+            view = {**self._stats, "hit_ratio": ratio,
                     "bytes": self._bytes, "entries": len(self._entries),
                     "max_bytes": self.max_bytes,
                     "filling": len(self._filling),
                     "hot_keys": hot}
+            if self._owner_bytes:
+                # per-tenant resident bytes (chargeback rows; absent
+                # pre-tenancy — importers use .get, no shape break)
+                view["owner_bytes"] = dict(sorted(
+                    self._owner_bytes.items()))
+            return view
 
     def bind_metrics(self, registry) -> None:
         """Attach the cache's series as scrape-time callbacks.  The
@@ -602,4 +678,5 @@ class SynthCache:
         with self._lock:
             self._closed = True
             self._entries.clear()
+            self._owner_bytes.clear()
             self._bytes = 0

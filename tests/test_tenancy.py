@@ -1,0 +1,652 @@
+"""sonata-tenancy tests (ISSUE 17): multi-tenant admission, weighted
+fairness, and per-tenant accounting.
+
+Layers:
+
+- token-bucket determinism under an injected clock (refill math,
+  retry-after honesty, burst capping, 0-qps = unlimited);
+- classification: unlabeled/unknown traffic lands in ``default``, the
+  ``tenancy.classify`` failpoint degrades to ``default`` (served and
+  counted, never refused), router markers are honored only for
+  locally-known names;
+- the DRR fair gate: immediate entry below saturation, 2:1 weight →
+  2:1 grant proportionality under saturation, burst isolation (a
+  flooding tenant deepens only its OWN queue), and timeout behavior;
+- config lifecycle: hot reload preserving unchanged buckets, parse
+  errors keeping the old table, router desired-state pushes
+  (idempotent, stale-refused, ownership over local reloads) and the
+  :class:`~sonata_tpu.serving.tenancy.ConfigPropagator` ack /
+  anti-entropy loop;
+- the shed-ladder rung ordering and per-tenant synth-cache insert
+  budgets (owner accounting — NEVER the cache key);
+- the wire-compat pin: ``SONATA_TENANTS`` unset ⇒ ``from_env()`` is
+  None, so every frontend hook reduces to one ``is None`` branch and
+  the request path is byte-for-byte the pre-tenancy shape.
+"""
+
+import json
+import threading
+
+import pytest
+
+from sonata_tpu.serving import faults
+from sonata_tpu.serving import metrics as metrics_mod
+from sonata_tpu.serving import synthcache as sc
+from sonata_tpu.serving import tenancy as tn
+from sonata_tpu.serving.admission import Overloaded
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    faults.registry().disarm_all()
+    yield
+    faults.registry().disarm_all()
+
+
+class FakeClock:
+    def __init__(self, now=1000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+TABLE = json.dumps({"tenants": {
+    "gold": {"weight": 3, "qps": 10, "burst": 20, "cache_share": 0.5},
+    "bronze": {"weight": 1, "qps": 2, "burst": 2},
+    "batch": {"weight": 1, "shed_priority": 1},
+}})
+
+
+def make_plane(source=TABLE, **kw):
+    return tn.TenantPlane(source, **kw)
+
+
+# ---------------------------------------------------------------------------
+# token bucket
+# ---------------------------------------------------------------------------
+
+def test_token_bucket_deterministic_refill():
+    clock = FakeClock()
+    bucket = tn.TokenBucket(qps=2.0, burst=2.0, clock=clock)
+    assert bucket.try_take() == (True, 0.0)
+    assert bucket.try_take() == (True, 0.0)
+    ok, retry = bucket.try_take()
+    assert not ok
+    # an empty 2-qps bucket refills one token in exactly 0.5 s — the
+    # trailer value is the honest backoff, not a guess
+    assert retry == pytest.approx(0.5)
+    clock.advance(0.25)
+    ok, retry = bucket.try_take()
+    assert not ok and retry == pytest.approx(0.25)
+    clock.advance(0.25)
+    assert bucket.try_take() == (True, 0.0)
+
+
+def test_token_bucket_caps_at_burst():
+    clock = FakeClock()
+    bucket = tn.TokenBucket(qps=10.0, burst=3.0, clock=clock)
+    clock.advance(3600.0)  # an idle hour banks at most `burst` tokens
+    grants = sum(bucket.try_take()[0] for _ in range(10))
+    assert grants == 3
+
+
+def test_token_bucket_zero_qps_is_unlimited():
+    bucket = tn.TokenBucket(qps=0.0, burst=1.0, clock=FakeClock())
+    assert all(bucket.try_take() == (True, 0.0) for _ in range(100))
+    assert not bucket.empty()
+
+
+def test_token_bucket_empty_is_read_only():
+    clock = FakeClock()
+    bucket = tn.TokenBucket(qps=1.0, burst=1.0, clock=clock)
+    assert not bucket.empty()
+    assert bucket.try_take()[0]
+    assert bucket.empty()
+    # probing emptiness must not move tokens
+    clock.advance(1.0)
+    assert not bucket.empty()
+    assert bucket.try_take()[0]
+    assert not bucket.try_take()[0]
+
+
+# ---------------------------------------------------------------------------
+# config parsing
+# ---------------------------------------------------------------------------
+
+def test_parse_tenants_synthesizes_default():
+    table = tn.parse_tenants(json.loads(TABLE))
+    assert tn.DEFAULT_TENANT in table
+    default = table[tn.DEFAULT_TENANT]
+    assert default.qps == 0.0 and default.weight == 1.0
+    assert table["gold"].weight == 3.0
+
+
+def test_parse_tenants_rejects_unknown_fields():
+    with pytest.raises(ValueError, match="unknown field"):
+        tn.parse_tenants({"tenants": {"a": {"qqps": 1}}})
+    with pytest.raises(ValueError):
+        tn.parse_tenants({"tenants": {"a": 3}})
+
+
+def test_burst_defaults_to_one_second_of_refill():
+    cfg = tn.TenantConfig("a", qps=5.0)
+    assert cfg.burst == 5.0
+    assert tn.TenantConfig("b", qps=0.2).burst == 1.0
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+def test_classify_unlabeled_and_unknown_land_in_default():
+    plane = make_plane(clock=FakeClock())
+    assert plane.classify(None) == (tn.DEFAULT_TENANT, False)
+    assert plane.classify(()) == (tn.DEFAULT_TENANT, False)
+    assert plane.classify((("x-tenant-id", "gold"),)).name == "gold"
+    # a client-controlled header can never mint label cardinality
+    assert plane.classify(
+        (("x-tenant-id", "nobody"),)).name == tn.DEFAULT_TENANT
+
+
+def test_classify_router_marker_only_for_known_names():
+    plane = make_plane(clock=FakeClock())
+    routed = plane.classify((
+        ("x-sonata-tenant", "gold"),
+        ("x-sonata-tenant-quota", "router")))
+    assert routed == ("gold", True)
+    # a marker naming a tenant this node does not know falls back to
+    # local charging on `default` — never a free pass for unknown ids
+    stale = plane.classify((
+        ("x-sonata-tenant", "ghost"),
+        ("x-sonata-tenant-quota", "router")))
+    assert stale == (tn.DEFAULT_TENANT, False)
+    # the router's classification outranks the client header on the hop
+    both = plane.classify((
+        ("x-tenant-id", "bronze"), ("x-sonata-tenant", "gold")))
+    assert both.name == "gold" and not both.router_enforced
+
+
+def test_classify_failpoint_degrades_to_default_served():
+    plane = make_plane(clock=FakeClock())
+    faults.registry().arm_spec("tenancy.classify:error:1::2")
+    try:
+        for _ in range(2):
+            identity = plane.classify((("x-tenant-id", "gold"),))
+            assert identity == (tn.DEFAULT_TENANT, False)
+    finally:
+        faults.registry().disarm("tenancy.classify")
+    assert plane.classify_errors == 2
+    assert plane.classify((("x-tenant-id", "gold"),)).name == "gold"
+
+
+def test_classify_context_survives_broken_context():
+    class BrokenContext:
+        def invocation_metadata(self):
+            raise RuntimeError("torn connection")
+
+    plane = make_plane(clock=FakeClock())
+    assert plane.classify_context(BrokenContext()).name == \
+        tn.DEFAULT_TENANT
+
+
+# ---------------------------------------------------------------------------
+# quota
+# ---------------------------------------------------------------------------
+
+def test_charge_refuses_with_retry_after_and_counts():
+    clock = FakeClock()
+    plane = make_plane(clock=clock)
+    identity = tn.TenantIdentity("bronze", False)
+    assert plane.charge(identity) == (True, 0.0)
+    assert plane.charge(identity) == (True, 0.0)
+    ok, retry = plane.charge(identity)
+    assert not ok and retry == pytest.approx(0.5)
+    assert plane.stat("bronze", "quota_rejections") == 1.0
+    # gold's bucket is independent: bronze's deficit never throttles it
+    assert plane.charge(tn.TenantIdentity("gold", False))[0]
+    clock.advance(0.5)
+    assert plane.charge(identity)[0]
+
+
+def test_router_enforced_identity_skips_node_charge():
+    plane = make_plane(clock=FakeClock())
+    enforced = tn.TenantIdentity("bronze", True)
+    # far past bronze's burst of 2: the router already charged this hop
+    assert all(plane.charge(enforced) == (True, 0.0) for _ in range(10))
+    assert plane.stat("bronze", "quota_rejections") == 0.0
+
+
+def test_unlimited_default_tenant_never_refused():
+    plane = make_plane(clock=FakeClock())
+    identity = tn.TenantIdentity(tn.DEFAULT_TENANT, False)
+    assert all(plane.charge(identity)[0] for _ in range(50))
+
+
+# ---------------------------------------------------------------------------
+# the DRR fair gate
+# ---------------------------------------------------------------------------
+
+def _drain_gate(gate, parked, order, lock):
+    """Release the hold slot and let the parked threads cascade; each
+    granted thread records its tenant then leaves (re-dealing the
+    slot), so `order` is the DRR grant sequence."""
+    gate.leave("hold")
+    for t in parked:
+        t.join(timeout=30.0)
+        assert not t.is_alive()
+
+
+def _park(gate, tenant, order, lock, n):
+    def worker():
+        assert gate.enter(tenant, timeout_s=30.0)
+        with lock:
+            order.append(tenant)
+        gate.leave(tenant)
+
+    threads = [threading.Thread(target=worker) for _ in range(n)]
+    for t in threads:
+        t.start()
+    deadline = 200
+    while gate.queue_depth(tenant) < n and deadline:
+        deadline -= 1
+        threading.Event().wait(0.02)
+    assert gate.queue_depth(tenant) == n
+    return threads
+
+
+def test_fair_gate_immediate_below_saturation():
+    gate = tn.FairGate(lambda t: 1.0, slots=4)
+    for _ in range(4):
+        assert gate.enter("a", timeout_s=0.0)
+    assert gate.view()["active"] == 4
+    for _ in range(4):
+        gate.leave("a")
+    assert gate.view()["active"] == 0
+
+
+def test_fair_gate_two_to_one_weight_proportionality():
+    weights = {"heavy": 2.0, "light": 1.0}
+    gate = tn.FairGate(lambda t: weights.get(t, 1.0), slots=1)
+    assert gate.enter("hold")
+    order, lock = [], threading.Lock()
+    parked = _park(gate, "heavy", order, lock, 8)
+    parked += _park(gate, "light", order, lock, 8)
+    _drain_gate(gate, parked, order, lock)
+    assert len(order) == 16
+    # grants converge to weight proportion: in every early window the
+    # heavy tenant holds ~2/3 of the grants (exact prefix depends only
+    # on the deterministic DRR ring, not thread scheduling)
+    first9 = order[:9]
+    assert first9.count("heavy") == 6 and first9.count("light") == 3
+    assert gate.grants("heavy") == 8 and gate.grants("light") == 8
+
+
+def test_fair_gate_burst_deepens_only_its_own_queue():
+    gate = tn.FairGate(lambda t: 1.0, slots=1)
+    assert gate.enter("hold")
+    order, lock = [], threading.Lock()
+    parked = _park(gate, "noisy", order, lock, 6)
+    assert gate.queue_depth("noisy") == 6
+    assert gate.queue_depth("quiet") == 0
+    parked += _park(gate, "quiet", order, lock, 1)
+    _drain_gate(gate, parked, order, lock)
+    # six requests queued ahead of it, equal weights: DRR still deals
+    # the quiet tenant's single stream from ITS OWN FIFO on the first
+    # ring pass — it is not stuck behind the noisy backlog
+    assert "quiet" in order[:2]
+
+
+def test_fair_gate_timeout_forfeits_cleanly():
+    gate = tn.FairGate(lambda t: 1.0, slots=1)
+    assert gate.enter("hold")
+    assert not gate.enter("late", timeout_s=0.05)
+    assert gate.queue_depth("late") == 0
+    gate.leave("hold")
+    assert gate.enter("late", timeout_s=0.0)
+    gate.leave("late")
+
+
+def test_fair_gate_active_mix_tracks_running_streams():
+    gate = tn.FairGate(lambda t: 1.0, slots=4)
+    gate.enter("a")
+    gate.enter("a")
+    gate.enter("b")
+    assert gate.active_mix() == {"a": 2, "b": 1}
+    gate.leave("a")
+    gate.leave("a")
+    gate.leave("b")
+    assert gate.active_mix() == {}
+
+
+# ---------------------------------------------------------------------------
+# hot reload + router desired state
+# ---------------------------------------------------------------------------
+
+def test_hot_reload_preserves_unchanged_buckets(tmp_path, monkeypatch):
+    monkeypatch.setenv(tn.RELOAD_S_ENV, "0")
+    path = tmp_path / "tenants.json"
+    path.write_text(json.dumps({"tenants": {
+        "a": {"qps": 0.25, "burst": 1}, "b": {"qps": 1, "burst": 1}}}))
+    clock = FakeClock()
+    plane = tn.TenantPlane(str(path), clock=clock)
+    rev0 = plane.revision
+    a = tn.TenantIdentity("a", False)
+    assert plane.charge(a)[0]
+    assert not plane.charge(a)[0]  # a's bucket is now empty
+
+    # change ONLY b's policy (and pad so (mtime, size) must differ);
+    # a's slow 0.25-qps refill cannot rebuild a token across the 1 s
+    # clock advance the reload gate needs
+    path.write_text(json.dumps({"tenants": {
+        "a": {"qps": 0.25, "burst": 1},
+        "b": {"qps": 5, "burst": 9, "weight": 2}}}))
+    import os as _os
+    _os.utime(path, (clock.now, clock.now))
+    clock.advance(1.0)
+    assert plane.maybe_reload()
+    assert plane.revision == rev0 + 1
+    # a's bucket kept its (empty) fill: a reload must not hand every
+    # tenant a fresh burst
+    assert not plane.charge(a)[0]
+
+    # now change a's policy: its bucket resets with the new shape
+    path.write_text(json.dumps({"tenants": {
+        "a": {"qps": 2, "burst": 2},
+        "b": {"qps": 5, "burst": 9, "weight": 2}}}))
+    _os.utime(path, (clock.now + 5, clock.now + 5))
+    clock.advance(1.0)
+    assert plane.maybe_reload()
+    assert plane.charge(a)[0]
+
+
+def test_reload_parse_error_keeps_old_table(tmp_path, monkeypatch):
+    monkeypatch.setenv(tn.RELOAD_S_ENV, "0")
+    path = tmp_path / "tenants.json"
+    path.write_text(json.dumps({"tenants": {"a": {"qps": 7}}}))
+    clock = FakeClock()
+    plane = tn.TenantPlane(str(path), clock=clock)
+    rev0 = plane.revision
+    path.write_text("{this is not json")
+    import os as _os
+    _os.utime(path, (clock.now, clock.now))
+    clock.advance(1.0)
+    # a fat-fingered edit must not drop quota enforcement mid-incident
+    assert not plane.maybe_reload()
+    assert plane.revision == rev0
+    assert plane.weight_of("a") == 1.0
+
+
+def test_reload_rate_limited(tmp_path, monkeypatch):
+    monkeypatch.setenv(tn.RELOAD_S_ENV, "60")
+    path = tmp_path / "tenants.json"
+    path.write_text(json.dumps({"tenants": {"a": {"qps": 7}}}))
+    clock = FakeClock()
+    plane = tn.TenantPlane(str(path), clock=clock)
+    path.write_text(json.dumps({"tenants": {"a": {"qps": 9}}}))
+    import os as _os
+    _os.utime(path, (clock.now, clock.now))
+    clock.advance(1.0)  # < 60 s: the stat() is not even attempted
+    assert not plane.maybe_reload()
+    clock.advance(60.0)
+    assert plane.maybe_reload()
+
+
+def test_apply_remote_idempotent_and_stale_refused():
+    plane = make_plane(clock=FakeClock())
+    doc = {"revision": 5,
+           "tenants": {"gold": {"weight": 4, "qps": 1, "burst": 1}}}
+    assert plane.apply_remote(doc)
+    assert plane.remote_revision == 5
+    assert plane.weight_of("gold") == 4.0
+    assert not plane.apply_remote(doc)          # re-push: idempotent
+    assert not plane.apply_remote({**doc, "revision": 4})  # stale
+    assert plane.apply_remote({**doc, "revision": 6})
+    with pytest.raises(ValueError):
+        plane.apply_remote({"tenants": {}})
+
+
+def test_router_push_takes_ownership_from_local_reload(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv(tn.RELOAD_S_ENV, "0")
+    path = tmp_path / "tenants.json"
+    path.write_text(json.dumps({"tenants": {"a": {"qps": 7}}}))
+    clock = FakeClock()
+    plane = tn.TenantPlane(str(path), clock=clock)
+    assert plane.apply_remote({"revision": 1, "tenants": {
+        "a": {"qps": 3, "burst": 3}}})
+    path.write_text(json.dumps({"tenants": {"a": {"qps": 99}}}))
+    import os as _os
+    _os.utime(path, (clock.now + 9, clock.now + 9))
+    clock.advance(5.0)
+    # router-vs-node precedence: once the router pushed a table the
+    # node's local file is no longer authoritative
+    assert not plane.maybe_reload()
+    assert plane._cfg("a").qps == 3.0
+
+
+class _FakeNode:
+    """Mirrors the mesh prober's node shape: ``spec.metrics_base`` is
+    an attribute (a property on the real NodeSpec), not a callable."""
+
+    def __init__(self, index, base="http://n0"):
+        self.index = index
+        self.spec = type("Spec", (), {
+            "node_id": f"n{index}", "metrics_base": base})()
+
+
+def test_propagator_pushes_acks_and_antientropy():
+    clock = FakeClock()
+    plane = make_plane(clock=clock)
+    posts = []
+
+    def fake_post(url, doc):
+        posts.append((url, doc))
+        return {"revision": doc["revision"]}
+
+    prop = tn.ConfigPropagator(plane, interval_s=1.0, post=fake_post,
+                               clock=clock)
+    node = _FakeNode(0)
+    prop.on_probe_cycle(node)
+    assert len(posts) == 1
+    assert posts[0][0] == "http://n0/debug/tenants"
+    assert posts[0][1]["revision"] == plane.revision
+    # acked: due cycles skip until the anti-entropy floor forces a
+    # re-push (a restarted node lost its table; the router-side ack
+    # did not — the forced refresh re-converges it)
+    for _ in range(prop.REFRESH_CYCLES - 1):
+        clock.advance(1.5)
+        prop.on_probe_cycle(node)
+    assert len(posts) == 1
+    clock.advance(1.5)
+    prop.on_probe_cycle(node)
+    assert len(posts) == 2
+    # a table change (revision bump) pushes on the next due cycle
+    assert plane.apply_remote(
+        {"revision": 1, "tenants": {"gold": {"weight": 9}}})
+    clock.advance(1.5)
+    prop.on_probe_cycle(node)
+    assert len(posts) == 3
+    # forget() (node left / restarted under the same index) re-pushes
+    prop.forget(node)
+    clock.advance(1.5)
+    prop.on_probe_cycle(node)
+    assert len(posts) == 4
+    assert prop.view()["pushes"] == 4
+
+
+def test_propagator_push_failure_counted_not_fatal():
+    clock = FakeClock()
+    plane = make_plane(clock=clock)
+
+    def broken_post(url, doc):
+        raise OSError("connection refused")
+
+    prop = tn.ConfigPropagator(plane, interval_s=1.0, post=broken_post,
+                               clock=clock)
+    node = _FakeNode(0)
+    prop.on_probe_cycle(node)
+    clock.advance(1.5)
+    prop.on_probe_cycle(node)  # unacked: keeps retrying every cycle
+    assert prop.push_errors == 2 and prop.pushes == 0
+
+
+# ---------------------------------------------------------------------------
+# shed-ladder rung
+# ---------------------------------------------------------------------------
+
+def test_shed_rung_ordering():
+    clock = FakeClock()
+    plane = make_plane(clock=clock)
+    # level 0: nobody sheds
+    assert not plane.shed_rung("batch", 0)
+    # level 1: background (shed_priority > 0) tenants shed FIRST;
+    # interactive tenants and default do not
+    assert plane.shed_rung("batch", 1)
+    assert not plane.shed_rung("gold", 1)
+    assert not plane.shed_rung(tn.DEFAULT_TENANT, 1)
+    # level 2: an over-quota (empty-bucket) tenant sheds too
+    bronze = tn.TenantIdentity("bronze", False)
+    assert not plane.shed_rung("bronze", 2)
+    while plane.charge(bronze)[0]:
+        pass
+    assert not plane.shed_rung("bronze", 1)
+    assert plane.shed_rung("bronze", 2)
+    # unlimited tenants have no bucket and never trip the quota rung
+    assert not plane.shed_rung(tn.DEFAULT_TENANT, 2)
+    plane.note_shed("batch")
+    assert plane.stat("batch", "shed") == 1.0
+
+
+# ---------------------------------------------------------------------------
+# per-tenant synth-cache insert budgets
+# ---------------------------------------------------------------------------
+
+def _fill(cache, key, owner, payload):
+    outcome, handle = cache.lookup(key, owner=owner)
+    assert outcome == "fill"
+    handle.add_chunk(payload)
+    handle.commit_fill()
+
+
+def test_cache_share_bounds_owner_and_spares_others():
+    cache = sc.SynthCache(max_bytes=100_000)
+    shares = {"capped": 0.3}
+    cache.set_share_resolver(lambda owner: shares.get(owner))
+    chunk = b"x" * (10_000 - sc.CHUNK_OVERHEAD_BYTES)
+    for i in range(5):
+        _fill(cache, f"other-{i}", "roomy", chunk)
+    for i in range(5):
+        _fill(cache, f"capped-{i}", "capped", chunk)
+    # capped's budget is 30k = 3 entries: its churn evicted its OWN
+    # least-recent entries and left roomy's hot set untouched
+    assert cache.stat("share_evictions") == 2
+    assert all(cache.lookup(f"other-{i}", owner="roomy")[0] == "hit"
+               for i in range(5))
+    assert cache.lookup("capped-0", owner="capped")[0] != "hit"
+    assert cache.lookup("capped-4", owner="capped")[0] == "hit"
+
+
+def test_cache_share_never_in_key():
+    cache = sc.SynthCache(max_bytes=100_000)
+    cache.set_share_resolver(lambda owner: 0.5)
+    _fill(cache, "same-key", "tenant-a", b"payload")
+    # identical text from ANOTHER tenant still hits the same entry:
+    # tenancy bounds the insert budget, never the key
+    outcome, chunks = cache.lookup("same-key", owner="tenant-b")
+    assert outcome == "hit"
+    assert chunks[0][0] == b"payload"
+
+
+def test_cache_oversize_for_share_skips_insert():
+    cache = sc.SynthCache(max_bytes=100_000)
+    cache.set_share_resolver(lambda owner: 0.1)
+    _fill(cache, "big", "tiny-share",
+          b"x" * 20_000)  # > the 10k share: skipped, not force-evicted
+    assert cache.stat("oversize_skips") == 1
+    assert cache.lookup("big", owner="tiny-share")[0] != "hit"
+
+
+# ---------------------------------------------------------------------------
+# metrics + snapshot surfaces
+# ---------------------------------------------------------------------------
+
+def test_tenant_metrics_lazy_series_and_exact_teardown():
+    plane = make_plane(clock=FakeClock())
+    registry = metrics_mod.MetricsRegistry()
+    plane.bind_metrics(registry)
+    plane.note_admitted("gold")
+    plane.note_admitted("gold")
+    text = registry.render()
+    assert 'sonata_tenant_admitted_total{tenant="gold"} 2' in text
+    assert 'sonata_tenant_queue_depth{tenant="gold"}' in text
+    parsed = metrics_mod.parse_prometheus_text(text)
+    configured = {lbl["tenant"]
+                  for lbl, _v in parsed["sonata_tenant_admitted_total"]}
+    # configured tenants export rows up front; nothing else does
+    assert configured == {"batch", "bronze", "default", "gold"}
+    plane.close()
+    text = registry.render()
+    assert 'tenant="gold"' not in text
+
+
+def test_snapshot_shape():
+    plane = make_plane(clock=FakeClock(), fair_slots=2)
+    plane.note_admitted("gold")
+    doc = plane.debug_doc()
+    assert doc["revision"] >= 1 and doc["remote_revision"] == 0
+    assert doc["tenants"]["gold"]["counters"]["admitted"] == 1
+    assert doc["tenants"]["gold"]["queue_depth"] == 0
+    assert doc["fair"]["slots"] == 2
+    json.dumps(doc)  # the /debug/tenants payload must be serializable
+
+
+def test_config_doc_roundtrips_through_apply_remote():
+    plane = make_plane(clock=FakeClock())
+    doc = plane.config_doc()
+    receiver = tn.TenantPlane(None, clock=FakeClock())
+    assert receiver.apply_remote(doc)
+    assert receiver.weight_of("gold") == 3.0
+    assert receiver.remote_revision == doc["revision"]
+
+
+# ---------------------------------------------------------------------------
+# wire-compat pin
+# ---------------------------------------------------------------------------
+
+def test_from_env_unset_means_off(monkeypatch):
+    monkeypatch.delenv(tn.TENANTS_ENV, raising=False)
+    # THE compat pin: no table ⇒ no plane ⇒ runtime.tenancy is None ⇒
+    # every frontend hook is one `is None` branch and the request path
+    # is byte-for-byte the pre-tenancy shape
+    assert tn.from_env() is None
+
+
+def test_from_env_broken_config_stays_off(monkeypatch, caplog):
+    monkeypatch.setenv(tn.TENANTS_ENV, "{not json")
+    assert tn.from_env() is None
+    monkeypatch.setenv(tn.TENANTS_ENV,
+                       '{"tenants": {"a": {"bogus_field": 1}}}')
+    # a typo must not boot a server with surprise quotas
+    assert tn.from_env() is None
+
+
+def test_from_env_builds_plane_with_fair_gate(monkeypatch):
+    monkeypatch.setenv(tn.TENANTS_ENV, TABLE)
+    plane = tn.from_env(fair_slots=4)
+    assert plane is not None
+    assert plane.fair is not None and plane.fair.slots == 4
+    assert plane.weight_of("gold") == 3.0
+    plane.close()
+
+
+def test_overloaded_maps_to_resource_exhausted():
+    grpc = pytest.importorskip("grpc")
+    from sonata_tpu.frontends.grpc_server import _status_for
+
+    # the quota/shed refusal type carries the canonical retryable code
+    assert _status_for(Overloaded("tenant over quota")) \
+        == grpc.StatusCode.RESOURCE_EXHAUSTED

@@ -493,11 +493,12 @@ def main() -> int:
     # run, whatever the random draw skipped (warmup fired in phase B;
     # the mesh.* sites need a router in front of this server — phase M
     # fires them; cache.lookup needs a cache-enabled server — phase CC
-    # fires it; the all-sites check runs after both)
+    # fires it; tenancy.classify needs a tenant-table server — phase TT
+    # fires it; the all-sites check runs after all of them)
     fired = fires_total()
     for site in faults.SITES:
         if fired.get(site, 0) > 0 or site.startswith("mesh.") \
-                or site == "cache.lookup":
+                or site in ("cache.lookup", "tenancy.classify"):
             continue
         arm_spec(f"{site}:error:1::1")
         if site == "metrics.scrape":
@@ -507,9 +508,10 @@ def main() -> int:
         disarm_all()
         heal_pool()
     fired = fires_total()
-    check("every non-mesh, non-cache site fired this run",
+    check("every non-mesh, non-cache, non-tenancy site fired this run",
           all(fired.get(s, 0) > 0 for s in faults.SITES
-              if not s.startswith("mesh.") and s != "cache.lookup"),
+              if not s.startswith("mesh.")
+              and s not in ("cache.lookup", "tenancy.classify")),
           f"({fired})")
     _e, _t, results, err = synth(TEXTS[0])
     check("clean request serves after disarm",
@@ -941,9 +943,83 @@ def main() -> int:
     if runtime.scope is not None:
         scope_mod.install(runtime.scope)
 
+    # ---- phase TT: multi-tenant QoS (ISSUE 17) — the tenancy.classify
+    # failpoint must degrade to the DEFAULT tenant: a broken classifier
+    # can NEVER refuse a request, it just loses per-tenant attribution.
+    # A dedicated server boots with a tenant table armed (the main
+    # server runs tenancy-off on purpose — the pin that unset
+    # SONATA_TENANTS keeps every RPC path byte-for-byte pre-tenancy).
+    os.environ["SONATA_TENANTS"] = json.dumps({"tenants": {
+        "chaos-a": {"weight": 2, "qps": 100, "burst": 100},
+        "chaos-b": {"weight": 1}}})
+    try:
+        tt_server, tt_port = create_server(
+            0, metrics_port=0, request_timeout_s=REQUEST_TIMEOUT_S)
+    finally:
+        del os.environ["SONATA_TENANTS"]
+    tt_server.start()
+    tt_rt = tt_server.sonata_runtime
+    check("tenancy: runtime constructed the tenant plane",
+          tt_rt.tenancy is not None)
+    tt_channel = grpc.insecure_channel(f"127.0.0.1:{tt_port}")
+    tt_load = tt_channel.unary_unary(
+        "/sonata_grpc.sonata_grpc/LoadVoice",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.VoiceInfo.decode)
+    tt_synth_rpc = tt_channel.unary_stream(
+        "/sonata_grpc.sonata_grpc/SynthesizeUtterance",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.SynthesisResult.decode)
+    tt_info = tt_load(pb.VoicePath(config_path=cfg), timeout=120.0)
+    tt_server.sonata_service.warmup_and_mark_ready()
+
+    def tt_synth(text: str, tenant: str):
+        try:
+            return [r.wav_samples for r in tt_synth_rpc(
+                pb.Utterance(voice_id=tt_info.voice_id, text=text),
+                timeout=RPC_TIMEOUT_S,
+                metadata=(("x-tenant-id", tenant),))], None
+        except grpc.RpcError as e:
+            return None, e
+
+    served, err = tt_synth(TEXTS[0], "chaos-a")
+    check("tenancy: labeled request serves under an enabled table",
+          err is None and served and len(served[0]) > 0
+          and tt_rt.tenancy.stat("chaos-a", "admitted") == 1,
+          f"({tt_rt.tenancy.debug_doc()['tenants'].get('chaos-a')})")
+    classify0 = fires_total().get("tenancy.classify", 0)
+    arm_spec("tenancy.classify:error:1::2")
+    served, err = tt_synth(TEXTS[1], "chaos-a")  # classification errors
+    check("tenancy: armed tenancy.classify error degrades to the "
+          "default tenant (request still serves, never refused)",
+          err is None and served and len(served[0]) > 0
+          and tt_rt.tenancy.stat("default", "admitted") >= 1,
+          f"({err.code().name if err else 'ok'})")
+    served, err = tt_synth(TEXTS[2], "chaos-b")  # second degrade
+    check("tenancy: second degraded classification also serves",
+          err is None and served and len(served[0]) > 0)
+    check("tenancy: classify fires counted and degradations visible",
+          fires_total().get("tenancy.classify", 0) == classify0 + 2
+          and tt_rt.tenancy.classify_errors == 2,
+          f"({fires_total()})")
+    disarm_all()
+    served, err = tt_synth(TEXTS[3], "chaos-b")
+    check("tenancy: disarmed classification attributes correctly again",
+          err is None and served
+          and tt_rt.tenancy.stat("chaos-b", "admitted") == 1,
+          f"({tt_rt.tenancy.debug_doc()['tenants'].get('chaos-b')})")
+    tt_channel.close()
+    tt_server.stop(grace=None)
+    tt_server.sonata_service.shutdown()
+    # same plane-reinstall dance as phase CC: latest runtime wins the
+    # process-global ladder/scope slots
+    degradation_mod.install(runtime.degradation)
+    if runtime.scope is not None:
+        scope_mod.install(runtime.scope)
+
     fired = fires_total()
-    check("every registered site fired this run (mesh and cache sites "
-          "included)",
+    check("every registered site fired this run (mesh, cache, and "
+          "tenancy sites included)",
           all(fired.get(s, 0) > 0 for s in faults.SITES), f"({fired})")
 
     # ---- phase G: no request outlived its budget; registry symmetry ----

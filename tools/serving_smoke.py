@@ -1504,6 +1504,188 @@ def main(args=None) -> int:
     for f in fc_logs:
         f.close()
 
+    # ---- tenancy phase (ISSUE 17): multi-tenant admission + QoS ----
+    # One tenant-table backend behind a tenant-table router.  The
+    # contract: gold (weight 3) and bronze (weight 1) both serve;
+    # bursting bronze past its 2-token bucket draws typed
+    # RESOURCE_EXHAUSTED refusals carrying the retry-after-s trailer
+    # while gold's TTFB stays inside a generous quiet band; per-tenant
+    # burn rows ride the node's /debug/quantiles AND the fleet-merged
+    # /debug/fleet; per-tenant padding-waste rows ride /debug/buckets;
+    # the router pushes its tenant table to the node (desired-state
+    # propagation, remote_revision > 0); and the per-tenant counter
+    # families export with exact labels.
+    import statistics
+
+    tn_table = json.dumps({"tenants": {
+        "gold": {"weight": 3, "qps": 200, "burst": 200},
+        "bronze": {"weight": 1, "qps": 2, "burst": 2}}})
+    tn_ports = (free_port(), free_port())
+    tn_log = open(os.path.join(mesh_cache, "tnnode0.log"), "w")
+    tn_env = dict(os.environ, JAX_PLATFORMS="cpu",
+                  SMOKE_VOICE_CFG=cfg,
+                  SONATA_JAX_CACHE_DIR=mesh_cache,
+                  SONATA_TENANTS=tn_table,
+                  MESH_NODE_GRPC_PORT=str(tn_ports[0]),
+                  MESH_NODE_METRICS_PORT=str(tn_ports[1]),
+                  MESH_NODE_EMPTY="0")
+    tn_proc = subprocess.Popen(
+        [sys.executable, __file__, "--mesh-node-boot"],
+        env=tn_env, stdout=tn_log, stderr=tn_log)
+    check("tenancy: tenant-table backend boots ready",
+          wait_readyz(tn_ports[1]))
+    os.environ["SONATA_TENANTS"] = tn_table
+    os.environ["SONATA_FLEET_SCRAPE_INTERVAL_S"] = "0.5"
+    os.environ["SONATA_MESH_PROBE_INTERVAL_S"] = "0.5"
+    try:
+        tn_server, tn_grpc_port = create_mesh_server(
+            0, backends=[f"127.0.0.1:{tn_ports[0]}/{tn_ports[1]}"],
+            metrics_port=0, request_timeout_s=60.0)
+    finally:
+        for k in ("SONATA_TENANTS", "SONATA_FLEET_SCRAPE_INTERVAL_S",
+                  "SONATA_MESH_PROBE_INTERVAL_S"):
+            del os.environ[k]
+    tn_server.start()
+    tn_rt = tn_server.sonata_runtime
+    tn_base = f"http://127.0.0.1:{tn_rt.http_port}"
+    tn_node_base = f"http://127.0.0.1:{tn_ports[1]}"
+    check("tenancy: router built the tenant plane and its propagator",
+          tn_rt.tenancy is not None
+          and tn_server.sonata_service.tenancy_propagator is not None)
+    tn_channel = grpc.insecure_channel(f"127.0.0.1:{tn_grpc_port}")
+    tn_synth = tn_channel.unary_stream(
+        "/sonata_grpc.sonata_grpc/SynthesizeUtterance",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.SynthesisResult.decode)
+    tn_load = tn_channel.unary_unary(
+        "/sonata_grpc.sonata_grpc/LoadVoice",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.VoiceInfo.decode)
+    tn_voice = tn_load(pb.VoicePath(config_path=cfg),
+                       timeout=120.0).voice_id
+
+    def tn_call(text: str, tenant: str) -> dict:
+        t0 = time.monotonic()
+        call = tn_synth(pb.Utterance(voice_id=tn_voice, text=text),
+                        timeout=60.0,
+                        metadata=(("x-tenant-id", tenant),))
+        first_at = None
+        try:
+            chunks = []
+            for r in call:
+                if first_at is None:
+                    first_at = time.monotonic()
+                chunks.append(r.wav_samples)
+            return {"ok": bool(chunks) and len(chunks[0]) > 0,
+                    "ttfb": (first_at or time.monotonic()) - t0,
+                    "trailers": dict(call.trailing_metadata() or ())}
+        except grpc.RpcError as e:
+            return {"ok": False, "code": e.code(),
+                    "trailers": dict(e.trailing_metadata() or ())}
+
+    # quiet lap: gold alone — its TTFB baseline band
+    quiet = [tn_call(f"Gold quiet baseline {i}.", "gold")
+             for i in range(3)]
+    check("tenancy: quiet gold traffic serves through the router",
+          all(r["ok"] for r in quiet),
+          f"({[r.get('code') for r in quiet]})")
+    quiet_ttfb = statistics.median(r["ttfb"] for r in quiet)
+
+    # burst bronze 4x past its bucket while gold keeps a steady lap:
+    # bronze draws typed quota refusals, gold stays in band
+    bronze_results: list = []
+
+    def bronze_burst() -> None:
+        for i in range(8):
+            bronze_results.append(
+                tn_call(f"Bronze burst number {i}.", "bronze"))
+
+    bronze_thread = threading.Thread(target=bronze_burst)
+    bronze_thread.start()
+    busy = [tn_call(f"Gold busy lap {i}.", "gold") for i in range(3)]
+    bronze_thread.join(timeout=120.0)
+    refused = [r for r in bronze_results if not r["ok"]]
+    check("tenancy: bursting bronze draws typed RESOURCE_EXHAUSTED "
+          "refusals",
+          len(refused) >= 1 and all(
+              r.get("code") == grpc.StatusCode.RESOURCE_EXHAUSTED
+              for r in refused),
+          f"({len(refused)} refused: "
+          f"{[getattr(r.get('code'), 'name', None) for r in refused]})")
+    check("tenancy: quota refusals carry the retry-after-s trailer",
+          bool(refused) and all("retry-after-s" in r["trailers"]
+                                for r in refused),
+          f"({[r['trailers'] for r in refused[:2]]})")
+    busy_ok = [r for r in busy if r["ok"]]
+    busy_ttfb = (statistics.median(r["ttfb"] for r in busy_ok)
+                 if busy_ok else float("inf"))
+    check("tenancy: quiet-tenant TTFB stays in band through the burst",
+          len(busy_ok) == 3
+          and busy_ttfb <= max(quiet_ttfb * 5.0, quiet_ttfb + 2.0),
+          f"(quiet {quiet_ttfb * 1e3:.0f}ms -> busy "
+          f"{busy_ttfb * 1e3:.0f}ms)")
+
+    # per-tenant burn rows on the NODE's scope plane (the router
+    # stamped x-sonata-tenant, so the node attributes per tenant)
+    code, body = http_get(tn_node_base + "/debug/quantiles")
+    qdoc = json.loads(body) if code == 200 else {}
+    check("tenancy: per-tenant burn rows on the node /debug/quantiles",
+          "gold" in (qdoc.get("tenants") or {}),
+          f"({sorted((qdoc.get('tenants') or {}))})")
+    # per-tenant padding-waste chargeback rows on /debug/buckets
+    code, body = http_get(tn_node_base + "/debug/buckets")
+    bdoc = json.loads(body) if code == 200 else {}
+    waste_tenants = {r.get("tenant")
+                     for r in (bdoc.get("tenant_waste") or [])}
+    check("tenancy: per-tenant padding-waste rows on /debug/buckets",
+          "gold" in waste_tenants, f"({sorted(waste_tenants)})")
+
+    # fleet-merged per-tenant burn on the router's /debug/fleet
+    tn_doc: dict = {}
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        code, body = http_get(tn_base + "/debug/fleet")
+        tn_doc = json.loads(body) if code == 200 else {}
+        if (tn_doc.get("fleet", {}).get("tenants") or {}).get("gold"):
+            break
+        time.sleep(0.5)
+    check("tenancy: fleet-merged per-tenant burn on /debug/fleet",
+          bool((tn_doc.get("fleet", {}).get("tenants")
+                or {}).get("gold")),
+          f"({tn_doc.get('fleet', {}).get('tenants')})")
+
+    # desired-state propagation: the router pushed its table revision
+    pushed: dict = {}
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        code, body = http_get(tn_node_base + "/debug/tenants")
+        pushed = json.loads(body) if code == 200 else {}
+        if pushed.get("remote_revision", 0) >= 1:
+            break
+        time.sleep(0.5)
+    check("tenancy: router pushed the tenant table to the node "
+          "(remote_revision advanced)",
+          pushed.get("remote_revision", 0) >= 1,
+          f"(node table: revision={pushed.get('revision')}, "
+          f"remote_revision={pushed.get('remote_revision')})")
+
+    # per-tenant counter families with exact labels on the router
+    parsed = parse_prometheus_text(http_get(tn_base + "/metrics")[1])
+    adm = {lbl.get("tenant"): v for lbl, v in parsed.get(
+        "sonata_tenant_admitted_total", [])}
+    rej = {lbl.get("tenant"): v for lbl, v in parsed.get(
+        "sonata_tenant_quota_rejections_total", [])}
+    check("tenancy: per-tenant admitted/rejection series on the router",
+          adm.get("gold", 0) >= 6 and rej.get("bronze", 0) >= 1,
+          f"(admitted={adm}, rejections={rej})")
+
+    tn_channel.close()
+    tn_server.stop(grace=None)
+    tn_server.sonata_service.shutdown()
+    if tn_proc.poll() is None:
+        tn_proc.kill()
+    tn_log.close()
+
     if failures:
         print(f"smoke: {len(failures)} FAILED: {failures}")
         return 1
