@@ -12,6 +12,15 @@ per metric.
 p50 over the wire (interleaved arms) and the hit ratio under a
 Zipf-repeated workload — the committed ``CACHE_rNN.json`` artifact
 (folded into BENCH_TREND by the CACHE family).
+
+``--ledger-artifact PATH`` runs the **request-ledger arm** instead
+(ISSUE 19): two in-process gRPC servers — one with the wide-event
+ledger armed at worst-case capture (``SONATA_LEDGER_MB=4``, sample
+1.0), one ledger-off — measuring interleaved first-chunk TTFB p50 over
+the wire.  The headline ``ledger_overhead`` ratio (on p50 / off p50)
+pins the always-on observability budget; the committed
+``LEDGER_rNN.json`` artifact is folded into BENCH_TREND by the LEDGER
+family.
 """
 
 from __future__ import annotations
@@ -158,6 +167,141 @@ def run_cache_arm(artifact_path: str) -> None:
     server.sonata_service.shutdown()
 
 
+def run_ledger_arm(artifact_path: str) -> None:
+    """The request-ledger arm (ISSUE 19): first-chunk TTFB with the
+    wide-event ledger on (worst-case: sample=1.0, every record kept)
+    vs off, interleaved over the wire against two otherwise-identical
+    in-process servers.  The ratio is the committed always-on budget —
+    the ledger finalizes records off the chunk path, so on/off should
+    be statistically indistinguishable (the ≤1.02 bar)."""
+    import os
+    import tempfile
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
+    import grpc
+
+    from sonata_tpu.frontends import grpc_messages as pb
+    from sonata_tpu.frontends.grpc_server import create_server
+    from sonata_tpu.utils.jax_cache import enable_persistent_compile_cache
+    from voices import write_tiny_voice
+
+    enable_persistent_compile_cache()
+    cfg = str(write_tiny_voice(
+        Path(tempfile.mkdtemp(prefix="ledger_bench"))))
+
+    def boot(with_ledger: bool):
+        if with_ledger:
+            os.environ["SONATA_LEDGER_MB"] = "4"
+            os.environ["SONATA_LEDGER_SAMPLE"] = "1"
+        try:
+            server, port = create_server(0, metrics_port=0,
+                                         request_timeout_s=120.0)
+        finally:
+            if with_ledger:
+                del os.environ["SONATA_LEDGER_MB"]
+                del os.environ["SONATA_LEDGER_SAMPLE"]
+        server.start()
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        load = channel.unary_unary(
+            "/sonata_grpc.sonata_grpc/LoadVoice",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=pb.VoiceInfo.decode)
+        realtime = channel.unary_stream(
+            "/sonata_grpc.sonata_grpc/SynthesizeUtteranceRealtime",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=pb.WaveSamples.decode)
+        info = load(pb.VoicePath(config_path=cfg))
+        server.sonata_service.warmup_and_mark_ready()
+        return server, channel, realtime, info.voice_id
+
+    on_server, on_channel, on_rpc, on_voice = boot(with_ledger=True)
+    off_server, off_channel, off_rpc, off_voice = boot(with_ledger=False)
+    assert on_server.sonata_runtime.ledger is not None
+    assert off_server.sonata_runtime.ledger is None
+
+    def first_chunk_ttfb(rpc, voice_id: str, text: str,
+                         rid: str) -> float:
+        t0 = time.perf_counter()
+        stream = rpc(pb.Utterance(voice_id=voice_id, text=text),
+                     timeout=120.0,
+                     metadata=(("x-request-id", rid),))
+        next(iter(stream))
+        dt = time.perf_counter() - t0
+        for _chunk in stream:
+            pass
+        return dt
+
+    def template(tag) -> str:
+        return (f"Ledger run {tag}: your delivery arrives this "
+                "afternoon between two and four, reply with the word "
+                "reschedule if that window no longer works for you.")
+
+    # warm both servers' synthesis paths on sacrificial texts so the
+    # measured arms compare warm-path TTFB, not first-shape compiles
+    for i in range(3):
+        first_chunk_ttfb(on_rpc, on_voice, template(f"warm-{i}"),
+                         f"bench-warm-on-{i}")
+        first_chunk_ttfb(off_rpc, off_voice, template(f"warm-{i}"),
+                         f"bench-warm-off-{i}")
+
+    on_ts, off_ts = [], []
+    for i in range(32):  # interleaved arms: drift hits both equally;
+        # alternating which arm goes first cancels any per-iteration
+        # warm-cache bias toward the second measurement
+        arms = [(off_ts, off_rpc, off_voice, "off"),
+                (on_ts, on_rpc, on_voice, "on")]
+        if i % 2:
+            arms.reverse()
+        for sink, rpc, voice, tag in arms:
+            sink.append(first_chunk_ttfb(rpc, voice,
+                                         template(f"run-{i}"),
+                                         f"bench-{tag}-{i:02d}"))
+    p50_on = statistics.median(on_ts)
+    p50_off = statistics.median(off_ts)
+    ledger = on_server.sonata_runtime.ledger
+    captured = len(ledger.query(outcome="ok", limit=1000))
+    rows = [
+        {"metric": "ledger_on_ttfb_p50_ms",
+         "value": round(p50_on * 1e3, 3), "unit": "ms",
+         "vs_baseline": None, "runs": len(on_ts)},
+        {"metric": "ledger_off_ttfb_p50_ms",
+         "value": round(p50_off * 1e3, 3), "unit": "ms",
+         "vs_baseline": None, "runs": len(off_ts)},
+        {"metric": "ledger_overhead",
+         "value": round(p50_on / max(p50_off, 1e-9), 4),
+         "unit": "ratio_ledger_on_over_off",
+         "vs_baseline": None,
+         "records_captured": captured},
+    ]
+    for row in rows:
+        print(json.dumps(row))
+    artifact = {
+        "bench": "request_ledger",
+        "host": "ci-cpu",
+        "notes": ("bench_streaming --ledger-artifact: two in-process "
+                  "gRPC servers (SONATA_LEDGER_MB=4 sample=1.0 vs "
+                  "ledger-off), tiny test voice; first-chunk TTFB p50 "
+                  "from interleaved runs over the loopback wire (12 "
+                  "runs per arm, warm synthesis path).  The "
+                  "ledger_overhead ratio is the headline (both arms "
+                  "share host noise) and pins the always-on wide-event "
+                  "budget at <= 1.02; absolute TTFBs are supporting "
+                  "per the r11/r12 convention."),
+        "configs": {"request_ledger": {"results": [
+            {k: row[k] for k in ("metric", "value")} for row in rows]}},
+    }
+    Path(artifact_path).write_text(
+        json.dumps(artifact, indent=1, sort_keys=True) + "\n",
+        encoding="utf-8")
+    print(f"ledger bench: wrote {artifact_path}")
+    for channel, server in ((on_channel, on_server),
+                            (off_channel, off_server)):
+        channel.close()
+        server.stop(grace=None)
+        server.sonata_service.shutdown()
+
+
 def main() -> None:
     import argparse
 
@@ -171,10 +315,18 @@ def main() -> None:
                     help="run ONLY the cached-replay arm (ISSUE 15) "
                          "against a live cache-enabled gRPC server and "
                          "write the CACHE_rNN.json artifact here")
+    ap.add_argument("--ledger-artifact", default=None, metavar="PATH",
+                    help="run ONLY the request-ledger overhead arm "
+                         "(ISSUE 19) against ledger-on/off gRPC "
+                         "servers and write the LEDGER_rNN.json "
+                         "artifact here")
     args = ap.parse_args()
 
     if args.cache_artifact:
         run_cache_arm(args.cache_artifact)
+        return
+    if args.ledger_artifact:
+        run_ledger_arm(args.ledger_artifact)
         return
 
     from bench import accelerator_ready_with_retries

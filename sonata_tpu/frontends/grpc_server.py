@@ -62,6 +62,7 @@ from ..serving import (
     synthcache,
     tracing,
 )
+from ..serving import ledger as ledger_mod
 from ..serving import tenancy as tenancy_mod
 from ..serving import warmup as serving_warmup
 from ..serving.logs import configure_logging
@@ -132,6 +133,62 @@ def _status_for(e: SonataError) -> grpc.StatusCode:
     if isinstance(e, OperationError):
         return grpc.StatusCode.ABORTED
     return grpc.StatusCode.UNKNOWN
+
+
+def _context_request_id(context) -> str:
+    """Resolve (and memoize) the request id for this RPC: the client's
+    ``x-request-id`` metadata when present, else generated ONCE — so
+    the trace, the ledger record, and the wire trailer all carry the
+    same id, including for refused requests that never reach a trace."""
+    rid = getattr(context, "_sonata_rid", None)
+    if rid is None:
+        rid = (tracing.request_id_from_context(context)
+               or tracing.new_request_id())
+        try:
+            context._sonata_rid = rid
+        except Exception:
+            pass  # frozen context double: regenerate if asked again
+    return rid
+
+
+def _add_trailers(context, *pairs) -> None:
+    """Accumulate trailing metadata.  ``set_trailing_metadata`` REPLACES
+    the previous tuple wholesale, so every trailer producer (request id,
+    node id, retry-after) funnels through this helper, which keeps the
+    union on the context and re-sets the whole of it each time."""
+    set_tm = getattr(context, "set_trailing_metadata", None)
+    if set_tm is None:
+        return
+    acc = getattr(context, "_sonata_trailers", None)
+    if acc is None:
+        acc = []
+        try:
+            context._sonata_trailers = acc
+        except Exception:
+            pass
+    acc.extend(pairs)
+    try:
+        set_tm(tuple(acc))
+    except Exception:
+        pass  # terminated context / test double
+
+
+def _ledger_record(runtime, context, rpc: str, voice=None):
+    """Open (and memoize on the context) this request's wide-event
+    record; None when the ledger is off.  Shared by the node frontend
+    and the mesh router — both memoize, so an abort after ``begin``
+    finalizes the SAME record, never a second one."""
+    lg = runtime.ledger
+    if lg is None:
+        return None
+    rec = getattr(context, "_sonata_ledger_rec", None)
+    if rec is None:
+        rec = lg.begin(rpc, _context_request_id(context), voice=voice)
+        try:
+            context._sonata_ledger_rec = rec
+        except Exception:
+            pass  # frozen context double: a fresh record per caller
+    return rec
 
 
 class SonataGrpcService:
@@ -362,10 +419,29 @@ class SonataGrpcService:
                                  appended_silence_ms=args.appended_silence_ms)
 
     # -- serving-runtime helpers ----------------------------------------------
-    def _abort_sonata(self, context, rpc: str, e: SonataError) -> None:
-        """Record the failure on the metrics plane, then abort (raises)."""
+    def _abort_sonata(self, context, rpc: str, e: SonataError,
+                      refusal: Optional[str] = None) -> None:
+        """Record the failure on the metrics plane and in the request
+        ledger (a typed refusal when the site passes one or the
+        exception type implies one, an error record otherwise), stamp
+        ``x-request-id`` on the wire — refused requests are debuggable
+        too — then abort (raises)."""
         code = _status_for(e)
         self.runtime.failures.labels(rpc=rpc, code=code.name).inc()
+        _add_trailers(context,
+                      ("x-request-id", _context_request_id(context)))
+        lg = self.runtime.ledger
+        if lg is not None:
+            if refusal is None:
+                refusal = ledger_mod.refusal_kind(e)
+            rec = _ledger_record(self.runtime, context, rpc)
+            ident = getattr(context, "_sonata_tenant", None)
+            if ident is not None:
+                rec.note(tenant=ident.name)
+            if refusal is not None:
+                lg.emit(rec, refusal=refusal)
+            else:
+                lg.emit(rec, outcome="error", error=type(e).__name__)
         context.abort(code, str(e))
 
     @staticmethod
@@ -437,19 +513,15 @@ class SonataGrpcService:
             tn.note_shed(name)
             self._abort_sonata(context, rpc, Overloaded(
                 f"degraded ({rt.degradation.level_name}): tenant "
-                f"{name!r} shed (background priority or over quota)"))
+                f"{name!r} shed (background priority or over quota)"),
+                refusal="tenant-shed")
         ok, retry_after = tn.charge(ident)
         if not ok:
-            set_tm = getattr(context, "set_trailing_metadata", None)
-            if set_tm is not None:
-                try:
-                    set_tm(((tenancy_mod.RETRY_AFTER_TRAILER,
-                             f"{retry_after:.3f}"),))
-                except Exception:
-                    pass
+            _add_trailers(context, (tenancy_mod.RETRY_AFTER_TRAILER,
+                                    f"{retry_after:.3f}"))
             self._abort_sonata(context, rpc, Overloaded(
                 f"tenant {name!r} over quota; retry in "
-                f"{retry_after:.3f}s"))
+                f"{retry_after:.3f}s"), refusal="node-quota")
         tn.note_admitted(name)
         gate = tn.fair
         if gate is None:
@@ -461,7 +533,7 @@ class SonataGrpcService:
             tn.note_shed(name)
             self._abort_sonata(context, rpc, Overloaded(
                 f"tenant {name!r}: weighted-fair queue wait exceeded "
-                "the request deadline"))
+                "the request deadline"), refusal="tenant-shed")
         return gate, name
 
     def _tenant_gated(self, request, context, rpc: str, miss_fn):
@@ -520,15 +592,27 @@ class SonataGrpcService:
         carries the request_id (see ``serving/logs.py``); an admission
         shed still produces a finished (error-status) trace, so shed
         requests are debuggable too.
+
+        The same id seeds the request's wide-event ledger record
+        (``serving/ledger.py``): this wrapper counts chunks / bytes /
+        TTFB as the stream flows, then finalizes the record at stream
+        close with the cost breakdown re-read from the trace spans —
+        one record per request, whatever the disposition.
         """
-        from contextlib import ExitStack
+        from contextlib import ExitStack, closing
 
         rt = self.runtime
+        rid = _context_request_id(context)
+        rec = _ledger_record(
+            self.runtime, context, rpc,
+            voice=getattr(request, "voice_id", None) or None)
+        if rec is not None:
+            rec.note(text_len=len(getattr(request, "text", "") or ""))
         try:
             with rt.tracer.trace_request(
                     rpc,
-                    request_id=tracing.request_id_from_context(context),
-                    voice=getattr(request, "voice_id", None) or ""):
+                    request_id=rid,
+                    voice=getattr(request, "voice_id", None) or "") as trace:
                 with ExitStack() as stack:
                     # the span covers only slot ACQUISITION (the shed /
                     # wait cost); the stack holds the slot for the body
@@ -542,25 +626,65 @@ class SonataGrpcService:
                         rt.drain.raise_if_draining()
                         stack.enter_context(rt.admission.admit())
                     rt.requests.labels(rpc=rpc).inc()
-                    # name this backend in the response trailers so the
-                    # sonata-mesh router (and any client) can log WHICH
-                    # node served the stream, not an opaque channel
+                    # name this backend and the request id in the
+                    # response trailers so the sonata-mesh router (and
+                    # any client) can log WHICH node served the stream
+                    # and correlate it with the ledger record
+                    trailers = [("x-request-id", rid)]
                     if rt.node_id:
-                        set_tm = getattr(context, "set_trailing_metadata",
-                                         None)
-                        if set_tm is not None:
-                            try:
-                                set_tm((("x-sonata-node-id",
-                                         rt.node_id),))
-                            except Exception:
-                                pass  # terminated context / test double
+                        trailers.append(("x-sonata-node-id", rt.node_id))
+                    _add_trailers(context, *trailers)
                     if rt.tenancy is None:
-                        yield from body(request, context)
+                        inner = body(request, context)
                     else:
-                        yield from self._tenant_observed(request,
-                                                         context, body)
+                        inner = self._tenant_observed(request, context,
+                                                      body)
+                    t0 = time.monotonic()
+                    chunks = 0
+                    bytes_out = 0
+                    first_at = None
+                    # closing(): a client hangup (GeneratorExit at the
+                    # yield) must close the BODY generator while this
+                    # trace is still active — an abandoned suspended
+                    # body would unwind its spans after trace_request
+                    # exits and re-install a stale current trace (the
+                    # ordering `yield from` used to provide)
+                    with closing(inner):
+                        for msg in inner:
+                            chunks += 1
+                            payload = getattr(msg, "wav_samples", None)
+                            if payload:
+                                bytes_out += len(payload)
+                            if first_at is None:
+                                first_at = time.monotonic()
+                            yield msg
+                    if rec is not None:
+                        ident = getattr(context, "_sonata_tenant", None)
+                        rec.note(
+                            chunks=chunks, bytes_out=bytes_out,
+                            ttfb_s=(first_at - t0
+                                    if first_at is not None else None),
+                            tenant=(ident.name if ident is not None
+                                    else None),
+                            **ledger_mod.cost_fields_from_trace(trace))
+                        rt.ledger.emit(rec)
         except (Draining, Overloaded) as e:
             self._abort_sonata(context, rpc, e)
+        except GeneratorExit:
+            # client hangup mid-stream: the record's disposition is
+            # "cancelled" — not ok, and not a server-attributed error
+            if rec is not None:
+                rt.ledger.emit(rec, outcome="cancelled")
+            raise
+        except BaseException as e:
+            # typed SonataErrors abort inside the body (the record was
+            # emitted there, so this is a no-op for them); this arm
+            # catches whatever nothing else did, so no admitted request
+            # can vanish from the ledger
+            if rec is not None and not rec.emitted:
+                rt.ledger.emit(rec, outcome="error",
+                               error=type(e).__name__)
+            raise
 
     def SynthesizeUtterance(self, request: pb.Utterance,
                             context) -> Iterator[pb.SynthesisResult]:
@@ -756,7 +880,8 @@ class SonataGrpcService:
             rt.shed.labels(source="degradation").inc()
             self._abort_sonata(context, "SynthesizeUtterance", Overloaded(
                 f"degraded ({rt.degradation.level_name}): batch "
-                "synthesis rejected; interactive requests only"))
+                "synthesis rejected; interactive requests only"),
+                refusal="fleet-shed")
         try:
             if v.scheduler is not None and cfg is None:
                 # continuous batching: submit every sentence up front so a

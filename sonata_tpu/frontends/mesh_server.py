@@ -71,14 +71,28 @@ from ..serving import (
     tracing,
 )
 from ..serving import fleetcache as fleetcache_mod
+from ..serving import ledger as ledger_mod
 from ..serving import tenancy as tenancy_mod
 from ..serving.fleetscope import FleetScope
 from ..serving.logs import configure_logging
-from ..serving.mesh import MeshRouter, parse_backends, resolve_node_id
+from ..serving.mesh import (
+    MeshRouter,
+    _http_fetch,
+    parse_backends,
+    resolve_node_id,
+)
 from ..serving.placement import PlacementPlane, VoiceWarming
 from ..serving.replicas import OPEN
 from . import grpc_messages as pb
-from .grpc_server import _METHODS, _SERVICE_PATH, _status_for, voice_id_for
+from .grpc_server import (
+    _METHODS,
+    _SERVICE_PATH,
+    _add_trailers,
+    _context_request_id,
+    _ledger_record,
+    _status_for,
+    voice_id_for,
+)
 
 log = logging.getLogger("sonata.mesh")
 
@@ -225,6 +239,12 @@ class SonataMeshService:
             self.tenancy_propagator = tenancy_mod.ConfigPropagator(
                 rt.tenancy)
             router.attach_tenancy(self.tenancy_propagator)
+        #: sonata-ledger (ISSUE 19): /debug/requests?id= on the router
+        #: merges the serving node's own record into the hop record by
+        #: x-request-id (the stitched-trace pattern) — one document
+        #: shows router reroutes next to node-side cost
+        if rt.ledger is not None:
+            rt.ledger.set_node_record_fetcher(self._fetch_node_record)
 
     # -- placement replay transport (the plane's apply_* callables) ----------
     def _apply_load(self, node, config_path: str):
@@ -566,8 +586,52 @@ class SonataMeshService:
         return self._routed_stream("SynthesizeUtteranceRealtime",
                                    request, context)
 
-    def _abort(self, context, rpc: str, code, detail: str) -> None:
+    def _fetch_node_record(self, request_id: str, node_id: str):
+        """Fetch the serving node's own ledger record over its metrics
+        plane (the fleet scope's scrape transport).  None on any miss —
+        the router's hop record then stands alone.  Called at QUERY
+        time only (one /debug/requests?id= lookup), never on the
+        request path."""
+        import json
+        from urllib.parse import quote
+
+        for node in self.router.nodes:
+            if node.node_id != node_id:
+                continue
+            base = node.spec.metrics_base
+            if base is None:
+                return None
+            status, body = _http_fetch(
+                f"{base}/debug/requests?id={quote(request_id)}",
+                timeout_s=2.0)
+            if status != 200:
+                return None
+            try:
+                records = json.loads(body).get("records") or []
+            except (ValueError, AttributeError):
+                return None
+            return records[0] if records else None
+        return None
+
+    def _abort(self, context, rpc: str, code, detail: str,
+               refusal: Optional[str] = None,
+               error: Optional[str] = None) -> None:
+        """Metrics + a typed ledger record + the ``x-request-id``
+        trailer (refused requests are debuggable too), then abort
+        (raises)."""
         self.runtime.failures.labels(rpc=rpc, code=code.name).inc()
+        _add_trailers(context,
+                      ("x-request-id", _context_request_id(context)))
+        lg = self.runtime.ledger
+        if lg is not None:
+            rec = _ledger_record(self.runtime, context, f"mesh.{rpc}")
+            ident = getattr(context, "_sonata_tenant", None)
+            if ident is not None:
+                rec.note(tenant=ident.name)
+            if refusal is not None:
+                lg.emit(rec, refusal=refusal)
+            else:
+                lg.emit(rec, outcome="error", error=error or code.name)
         context.abort(code, detail)
 
     def _routed_stream(self, name: str, request: pb.Utterance,
@@ -580,18 +644,23 @@ class SonataMeshService:
         from contextlib import ExitStack
 
         rt = self.runtime
-        rid = tracing.request_id_from_context(context) \
-            or tracing.new_request_id()
+        rid = _context_request_id(context)
+        rec = _ledger_record(self.runtime, context, f"mesh.{name}",
+                             voice=request.voice_id or None)
+        if rec is not None:
+            rec.note(text_len=len(request.text or ""))
         t0 = time.monotonic()
+        ttfb = None
         try:
             with rt.tracer.trace_request(
                     f"mesh.{name}", request_id=rid,
-                    voice=request.voice_id or ""):
+                    voice=request.voice_id or "") as trace:
                 with ExitStack() as stack:
                     with tracing.span("admission"):
                         rt.drain.raise_if_draining()
                         stack.enter_context(rt.admission.admit())
                     rt.requests.labels(rpc=name).inc()
+                    _add_trailers(context, ("x-request-id", rid))
                     deadline = rt.deadline_for(context)
                     payload = request.encode()
                     md = (("x-request-id", rid),)
@@ -605,6 +674,11 @@ class SonataMeshService:
                     identity = None
                     if tn is not None:
                         identity = tn.classify_context(context)
+                        try:
+                            # the ledger's refusal records read it back
+                            context._sonata_tenant = identity
+                        except Exception:
+                            pass
                         md = md + (
                             (tenancy_mod.ROUTER_TENANT_HEADER,
                              identity.name),
@@ -642,11 +716,13 @@ class SonataMeshService:
                         # router single-flight follower: ride the
                         # leader's fill instead of re-synthesizing
                         n = 0
+                        follow_bytes = 0
                         try:
                             with tracing.span("fleetcache-follow") as fsp:
                                 first = True
                                 for chunk, _aux in flight:
                                     n += 1
+                                    follow_bytes += len(chunk)
                                     if first:
                                         first = False
                                         ttfb = time.monotonic() - t0
@@ -657,6 +733,14 @@ class SonataMeshService:
                                 fsp.annotate(chunks=n)
                             rt.synth_latency.observe(
                                 time.monotonic() - t0)
+                            if rec is not None:
+                                rec.note(
+                                    tenant=(identity.name
+                                            if identity is not None
+                                            else None),
+                                    cache="follow", chunks=n,
+                                    bytes_out=follow_bytes, ttfb_s=ttfb)
+                                rt.ledger.emit(rec)
                             return
                         except synthcache.LeaderFailed:
                             if n > 0:
@@ -678,20 +762,16 @@ class SonataMeshService:
                         ok, retry_after = tn.charge(
                             identity._replace(router_enforced=False))
                         if not ok:
-                            set_tm = getattr(
-                                context, "set_trailing_metadata", None)
-                            if set_tm is not None:
-                                try:
-                                    set_tm(((
-                                        tenancy_mod.RETRY_AFTER_TRAILER,
-                                        f"{retry_after:.3f}"),))
-                                except Exception:
-                                    pass
+                            _add_trailers(
+                                context,
+                                (tenancy_mod.RETRY_AFTER_TRAILER,
+                                 f"{retry_after:.3f}"))
                             self._abort(
                                 context, name,
                                 grpc.StatusCode.RESOURCE_EXHAUSTED,
                                 f"tenant {identity.name!r} over quota; "
-                                f"retry in {retry_after:.3f}s")
+                                f"retry in {retry_after:.3f}s",
+                                refusal="router-quota")
                         tn.note_admitted(identity.name)
 
                     fill = flight if outcome == "fill" else None
@@ -700,6 +780,7 @@ class SonataMeshService:
                         first = True
                         with tracing.span("stream-emit") as emit_sp:
                             n_chunks = 0
+                            bytes_out = 0
                             for chunk in self.router.route_stream(
                                     start, deadline=deadline,
                                     request_id=rid,
@@ -707,6 +788,7 @@ class SonataMeshService:
                                     voice=request.voice_id or None,
                                     affinity_key=ckey):
                                 n_chunks += 1
+                                bytes_out += len(chunk)
                                 if first:
                                     first = False
                                     ttfb = time.monotonic() - t0
@@ -732,28 +814,43 @@ class SonataMeshService:
                         # client, like the backend does for us — a
                         # client of the router learns which process in
                         # the fleet actually synthesized its audio
-                        set_tm = getattr(context, "set_trailing_metadata",
-                                         None)
-                        if set_tm is not None:
-                            try:
-                                set_tm((("x-sonata-node-id",
-                                         served[0].node_id),))
-                            except Exception:
-                                pass
+                        _add_trailers(context, ("x-sonata-node-id",
+                                                served[0].node_id))
+                    if rec is not None:
+                        # the hop's wide event: router-side cost plus
+                        # which node synthesized and how many reroutes
+                        # it took to get there — /debug/requests?id= on
+                        # the router merges the node's own record in
+                        cost = ledger_mod.cost_fields_from_trace(trace)
+                        reroutes = cost.pop("reroutes", 0)
+                        rec.note(
+                            tenant=(identity.name
+                                    if identity is not None else None),
+                            chunks=n_chunks, bytes_out=bytes_out,
+                            ttfb_s=ttfb,
+                            router={"reroutes": reroutes,
+                                    "node": (served[0].node_id
+                                             if served[0] is not None
+                                             else None)},
+                            **cost)
+                        rt.ledger.emit(rec)
         except VoiceWarming as e:
             # typed like a draining refusal (UNAVAILABLE, retryable):
             # the voice is desired but no holder has converged inside
             # the bounded placement wait — a reconcile is in flight
             self._abort(context, name, grpc.StatusCode.UNAVAILABLE,
-                        str(e))
+                        str(e), refusal="voice-warming")
         except Overloaded as e:
             rt.shed.labels(source="mesh").inc()
-            self._abort(context, name, _status_for(e), str(e))
+            self._abort(context, name, _status_for(e), str(e),
+                        refusal="overload")
         except DeadlineExceeded as e:
             rt.expired.inc()
-            self._abort(context, name, _status_for(e), str(e))
+            self._abort(context, name, _status_for(e), str(e),
+                        refusal="deadline")
         except Draining as e:
-            self._abort(context, name, _status_for(e), str(e))
+            self._abort(context, name, _status_for(e), str(e),
+                        refusal="draining")
         except grpc.RpcError as e:
             # backend failure after the retry budget (or after bytes
             # streamed): forward the backend's own status typed
@@ -763,9 +860,22 @@ class SonataMeshService:
             det = (det() if callable(det) else "") or ""
             self._abort(context, name,
                         code or grpc.StatusCode.UNKNOWN,
-                        f"backend: {det}")
+                        f"backend: {det}",
+                        error=(code.name if code is not None
+                               else "RpcError"))
         except SonataError as e:
-            self._abort(context, name, _status_for(e), str(e))
+            self._abort(context, name, _status_for(e), str(e),
+                        error=type(e).__name__)
+        except GeneratorExit:
+            # client hangup mid-stream: "cancelled", not a server error
+            if rec is not None:
+                rt.ledger.emit(rec, outcome="cancelled")
+            raise
+        except BaseException as e:
+            if rec is not None and not rec.emitted:
+                rt.ledger.emit(rec, outcome="error",
+                               error=type(e).__name__)
+            raise
 
     # -- lifecycle ------------------------------------------------------------
     def drain(self, timeout_s: Optional[float] = None,

@@ -39,6 +39,7 @@ from typing import Optional
 
 from . import degradation as degradation_mod
 from . import faults, tracing
+from . import ledger as ledger_mod
 from . import mesh as mesh_mod
 from . import scope as scope_mod
 from . import synthcache as synthcache_mod
@@ -50,6 +51,7 @@ from .degradation import DegradationLadder
 from .drain import DrainCoordinator, Draining
 from .faults import InjectedFault
 from .health import HealthState
+from .ledger import RequestLedger
 from .metrics import (
     MetricsRegistry,
     parse_prometheus_text,
@@ -74,6 +76,8 @@ __all__ = [
     "default_timeout_s",
     "degradation_mod",
     "faults",
+    "ledger_mod",
+    "RequestLedger",
     "HealthState",
     "mesh_mod",
     "MetricsRegistry",
@@ -272,6 +276,14 @@ class ServingRuntime:
                 # still dedups across tenants)
                 self.synth_cache.set_share_resolver(
                     self.tenancy.cache_share)
+        #: per-request wide-event ledger (ISSUE 19): enabled by
+        #: SONATA_LEDGER_MB > 0 (default off — the request path is then
+        #: byte-for-byte the pre-ledger shape and zero new metric
+        #: series exist).  Frontends begin/emit records; the ring is
+        #: served by GET /debug/requests on the metrics plane.
+        self.ledger: Optional[RequestLedger] = ledger_mod.from_env()
+        if self.ledger is not None:
+            self.ledger.bind_metrics(r)
         #: per-voice flight-recorder probes added by register_voice, so
         #: unregister removes exactly what was added
         self._voice_probes: dict = {}
@@ -286,6 +298,9 @@ class ServingRuntime:
         an opaque channel."""
         self.node_id = node_id
         self.health.node_id = node_id
+        if self.ledger is not None:
+            # every subsequent record names the node that served it
+            self.ledger.node_id = node_id
         self.registry.gauge(
             "sonata_node_info",
             "Constant 1, labeled with this process's stable node_id "
@@ -325,7 +340,8 @@ class ServingRuntime:
                                       port=resolved, host=host,
                                       tracer=self.tracer, scope=self.scope,
                                       fleet=self.fleet,
-                                      tenancy=self.tenancy)
+                                      tenancy=self.tenancy,
+                                      ledger=self.ledger)
         return self.http.port
 
     @property
@@ -556,6 +572,8 @@ class ServingRuntime:
         if self.scope is not None:
             scope_mod.uninstall(self.scope)
             self.scope.close()
+        if self.ledger is not None:
+            self.ledger.close()
         if self.http is not None:
             self.http.stop()
             self.http = None

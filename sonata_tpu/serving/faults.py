@@ -37,7 +37,10 @@ exercised by at least one test):
   broken affinity tier can never fail a request;
 - ``cache.lookup``        — inside every synthesis-cache probe
   (``serving/synthcache.py``): an injected error degrades that lookup
-  to a normal miss — a broken cache can never fail a request.
+  to a normal miss — a broken cache can never fail a request;
+- ``ledger.emit``         — inside every request-ledger record finalize
+  (``serving/ledger.py``): an injected error degrades that finalize to
+  no-record — a broken ledger can never fail a request.
 
 Modes:
 
@@ -103,6 +106,7 @@ SITES = (
     "mesh.cache_affinity",
     "cache.lookup",
     "tenancy.classify",
+    "ledger.emit",
 )
 
 MODES = ("error", "hang", "slow", "corrupt-shape")

@@ -319,6 +319,7 @@ class _Handler(BaseHTTPRequestHandler):
     scope = None
     fleet = None
     tenancy = None
+    ledger = None
 
     def do_GET(self):  # noqa: N802 (http.server API)
         from . import faults
@@ -370,6 +371,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply_fleet(path, query)
         elif path == "/debug/tenants":
             self._reply_tenants()
+        elif path == "/debug/requests":
+            self._reply_requests(query)
         else:
             self._reply(404, b"not found\n")
 
@@ -579,6 +582,44 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(200, body.encode("utf-8"),
                     "application/json; charset=utf-8")
 
+    # -- request ledger (serving/ledger.py) ----------------------------------
+    def _reply_requests(self, query: str) -> None:
+        """``GET /debug/requests`` — the wide-event ring, filterable by
+        ``tenant=&voice=&outcome=&since=&id=&limit=`` (newest first).
+        ``id=`` on a mesh router also merges the serving node's own
+        record into the hop record (the stitched-trace pattern).  404
+        on ledger-off processes, like the scope/tracer siblings."""
+        import json
+        from urllib.parse import parse_qs
+
+        if self.ledger is None:
+            self._reply(404, b"ledger not enabled on this server\n")
+            return
+        params = parse_qs(query)
+
+        def first(key):
+            return params.get(key, [""])[0] or None
+
+        since = first("since")
+        if since is not None:
+            try:
+                since = float(since)
+            except ValueError:
+                self._reply(400, b"since must be a unix timestamp\n")
+                return
+        try:
+            limit = int(first("limit") or 100)
+        except ValueError:
+            self._reply(400, b"limit must be an integer\n")
+            return
+        records = self.ledger.query(
+            tenant=first("tenant"), voice=first("voice"),
+            outcome=first("outcome"), since=since,
+            request_id=first("id"), limit=limit)
+        body = json.dumps({"count": len(records), "records": records})
+        self._reply(200, body.encode("utf-8"),
+                    "application/json; charset=utf-8")
+
     def _reply(self, code: int, body: bytes,
                content_type: str = "text/plain; charset=utf-8") -> None:
         self.send_response(code)
@@ -629,7 +670,8 @@ def start_http_server(registry: MetricsRegistry, health=None,
                       port: Optional[int] = None,
                       host: Optional[str] = None,
                       tracer=None, scope=None,
-                      fleet=None, tenancy=None) -> MetricsHTTPServer:
+                      fleet=None, tenancy=None,
+                      ledger=None) -> MetricsHTTPServer:
     """Serve ``/metrics``, ``/healthz``, ``/readyz`` — plus, when a
     :class:`~sonata_tpu.serving.tracing.Tracer` is given,
     ``/debug/traces``, ``/debug/slowest``, and ``/debug/profile``; when
@@ -643,7 +685,7 @@ def start_http_server(registry: MetricsRegistry, health=None,
     handler = type("BoundHandler", (_Handler,),
                    {"registry": registry, "health": health,
                     "tracer": tracer, "scope": scope, "fleet": fleet,
-                    "tenancy": tenancy})
+                    "tenancy": tenancy, "ledger": ledger})
     httpd = ThreadingHTTPServer((host, port or 0), handler)
     httpd.daemon_threads = True
     return MetricsHTTPServer(httpd)

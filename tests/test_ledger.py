@@ -1,0 +1,616 @@
+"""Request ledger (serving/ledger.py): wide events, tail sampling,
+ring bounding, refusal coverage, the NDJSON sink, /debug/requests, and
+the trafficshape fold.
+
+The sampling tests use CHOSEN request ids (the keep/drop decision is a
+deterministic hash of the id, no RNG to seed) so every assertion pins
+an exact capture set; the off-pin test asserts SONATA_LEDGER_MB unset
+means no ledger object and zero ``sonata_ledger_*`` series.
+"""
+
+import json
+import sys
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from sonata_tpu.serving import ServingRuntime, faults
+from sonata_tpu.serving import ledger as ledger_mod
+from sonata_tpu.serving.admission import Overloaded
+from sonata_tpu.serving.deadlines import DeadlineExceeded
+from sonata_tpu.serving.drain import Draining
+from sonata_tpu.serving.ledger import (
+    LEDGER_DIR_ENV,
+    LEDGER_MB_ENV,
+    LEDGER_SAMPLE_ENV,
+    REFUSALS,
+    RequestLedger,
+)
+from sonata_tpu.serving.metrics import (
+    MetricsRegistry,
+    parse_prometheus_text,
+    start_http_server,
+)
+from sonata_tpu.serving.scope import parse_slos
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    faults.registry().disarm_all()
+    yield
+    faults.registry().disarm_all()
+
+
+def make_ledger(max_bytes=1 << 20, sample=1.0, sink_dir=None, slos=()):
+    return RequestLedger(max_bytes=max_bytes, sample=sample,
+                         sink_dir=sink_dir, slos=slos)
+
+
+def emit_one(lg, rid, outcome="ok", rpc="Synthesize", **fields):
+    rec = lg.begin(rpc, rid)
+    rec.note(**fields)
+    if outcome == "refused":
+        lg.emit(rec, refusal=fields.get("refusal", "draining"))
+    elif outcome == "error":
+        lg.emit(rec, outcome="error", error="OperationError")
+    else:
+        lg.emit(rec, outcome=outcome)
+    return rec
+
+
+# -- knob resolvers ----------------------------------------------------------
+
+def test_resolve_mb_unset_empty_bad_negative_all_off(monkeypatch):
+    monkeypatch.delenv(LEDGER_MB_ENV, raising=False)
+    assert ledger_mod.resolve_ledger_mb() == 0.0
+    monkeypatch.setenv(LEDGER_MB_ENV, "  ")
+    assert ledger_mod.resolve_ledger_mb() == 0.0
+    monkeypatch.setenv(LEDGER_MB_ENV, "lots")
+    assert ledger_mod.resolve_ledger_mb() == 0.0
+    monkeypatch.setenv(LEDGER_MB_ENV, "-3")
+    assert ledger_mod.resolve_ledger_mb() == 0.0
+    monkeypatch.setenv(LEDGER_MB_ENV, "4.5")
+    assert ledger_mod.resolve_ledger_mb() == 4.5
+
+
+def test_resolve_sample_defaults_and_clamps(monkeypatch):
+    monkeypatch.delenv(LEDGER_SAMPLE_ENV, raising=False)
+    assert ledger_mod.resolve_sample() == 1.0
+    monkeypatch.setenv(LEDGER_SAMPLE_ENV, "half")
+    assert ledger_mod.resolve_sample() == 1.0
+    monkeypatch.setenv(LEDGER_SAMPLE_ENV, "2.5")
+    assert ledger_mod.resolve_sample() == 1.0
+    monkeypatch.setenv(LEDGER_SAMPLE_ENV, "-1")
+    assert ledger_mod.resolve_sample() == 0.0
+    monkeypatch.setenv(LEDGER_SAMPLE_ENV, "0.25")
+    assert ledger_mod.resolve_sample() == 0.25
+
+
+def test_from_env_off_and_on(monkeypatch, tmp_path):
+    monkeypatch.delenv(LEDGER_MB_ENV, raising=False)
+    assert ledger_mod.from_env() is None
+    monkeypatch.setenv(LEDGER_MB_ENV, "0")
+    assert ledger_mod.from_env() is None
+    monkeypatch.setenv(LEDGER_MB_ENV, "2")
+    monkeypatch.setenv(LEDGER_SAMPLE_ENV, "0.5")
+    monkeypatch.setenv(LEDGER_DIR_ENV, str(tmp_path))
+    lg = ledger_mod.from_env()
+    assert lg is not None
+    assert lg.max_bytes == 2 * (1 << 20)
+    assert lg.sample == 0.5
+    assert lg._sink_path == str(tmp_path / "ledger.ndjson")
+
+
+# -- tail sampling -----------------------------------------------------------
+
+def test_sample_decision_deterministic_and_extremes():
+    lg0 = make_ledger(sample=0.0)
+    lg1 = make_ledger(sample=1.0)
+    lg_half = make_ledger(sample=0.5)
+    ids = [f"req-{i:04d}" for i in range(200)]
+    assert not any(lg0.sample_decision(r) for r in ids)
+    assert all(lg1.sample_decision(r) for r in ids)
+    first = [lg_half.sample_decision(r) for r in ids]
+    assert first == [lg_half.sample_decision(r) for r in ids]
+    kept = sum(first)
+    assert 0 < kept < len(ids)  # a hash this skewed would be a bug
+
+
+def test_tail_sampling_keeps_every_incident_at_sample_zero():
+    lg = make_ledger(sample=0.0)
+    emit_one(lg, "r-ok")  # sampled out
+    emit_one(lg, "r-err", outcome="error")
+    emit_one(lg, "r-ref", outcome="refused", refusal="node-quota")
+    emit_one(lg, "r-can", outcome="cancelled")
+    kept = {r["request_id"] for r in lg.query(limit=100)}
+    assert kept == {"r-err", "r-ref", "r-can"}
+    assert lg.stat("sampled_out") == 1.0
+    assert lg.outcome_total("ok") == 1.0  # counted even when dropped
+
+
+def test_slo_violator_kept_and_tagged_despite_sample_zero():
+    slos = parse_slos("ttfb:p95:2s,e2e:p99:10s")
+    lg = make_ledger(sample=0.0, slos=slos)
+    rec = lg.begin("Synthesize", "r-slow")
+    rec.note(ttfb_s=5.0)
+    lg.emit(rec)
+    rows = lg.query(limit=10)
+    assert [r["request_id"] for r in rows] == ["r-slow"]
+    assert rows[0]["slo"] == ["ttfb_p95"]
+    rec2 = lg.begin("Synthesize", "r-fast")
+    rec2.note(ttfb_s=0.1)
+    lg.emit(rec2)  # fast and ok → sampled out at 0.0
+    assert len(lg.query(limit=10)) == 1
+
+
+def test_ok_sampling_honored_with_chosen_ids():
+    lg = make_ledger(sample=0.5)
+    ids = [f"sample-{i}" for i in range(40)]
+    expected = {r for r in ids if lg.sample_decision(r)}
+    for rid in ids:
+        emit_one(lg, rid)
+    kept = {r["request_id"] for r in lg.query(limit=100)}
+    assert kept == expected
+    assert lg.stat("sampled_out") == float(len(ids) - len(expected))
+
+
+# -- ring bounding -----------------------------------------------------------
+
+def test_ring_evicts_oldest_ok_first_and_keeps_incidents():
+    lg = make_ledger(max_bytes=600)
+    emit_one(lg, "r-refused", outcome="refused", refusal="draining")
+    for i in range(12):
+        emit_one(lg, f"r-ok-{i:02d}")
+    rows = lg.query(limit=100)
+    ids = [r["request_id"] for r in rows]
+    assert "r-refused" in ids  # incident outlives every OK record
+    assert lg.stat("evictions") > 0
+    assert lg.stat("ring_bytes") <= 600
+    # newest-first ordering, and the evicted records are the OLDEST oks
+    ok_ids = [i for i in ids if i.startswith("r-ok-")]
+    assert ok_ids == sorted(ok_ids, reverse=True)
+    assert "r-ok-00" not in ids
+
+
+def test_ring_all_incidents_falls_back_to_head_eviction():
+    lg = make_ledger(max_bytes=500)
+    for i in range(10):
+        emit_one(lg, f"r-e{i}", outcome="error")
+    assert lg.stat("ring_bytes") <= 500
+    assert lg.stat("evictions") > 0
+    ids = [r["request_id"] for r in lg.query(limit=100)]
+    assert "r-e9" in ids and "r-e0" not in ids
+
+
+# -- off pin -----------------------------------------------------------------
+
+def test_mb_zero_means_no_ledger_and_zero_series(monkeypatch):
+    monkeypatch.delenv(LEDGER_MB_ENV, raising=False)
+    rt = ServingRuntime()
+    try:
+        assert rt.ledger is None
+        assert "sonata_ledger" not in rt.registry.render()
+    finally:
+        rt.close()
+
+
+def test_mb_on_binds_series_and_node_id(monkeypatch):
+    monkeypatch.setenv(LEDGER_MB_ENV, "1")
+    rt = ServingRuntime()
+    try:
+        assert rt.ledger is not None
+        rt.set_node_id("node-a:1")
+        assert rt.ledger.node_id == "node-a:1"
+        series = parse_prometheus_text(rt.registry.render())
+        for family in ("sonata_ledger_records_total",
+                       "sonata_ledger_sampled_out_total",
+                       "sonata_ledger_emit_errors_total",
+                       "sonata_ledger_evictions_total",
+                       "sonata_ledger_sink_rotations_total",
+                       "sonata_ledger_ring_bytes",
+                       "sonata_ledger_ring_records"):
+            assert family in series, family
+    finally:
+        rt.close()
+
+
+# -- failpoint posture -------------------------------------------------------
+
+def test_ledger_emit_failpoint_degrades_to_no_record():
+    lg = make_ledger()
+    faults.registry().arm_spec("ledger.emit:error")
+    emit_one(lg, "r-faulted")
+    assert lg.query(limit=10) == []
+    assert lg.stat("emit_errors") == 1.0
+    faults.registry().disarm_all()
+    emit_one(lg, "r-after")
+    assert [r["request_id"] for r in lg.query(limit=10)] == ["r-after"]
+
+
+def test_emit_is_idempotent_and_closed_ledger_ignores():
+    lg = make_ledger()
+    rec = lg.begin("Synthesize", "r-1")
+    lg.emit(rec)
+    lg.emit(rec, outcome="error", error="late")  # double finalize: no-op
+    rows = lg.query(limit=10)
+    assert len(rows) == 1 and rows[0]["outcome"] == "ok"
+    lg.close()
+    lg.emit(lg.begin("Synthesize", "r-2"))
+    assert len(lg.query(limit=10)) == 1
+
+
+# -- exemplars ---------------------------------------------------------------
+
+def test_exemplar_gauge_tracks_last_incident_one_series_per_kind():
+    reg = MetricsRegistry()
+    lg = make_ledger()
+    lg.bind_metrics(reg)
+    emit_one(lg, "r-ref-1", outcome="refused", refusal="node-quota")
+    emit_one(lg, "r-ref-2", outcome="refused", refusal="overload")
+    emit_one(lg, "r-err-1", outcome="error")
+    series = parse_prometheus_text(reg.render())
+    exemplars = {tuple(sorted(labels.items()))
+                 for labels, _v in series["sonata_ledger_exemplar"]}
+    assert (("kind", "refusal"), ("request_id", "r-ref-2")) in exemplars
+    assert (("kind", "error"), ("request_id", "r-err-1")) in exemplars
+    # the older refusal exemplar series was removed, not accumulated
+    assert not any(dict(e).get("request_id") == "r-ref-1"
+                   for e in exemplars)
+
+
+# -- NDJSON sink -------------------------------------------------------------
+
+def test_sink_writes_ndjson_and_rotates_once(tmp_path):
+    lg = make_ledger(max_bytes=400, sink_dir=str(tmp_path))
+    for i in range(12):
+        emit_one(lg, f"r-{i:02d}", outcome="error")
+    live = tmp_path / "ledger.ndjson"
+    rotated = tmp_path / "ledger.ndjson.1"
+    assert live.exists() and rotated.exists()
+    assert lg.stat("sink_rotations") >= 1.0
+    for line in live.read_text().splitlines():
+        rec = json.loads(line)
+        assert rec["outcome"] == "error" and rec["request_id"]
+
+
+# -- /debug/requests ---------------------------------------------------------
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+        return resp.getcode(), json.loads(resp.read().decode())
+
+
+def test_debug_requests_filters_and_404_when_off():
+    lg = make_ledger()
+    emit_one(lg, "r-a", voice="en", tenant="acme")
+    emit_one(lg, "r-b", voice="ru", tenant="acme")
+    emit_one(lg, "r-c", outcome="refused", refusal="deadline",
+             voice="en", tenant="bulk")
+    reg = MetricsRegistry()
+    http = start_http_server(reg, port=0, ledger=lg)
+    try:
+        _, doc = _get(http.port, "/debug/requests")
+        assert doc["count"] == 3
+        _, doc = _get(http.port, "/debug/requests?voice=en")
+        assert {r["request_id"] for r in doc["records"]} == {"r-a", "r-c"}
+        _, doc = _get(http.port, "/debug/requests?tenant=acme&voice=ru")
+        assert [r["request_id"] for r in doc["records"]] == ["r-b"]
+        _, doc = _get(http.port, "/debug/requests?outcome=refused")
+        assert [r["refusal"] for r in doc["records"]] == ["deadline"]
+        _, doc = _get(http.port, "/debug/requests?id=r-b")
+        assert doc["count"] == 1
+        _, doc = _get(http.port, "/debug/requests?limit=1")
+        assert doc["count"] == 1
+    finally:
+        http.stop()
+    plain = start_http_server(MetricsRegistry(), port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(plain.port, "/debug/requests")
+        assert err.value.code == 404
+    finally:
+        plain.stop()
+
+
+def test_query_since_filter_uses_finalize_ts():
+    import time
+
+    lg = make_ledger()
+    emit_one(lg, "r-old")
+    cut = lg.query(limit=1)[0]["ts"] + 0.001
+    time.sleep(0.005)  # wall-clock ts must clear the cut
+    emit_one(lg, "r-new")
+    rows = lg.query(since=cut, limit=10)
+    assert [r["request_id"] for r in rows] == ["r-new"]
+
+
+# -- router merge ------------------------------------------------------------
+
+def test_router_merge_fetches_node_record_by_id():
+    lg = make_ledger()
+    rec = lg.begin("mesh.Synthesize", "r-hop")
+    rec.note(router={"reroutes": 1, "node": "node-b:2"})
+    lg.emit(rec)
+    calls = []
+
+    def fetcher(request_id, node_id):
+        calls.append((request_id, node_id))
+        return {"request_id": request_id, "node_id": node_id,
+                "outcome": "ok", "dispatches": 2}
+
+    lg.set_node_record_fetcher(fetcher)
+    rows = lg.query(request_id="r-hop", limit=10)
+    assert calls == [("r-hop", "node-b:2")]
+    assert rows[0]["node_record"]["dispatches"] == 2
+    # non-id queries never fan out fetches
+    calls.clear()
+    assert lg.query(limit=10) and calls == []
+    # a broken fetcher degrades to the router record alone
+    lg.set_node_record_fetcher(
+        lambda *_a: (_ for _ in ()).throw(RuntimeError("down")))
+    rows = lg.query(request_id="r-hop", limit=10)
+    assert "node_record" not in rows[0]
+
+
+# -- refusal coverage (satellite: typed refusals stamp the wire id) ----------
+
+class FakeAbort(Exception):
+    pass
+
+
+class FakeContext:
+    def __init__(self, metadata=()):
+        self._md = tuple(metadata)
+        self.trailers = []
+        self.aborted = None
+
+    def invocation_metadata(self):
+        return self._md
+
+    def time_remaining(self):
+        return None  # no client deadline
+
+    def set_trailing_metadata(self, pairs):
+        self.trailers = list(pairs)
+
+    def abort(self, code, detail):
+        self.aborted = (code, detail)
+        raise FakeAbort(detail)
+
+
+def _runtime_with_ledger(monkeypatch):
+    monkeypatch.setenv(LEDGER_MB_ENV, "1")
+    monkeypatch.setenv(LEDGER_SAMPLE_ENV, "1")
+    return ServingRuntime()
+
+
+NODE_REFUSALS = [
+    (Overloaded("node bucket dry"), "node-quota", "node-quota"),
+    (Overloaded("tenant shed"), "tenant-shed", "tenant-shed"),
+    (Overloaded("batch rejected"), "fleet-shed", "fleet-shed"),
+    (Overloaded("at capacity"), None, "overload"),
+    (Draining("restarting"), None, "draining"),
+    (DeadlineExceeded("too late"), None, "deadline"),
+]
+
+
+@pytest.mark.parametrize("exc,explicit,expected",
+                         NODE_REFUSALS,
+                         ids=[e for _x, _e, e in NODE_REFUSALS])
+def test_node_abort_stamps_id_and_records_refusal(monkeypatch, exc,
+                                                  explicit, expected):
+    from sonata_tpu.frontends.grpc_server import SonataGrpcService
+
+    rt = _runtime_with_ledger(monkeypatch)
+    try:
+        svc = SonataGrpcService(runtime=rt)
+        ctx = FakeContext(metadata=(("x-request-id", f"rid-{expected}"),))
+        with pytest.raises(FakeAbort):
+            svc._abort_sonata(ctx, "SynthesizeUtterance", exc,
+                              refusal=explicit)
+        assert ("x-request-id", f"rid-{expected}") in ctx.trailers
+        rows = rt.ledger.query(request_id=f"rid-{expected}", limit=10)
+        assert len(rows) == 1
+        assert rows[0]["outcome"] == "refused"
+        assert rows[0]["refusal"] == expected
+        assert expected in REFUSALS
+    finally:
+        rt.close()
+
+
+ROUTER_REFUSALS = [("router-quota", "router-quota"),
+                   ("voice-warming", "voice-warming"),
+                   ("overload", "overload"),
+                   ("draining", "draining"),
+                   ("deadline", "deadline")]
+
+
+@pytest.mark.parametrize("refusal,expected", ROUTER_REFUSALS,
+                         ids=[e for _r, e in ROUTER_REFUSALS])
+def test_router_abort_stamps_id_and_records_refusal(monkeypatch,
+                                                    refusal, expected):
+    import grpc
+
+    from sonata_tpu.frontends.mesh_server import SonataMeshService
+
+    rt = _runtime_with_ledger(monkeypatch)
+    try:
+        svc = SonataMeshService.__new__(SonataMeshService)
+        svc.runtime = rt
+        ctx = FakeContext(metadata=(("x-request-id", f"mrid-{expected}"),))
+        with pytest.raises(FakeAbort):
+            svc._abort(ctx, "SynthesizeUtterance",
+                       grpc.StatusCode.UNAVAILABLE, "refused",
+                       refusal=refusal)
+        assert ("x-request-id", f"mrid-{expected}") in ctx.trailers
+        rows = rt.ledger.query(request_id=f"mrid-{expected}", limit=10)
+        assert len(rows) == 1
+        assert rows[0]["rpc"] == "mesh.SynthesizeUtterance"
+        assert rows[0]["refusal"] == expected
+    finally:
+        rt.close()
+
+
+def test_refusal_id_stamped_even_with_ledger_off(monkeypatch):
+    from sonata_tpu.frontends.grpc_server import SonataGrpcService
+
+    monkeypatch.delenv(LEDGER_MB_ENV, raising=False)
+    rt = ServingRuntime()
+    try:
+        assert rt.ledger is None
+        svc = SonataGrpcService(runtime=rt)
+        ctx = FakeContext()  # no client id → server generates one
+        with pytest.raises(FakeAbort):
+            svc._abort_sonata(ctx, "SynthesizeUtterance",
+                              Overloaded("at capacity"))
+        stamped = dict(ctx.trailers)
+        assert stamped.get("x-request-id")
+    finally:
+        rt.close()
+
+
+def test_tenant_gate_refusals_land_typed(monkeypatch):
+    """The real quota/shed gate sites pass their typed refusal names
+    (not the Overloaded fallback): drive _tenant_synth_gate with a
+    one-token bucket and with a forced shed rung."""
+    from sonata_tpu.frontends.grpc_server import SonataGrpcService
+
+    monkeypatch.setenv(LEDGER_MB_ENV, "1")
+    monkeypatch.setenv("SONATA_TENANTS", json.dumps({"tenants": {
+        "acme": {"qps": 1, "burst": 1, "weight": 4}}}))
+    rt = ServingRuntime()
+    try:
+        assert rt.tenancy is not None
+        svc = SonataGrpcService(runtime=rt)
+        md = (("x-tenant-id", "acme"), ("x-request-id", "q-1"))
+        gate, name = svc._tenant_synth_gate(FakeContext(md), "Synth")
+        if gate is not None:
+            gate.leave(name)
+        ctx2 = FakeContext((("x-tenant-id", "acme"),
+                            ("x-request-id", "q-2")))
+        with pytest.raises(FakeAbort):  # burst=1: second charge refused
+            svc._tenant_synth_gate(ctx2, "Synth")
+        rows = rt.ledger.query(request_id="q-2", limit=10)
+        assert rows and rows[0]["refusal"] == "node-quota"
+        assert rows[0]["tenant"] == "acme"
+        assert dict(ctx2.trailers).get("retry-after-s")
+        # forced shed rung → tenant-shed (the rung site's typed name)
+        monkeypatch.setattr(rt.tenancy, "shed_rung",
+                            lambda *_a, **_k: True)
+        ctx3 = FakeContext((("x-tenant-id", "acme"),
+                            ("x-request-id", "q-3")))
+        with pytest.raises(FakeAbort):
+            svc._tenant_synth_gate(ctx3, "Synth")
+        rows = rt.ledger.query(request_id="q-3", limit=10)
+        assert rows and rows[0]["refusal"] == "tenant-shed"
+    finally:
+        rt.close()
+
+
+# -- cost extraction ---------------------------------------------------------
+
+class _Span:
+    def __init__(self, name, duration=0.0, attrs=None):
+        self.name = name
+        self.duration_s = duration
+        self.attrs = attrs or {}
+
+
+class _Trace:
+    def __init__(self, spans):
+        self._spans = spans
+
+    def spans_snapshot(self):
+        return self._spans
+
+
+def test_cost_fields_from_trace_extracts_breakdown():
+    trace = _Trace([
+        _Span("admission", 0.01),
+        _Span("queue-wait", 0.04),
+        _Span("dispatch", 0.2, {"padding_rows": 3}),
+        _Span("dispatch", 0.1, {"padding_rows": 1}),
+        _Span("decode-window", 0.05),
+        _Span("decode-window", 0.05),
+        _Span("cache-hit", 0.001),
+        _Span("mesh-reroute", 0.0),
+    ])
+    cost = ledger_mod.cost_fields_from_trace(trace)
+    assert cost["queue_wait_s"] == pytest.approx(0.05)
+    assert cost["dispatches"] == 2
+    assert cost["padding_rows"] == 4
+    assert cost["iterations"] == 2
+    assert cost["cache"] == "hit"
+    assert cost["reroutes"] == 1
+    assert ledger_mod.cost_fields_from_trace(None) == {}
+
+
+# -- trafficshape fold (satellite: round-trip) -------------------------------
+
+def _synthetic_records():
+    """A workload with a KNOWN shape: 3 short texts (bucket 16), 2
+    medium (bucket 96), one refusal, arrivals exactly 1s apart."""
+    rows = []
+    ts = 1000.0
+    for i, (text_len, bytes_out) in enumerate(
+            [(10, 16 * 512), (12, 16 * 512), (8, 16 * 512),
+             (80, 300 * 512), (90, 300 * 512)]):
+        rows.append({"request_id": f"s-{i}", "rpc": "Synthesize",
+                     "outcome": "ok", "text_len": text_len,
+                     "bytes_out": bytes_out, "chunks": 2,
+                     "dispatches": 1, "padding_rows": i % 2,
+                     "voice": "en", "dur_s": 0.0, "ts": ts + i})
+    rows.append({"request_id": "s-ref", "rpc": "Synthesize",
+                 "outcome": "refused", "refusal": "node-quota",
+                 "text_len": 40, "dur_s": 0.0, "ts": ts + 5})
+    return rows
+
+
+def test_trafficshape_roundtrip_pins_shape(tmp_path):
+    from tools.trafficshape import build_shape, load_records, main
+
+    ndjson = tmp_path / "ledger.ndjson"
+    ndjson.write_text("\n".join(json.dumps(r) for r in
+                                _synthetic_records()) + "\n")
+    out = tmp_path / "TRAFFICSHAPE_test.json"
+    assert main([str(ndjson), "-o", str(out)]) == 0
+    shape = json.loads(out.read_text())
+    assert shape["records_total"] == 6
+    assert shape["ok_records"] == 5
+    assert shape["outcomes"] == {"ok": 5, "refused": 1}
+    assert shape["refusals"] == {"node-quota": 1}
+    by_bucket = {(b["text_bucket"], b["frame_bucket"]): b
+                 for b in shape["buckets"]}
+    # 16*512 bytes → 16 frames at hop 256/int16 → frame bucket 64
+    assert by_bucket[(16, 64)]["requests"] == 3
+    assert by_bucket[(96, 384)]["requests"] == 2
+    assert by_bucket[(96, 384)]["bytes_out"] == 2 * 300 * 512
+    inter = shape["interarrival"]
+    assert inter["count"] == 5
+    assert inter["mean_s"] == pytest.approx(1.0)
+    assert inter["p50_s"] == pytest.approx(1.0)
+    assert inter["cv"] == pytest.approx(0.0, abs=1e-6)
+    # the fold is a pure function: same input → same artifact bytes
+    shape2 = build_shape(load_records([ndjson]))
+    assert shape2 == shape
+
+
+def test_trafficshape_reads_rotated_pair_and_skips_junk(tmp_path):
+    from tools.trafficshape import expand_inputs, load_records
+
+    (tmp_path / "ledger.ndjson.1").write_text(
+        json.dumps({"request_id": "old", "outcome": "ok", "ts": 1.0,
+                    "text_len": 5}) + "\n")
+    (tmp_path / "ledger.ndjson").write_text(
+        "not json\n" + json.dumps(
+            {"request_id": "new", "outcome": "ok", "ts": 2.0,
+             "text_len": 5}) + "\n")
+    paths = expand_inputs([str(tmp_path)])
+    assert [p.name for p in paths] == ["ledger.ndjson.1",
+                                       "ledger.ndjson"]
+    records = load_records(paths)
+    assert [r["request_id"] for r in records] == ["old", "new"]

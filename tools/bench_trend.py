@@ -52,7 +52,8 @@ WAIVERS_PATH = REPO / "BENCH_WAIVERS.json"
 REGRESSION_THRESHOLD = 0.20
 
 _REV_RE = re.compile(
-    r"^((?:BENCH|WARMUP|MESH|FLEET|CACHE|TENANCY)[A-Z_]*)_r(\d+)\.json$")
+    r"^((?:BENCH|WARMUP|MESH|FLEET|CACHE|TENANCY|LEDGER)[A-Z_]*)"
+    r"_r(\d+)\.json$")
 
 #: metric-name fragments → comparison direction
 _LOWER_IS_BETTER = ("ttfb", "rtf", "overhead", "latency", "wall",
@@ -112,7 +113,8 @@ def collect() -> Dict[str, Dict]:
                    + list(REPO.glob("FLEET_r*.json"))
                    + list(REPO.glob("FLEETCACHE_r*.json"))
                    + list(REPO.glob("CACHE_r*.json"))
-                   + list(REPO.glob("TENANCY_r*.json")))
+                   + list(REPO.glob("TENANCY_r*.json"))
+                   + list(REPO.glob("LEDGER_r*.json")))
     for path in paths:
         m = _REV_RE.match(path.name)
         if m is None:

@@ -494,11 +494,13 @@ def main() -> int:
     # the mesh.* sites need a router in front of this server — phase M
     # fires them; cache.lookup needs a cache-enabled server — phase CC
     # fires it; tenancy.classify needs a tenant-table server — phase TT
+    # fires it; ledger.emit needs a ledger-enabled server — phase LG
     # fires it; the all-sites check runs after all of them)
     fired = fires_total()
     for site in faults.SITES:
         if fired.get(site, 0) > 0 or site.startswith("mesh.") \
-                or site in ("cache.lookup", "tenancy.classify"):
+                or site in ("cache.lookup", "tenancy.classify",
+                            "ledger.emit"):
             continue
         arm_spec(f"{site}:error:1::1")
         if site == "metrics.scrape":
@@ -508,10 +510,12 @@ def main() -> int:
         disarm_all()
         heal_pool()
     fired = fires_total()
-    check("every non-mesh, non-cache, non-tenancy site fired this run",
+    check("every non-mesh, non-cache, non-tenancy, non-ledger site "
+          "fired this run",
           all(fired.get(s, 0) > 0 for s in faults.SITES
               if not s.startswith("mesh.")
-              and s not in ("cache.lookup", "tenancy.classify")),
+              and s not in ("cache.lookup", "tenancy.classify",
+                            "ledger.emit")),
           f"({fired})")
     _e, _t, results, err = synth(TEXTS[0])
     check("clean request serves after disarm",
@@ -1017,9 +1021,81 @@ def main() -> int:
     if runtime.scope is not None:
         scope_mod.install(runtime.scope)
 
+    # ---- phase LG: request ledger (ISSUE 19) — the ledger.emit
+    # failpoint must degrade to a MISSING RECORD, never a failed
+    # request: observability is strictly off the serving path.  A
+    # dedicated server boots with the ledger armed (the main server
+    # runs ledger-off on purpose — the pin that unset SONATA_LEDGER_MB
+    # keeps every request path byte-for-byte pre-ledger).
+    os.environ["SONATA_LEDGER_MB"] = "4"
+    try:
+        lg_server, lg_port = create_server(
+            0, metrics_port=0, request_timeout_s=REQUEST_TIMEOUT_S)
+    finally:
+        del os.environ["SONATA_LEDGER_MB"]
+    lg_server.start()
+    lg_rt = lg_server.sonata_runtime
+    check("ledger: runtime constructed the request ledger",
+          lg_rt.ledger is not None)
+    lg_channel = grpc.insecure_channel(f"127.0.0.1:{lg_port}")
+    lg_load = lg_channel.unary_unary(
+        "/sonata_grpc.sonata_grpc/LoadVoice",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.VoiceInfo.decode)
+    lg_synth_rpc = lg_channel.unary_stream(
+        "/sonata_grpc.sonata_grpc/SynthesizeUtterance",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.SynthesisResult.decode)
+    lg_info = lg_load(pb.VoicePath(config_path=cfg), timeout=120.0)
+    lg_server.sonata_service.warmup_and_mark_ready()
+
+    def lg_synth(text: str, rid: str):
+        try:
+            return [r.wav_samples for r in lg_synth_rpc(
+                pb.Utterance(voice_id=lg_info.voice_id, text=text),
+                timeout=RPC_TIMEOUT_S,
+                metadata=(("x-request-id", rid),))], None
+        except grpc.RpcError as e:
+            return None, e
+
+    served, err = lg_synth(TEXTS[0], f"chaos-lg-{args.seed}-ok")
+    check("ledger: request serves and lands a wide event",
+          err is None and served and len(served[0]) > 0
+          and lg_rt.ledger.query(
+              request_id=f"chaos-lg-{args.seed}-ok", limit=1),
+          f"({err.code().name if err else 'ok'})")
+    emit0 = fires_total().get("ledger.emit", 0)
+    arm_spec("ledger.emit:error:1::2")
+    served, err = lg_synth(TEXTS[1], f"chaos-lg-{args.seed}-faulted")
+    check("ledger: armed ledger.emit error degrades to no record "
+          "(request still serves, never fails)",
+          err is None and served and len(served[0]) > 0
+          and not lg_rt.ledger.query(
+              request_id=f"chaos-lg-{args.seed}-faulted", limit=1),
+          f"({err.code().name if err else 'ok'})")
+    served, err = lg_synth(TEXTS[2], f"chaos-lg-{args.seed}-faulted2")
+    check("ledger: second degraded finalize also serves",
+          err is None and served and len(served[0]) > 0)
+    check("ledger: emit fires counted and emit errors visible",
+          fires_total().get("ledger.emit", 0) == emit0 + 2
+          and lg_rt.ledger.stat("emit_errors") == 2.0,
+          f"({fires_total()})")
+    disarm_all()
+    served, err = lg_synth(TEXTS[3], f"chaos-lg-{args.seed}-healed")
+    check("ledger: disarmed finalize records again",
+          err is None and served
+          and lg_rt.ledger.query(
+              request_id=f"chaos-lg-{args.seed}-healed", limit=1))
+    lg_channel.close()
+    lg_server.stop(grace=None)
+    lg_server.sonata_service.shutdown()
+    degradation_mod.install(runtime.degradation)
+    if runtime.scope is not None:
+        scope_mod.install(runtime.scope)
+
     fired = fires_total()
-    check("every registered site fired this run (mesh, cache, and "
-          "tenancy sites included)",
+    check("every registered site fired this run (mesh, cache, tenancy, "
+          "and ledger sites included)",
           all(fired.get(s, 0) > 0 for s in faults.SITES), f"({fired})")
 
     # ---- phase G: no request outlived its budget; registry symmetry ----

@@ -1686,6 +1686,160 @@ def main(args=None) -> int:
         tn_proc.kill()
     tn_log.close()
 
+    # ---- ledger phase (ISSUE 19): per-request wide events ----
+    # One ledger-enabled backend behind a ledger-enabled router
+    # sampling OK traffic at 0.25.  The contract: the OK capture set is
+    # exactly the hash-deterministic keep set (chosen request ids make
+    # it pinnable); errors and typed refusals are captured 100% even
+    # when their ids hash to "drop"; refusals stamp x-request-id on the
+    # wire; /debug/requests filters; querying a routed request by id
+    # merges the node-side hop record; and the exemplar gauge points at
+    # the latest incident.
+    lg_ports = (free_port(), free_port())
+    lg_log = open(os.path.join(mesh_cache, "lgnode0.log"), "w")
+    lg_env = dict(os.environ, JAX_PLATFORMS="cpu",
+                  SMOKE_VOICE_CFG=cfg,
+                  SONATA_JAX_CACHE_DIR=mesh_cache,
+                  SONATA_LEDGER_MB="4",
+                  MESH_NODE_GRPC_PORT=str(lg_ports[0]),
+                  MESH_NODE_METRICS_PORT=str(lg_ports[1]),
+                  MESH_NODE_EMPTY="0")
+    lg_proc = subprocess.Popen(
+        [sys.executable, __file__, "--mesh-node-boot"],
+        env=lg_env, stdout=lg_log, stderr=lg_log)
+    check("ledger: ledger-enabled backend boots ready",
+          wait_readyz(lg_ports[1]))
+    os.environ["SONATA_LEDGER_MB"] = "4"
+    os.environ["SONATA_LEDGER_SAMPLE"] = "0.25"
+    try:
+        lg_server, lg_grpc_port = create_mesh_server(
+            0, backends=[f"127.0.0.1:{lg_ports[0]}/{lg_ports[1]}"],
+            metrics_port=0, request_timeout_s=60.0)
+    finally:
+        for k in ("SONATA_LEDGER_MB", "SONATA_LEDGER_SAMPLE"):
+            del os.environ[k]
+    lg_server.start()
+    lg_rt = lg_server.sonata_runtime
+    lg_base = f"http://127.0.0.1:{lg_rt.http_port}"
+    check("ledger: router built the request ledger at sample=0.25",
+          lg_rt.ledger is not None and lg_rt.ledger.sample == 0.25)
+    lg_channel = grpc.insecure_channel(f"127.0.0.1:{lg_grpc_port}")
+    lg_synth = lg_channel.unary_stream(
+        "/sonata_grpc.sonata_grpc/SynthesizeUtterance",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.SynthesisResult.decode)
+    lg_loadv = lg_channel.unary_unary(
+        "/sonata_grpc.sonata_grpc/LoadVoice",
+        request_serializer=lambda m: m.encode(),
+        response_deserializer=pb.VoiceInfo.decode)
+    lg_voice = lg_loadv(pb.VoicePath(config_path=cfg),
+                        timeout=120.0).voice_id
+
+    def lg_call(rid: str, text: str, voice: str = "") -> dict:
+        call = lg_synth(
+            pb.Utterance(voice_id=voice or lg_voice, text=text),
+            timeout=60.0, metadata=(("x-request-id", rid),))
+        try:
+            chunks = [r.wav_samples for r in call]
+            return {"ok": bool(chunks) and len(chunks[0]) > 0,
+                    "trailers": dict(call.trailing_metadata() or ())}
+        except grpc.RpcError as e:
+            return {"ok": False, "code": e.code(),
+                    "trailers": dict(e.trailing_metadata() or ())}
+
+    lg_ok_ids = [f"smoke-lg-ok-{i:02d}" for i in range(8)]
+    lg_served = [lg_call(rid, f"Ledger lap {i}.")
+                 for i, rid in enumerate(lg_ok_ids)]
+    check("ledger: routed OK traffic serves",
+          all(r["ok"] for r in lg_served),
+          f"({[r.get('code') for r in lg_served]})")
+    lg_expected = {rid for rid in lg_ok_ids
+                   if lg_rt.ledger.sample_decision(rid)}
+    lg_captured = {r["request_id"]
+                   for r in lg_rt.ledger.query(outcome="ok", limit=100)
+                   if r["request_id"] in set(lg_ok_ids)}
+    check("ledger: OK capture set is exactly the deterministic sample "
+          "keep set",
+          lg_captured == lg_expected and 0 < len(lg_captured) < 8,
+          f"(captured {sorted(lg_captured)}, "
+          f"expected {sorted(lg_expected)})")
+    check("ledger: sampled-out OK records are counted, not lost",
+          lg_rt.ledger.stat("sampled_out") >= len(lg_ok_ids)
+          - len(lg_expected)
+          and lg_rt.ledger.outcome_total("ok") >= len(lg_ok_ids),
+          f"(sampled_out={lg_rt.ledger.stat('sampled_out')})")
+
+    # an unknown voice is an ERROR record — captured despite a
+    # request id that hashes to "drop" at sample=0.25
+    err_res = lg_call("smoke-lg-ref-0", "No such voice.",
+                      voice="no-such-voice")
+    err_rows = lg_rt.ledger.query(request_id="smoke-lg-ref-0", limit=5)
+    check("ledger: error outcome captured at 100% despite sampling",
+          not err_res["ok"]
+          and not lg_rt.ledger.sample_decision("smoke-lg-ref-0")
+          and len(err_rows) == 1
+          and err_rows[0]["outcome"] == "error",
+          f"({err_rows})")
+
+    # drain the router: every subsequent request draws the typed
+    # ``draining`` refusal — 100% captured, id stamped on the wire
+    lg_rt.drain.begin("smoke-ledger-phase")
+    lg_refused = [lg_call(f"smoke-lg-ref-{i}", "Refuse me.")
+                  for i in (1, 2)]
+    check("ledger: draining refusals are typed UNAVAILABLE",
+          all(not r["ok"] and r.get("code") ==
+              grpc.StatusCode.UNAVAILABLE for r in lg_refused),
+          f"({[getattr(r.get('code'), 'name', None) for r in lg_refused]})")
+    check("ledger: refusals stamp x-request-id on the wire",
+          [r["trailers"].get("x-request-id") for r in lg_refused]
+          == ["smoke-lg-ref-1", "smoke-lg-ref-2"],
+          f"({[r['trailers'] for r in lg_refused]})")
+    ref_rows = lg_rt.ledger.query(outcome="refused", limit=10)
+    check("ledger: refusal records captured at 100% with the typed "
+          "kind",
+          {r["request_id"] for r in ref_rows}
+          >= {"smoke-lg-ref-1", "smoke-lg-ref-2"}
+          and all(r["refusal"] == "draining" for r in ref_rows),
+          f"({ref_rows})")
+
+    # /debug/requests: outcome filter + router-merge of the node-side
+    # hop record when querying one routed request by id
+    code, body = http_get(lg_base + "/debug/requests?outcome=refused")
+    lg_doc = json.loads(body) if code == 200 else {}
+    check("ledger: /debug/requests filters by outcome",
+          code == 200 and lg_doc.get("count", 0) >= 2
+          and all(r["outcome"] == "refused"
+                  for r in lg_doc.get("records", [])),
+          f"(code {code}, count {lg_doc.get('count')})")
+    merged_id = sorted(lg_expected)[0]
+    code, body = http_get(lg_base + f"/debug/requests?id={merged_id}")
+    lg_doc = json.loads(body) if code == 200 else {}
+    lg_recs = lg_doc.get("records", [])
+    check("ledger: by-id query merges the node-side hop record",
+          code == 200 and len(lg_recs) == 1
+          and (lg_recs[0].get("node_record") or {}).get("request_id")
+          == merged_id,
+          f"({lg_recs})")
+
+    # exemplar gauge: one series per incident kind, pointing at the
+    # latest incident's request id
+    parsed = parse_prometheus_text(http_get(lg_base + "/metrics")[1])
+    exemplars = {lbl.get("kind"): lbl.get("request_id")
+                 for lbl, _v in parsed.get("sonata_ledger_exemplar", [])}
+    check("ledger: exemplar gauge points at the latest refusal",
+          exemplars.get("refusal") == "smoke-lg-ref-2",
+          f"({exemplars})")
+    check("ledger: per-outcome record totals exported",
+          {lbl.get("outcome"): v for lbl, v in parsed.get(
+              "sonata_ledger_records_total", [])}.get("refused", 0) >= 2)
+
+    lg_channel.close()
+    lg_server.stop(grace=None)
+    lg_server.sonata_service.shutdown()
+    if lg_proc.poll() is None:
+        lg_proc.kill()
+    lg_log.close()
+
     if failures:
         print(f"smoke: {len(failures)} FAILED: {failures}")
         return 1

@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""Fold request-ledger NDJSON into a replayable traffic-shape artifact.
+
+ROADMAP's top open item (the replay-driven capacity harness) needs a
+*scoped traffic dump*: what text lengths arrive, in which static-shape
+buckets they land, and with what arrival process — the
+BUCKET_WASTE_r11.json question asked of real traffic instead of the
+padding audit.  The request ledger (``serving/ledger.py``,
+``SONATA_LEDGER_DIR``) records exactly that per request; this tool folds
+its NDJSON sink into one committed JSON document a future loadgen can
+replay:
+
+1. **bucket histogram** — requests grouped by ``(text_bucket,
+   frame_bucket)`` via the same :mod:`sonata_tpu.utils.buckets` ladders
+   the compile cache pads to (frame counts are estimated from PCM bytes
+   out: ``bytes / 2 / hop_length`` — int16 samples, default VITS hop
+   256), with per-bucket request / chunk / dispatch / padding-row
+   totals;
+2. **inter-arrival process** — deltas between consecutive record
+   timestamps: mean / p50 / p95 / max, coefficient of variation (cv ≈ 1
+   is Poisson, > 1 bursty), and a fixed-edge histogram;
+3. **outcome + refusal mix** — so a replay can reproduce the
+   refusal pressure, not just the happy path.
+
+Output is a pure function of the input records (no wall-clock stamp):
+re-running on the same NDJSON reproduces the artifact byte for byte,
+which is what makes it committable.
+
+Run: ``python tools/trafficshape.py <ledger.ndjson|dir>...
+[-o TRAFFICSHAPE_rNN.json]``.  A directory argument reads the rotated
+sink pair (``ledger.ndjson.1`` then ``ledger.ndjson``, oldest first).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from sonata_tpu.utils.buckets import (  # noqa: E402
+    FRAME_BUCKETS,
+    TEXT_BUCKETS,
+    bucket_for,
+)
+
+#: int16 PCM: one emitted sample is two bytes
+BYTES_PER_SAMPLE = 2
+#: default decoder hop length (samples per mel frame) for the
+#: bytes-out → frame-count estimate; override with --hop-length when
+#: the voice config differs
+DEFAULT_HOP_LENGTH = 256
+
+#: fixed inter-arrival histogram edges (seconds) — fixed so two dumps
+#: of the same workload produce comparable histograms
+INTERARRIVAL_EDGES = (0.001, 0.003, 0.01, 0.03, 0.1, 0.3,
+                      1.0, 3.0, 10.0, 30.0)
+
+
+def load_records(paths: List[Path]) -> List[dict]:
+    """Parse ledger NDJSON; malformed lines are counted out, not fatal
+    (a rotating sink can cut one line mid-write)."""
+    records: List[dict] = []
+    for path in paths:
+        try:
+            text = path.read_text(encoding="utf-8")
+        except OSError as e:
+            raise SystemExit(f"trafficshape: cannot read {path}: {e}")
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("request_id"):
+                records.append(rec)
+    return records
+
+
+def expand_inputs(args_paths: List[str]) -> List[Path]:
+    """File args pass through; a directory arg expands to its rotated
+    sink pair, oldest first (``.1`` before the live file)."""
+    paths: List[Path] = []
+    for raw in args_paths:
+        p = Path(raw)
+        if p.is_dir():
+            for name in ("ledger.ndjson.1", "ledger.ndjson"):
+                cand = p / name
+                if cand.exists():
+                    paths.append(cand)
+        else:
+            paths.append(p)
+    if not paths:
+        raise SystemExit("trafficshape: no input files")
+    return paths
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def interarrival_process(records: List[dict]) -> dict:
+    """Arrival-process summary from record finalize timestamps.
+
+    Finalize time (``ts``) minus duration approximates arrival; using
+    it keeps the tool a pure fold over the sink (no extra fields), and
+    for replay purposes the delta distribution is what matters."""
+    arrivals = sorted(
+        float(r["ts"]) - float(r.get("dur_s", 0.0))
+        for r in records if isinstance(r.get("ts"), (int, float)))
+    deltas = sorted(b - a for a, b in zip(arrivals, arrivals[1:]))
+    n = len(deltas)
+    if n == 0:
+        return {"count": 0, "mean_s": 0.0, "p50_s": 0.0, "p95_s": 0.0,
+                "max_s": 0.0, "cv": 0.0,
+                "histogram": [{"le_s": e, "count": 0}
+                              for e in INTERARRIVAL_EDGES]}
+    mean = sum(deltas) / n
+    var = sum((d - mean) ** 2 for d in deltas) / n
+    cv = (var ** 0.5) / mean if mean > 0 else 0.0
+    histogram = [{"le_s": edge,
+                  "count": sum(1 for d in deltas if d <= edge)}
+                 for edge in INTERARRIVAL_EDGES]
+    return {"count": n,
+            "mean_s": round(mean, 6),
+            "p50_s": round(_quantile(deltas, 0.50), 6),
+            "p95_s": round(_quantile(deltas, 0.95), 6),
+            "max_s": round(deltas[-1], 6),
+            "cv": round(cv, 4),
+            "histogram": histogram}
+
+
+def build_shape(records: List[dict],
+                hop_length: int = DEFAULT_HOP_LENGTH) -> dict:
+    """Ledger records → the BUCKET_WASTE-shaped traffic document."""
+    buckets: Dict[tuple, dict] = {}
+    outcomes: Dict[str, int] = {}
+    refusals: Dict[str, int] = {}
+    by_voice: Dict[str, int] = {}
+    for rec in records:
+        outcome = rec.get("outcome", "ok")
+        outcomes[outcome] = outcomes.get(outcome, 0) + 1
+        if rec.get("refusal"):
+            refusals[rec["refusal"]] = refusals.get(rec["refusal"], 0) + 1
+        voice = rec.get("voice")
+        if voice:
+            by_voice[voice] = by_voice.get(voice, 0) + 1
+        if outcome != "ok":
+            continue  # refused requests never reached a shape
+        text_bucket = bucket_for(int(rec.get("text_len", 0) or 0),
+                                 TEXT_BUCKETS)
+        bytes_out = int(rec.get("bytes_out", 0) or 0)
+        frames = bytes_out // BYTES_PER_SAMPLE // max(hop_length, 1)
+        frame_bucket = bucket_for(frames, FRAME_BUCKETS)
+        row = buckets.setdefault((text_bucket, frame_bucket), {
+            "text_bucket": text_bucket, "frame_bucket": frame_bucket,
+            "requests": 0, "bytes_out": 0, "chunks": 0,
+            "dispatches": 0, "padding_rows": 0})
+        row["requests"] += 1
+        row["bytes_out"] += bytes_out
+        row["chunks"] += int(rec.get("chunks", 0) or 0)
+        row["dispatches"] += int(rec.get("dispatches", 0) or 0)
+        row["padding_rows"] += int(rec.get("padding_rows", 0) or 0)
+    return {
+        "records_total": len(records),
+        "ok_records": outcomes.get("ok", 0),
+        "hop_length": hop_length,
+        "buckets": [buckets[k] for k in sorted(buckets)],
+        "interarrival": interarrival_process(records),
+        "outcomes": dict(sorted(outcomes.items())),
+        "refusals": dict(sorted(refusals.items())),
+        "requests_by_voice": dict(sorted(by_voice.items())),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fold ledger NDJSON into a traffic-shape artifact")
+    ap.add_argument("inputs", nargs="+",
+                    help="ledger NDJSON file(s) or SONATA_LEDGER_DIR "
+                         "directory (reads the rotated pair)")
+    ap.add_argument("-o", "--output", default=None,
+                    help="artifact path (default: stdout)")
+    ap.add_argument("--hop-length", type=int, default=DEFAULT_HOP_LENGTH,
+                    help="samples per frame for the bytes→frames "
+                         f"estimate (default {DEFAULT_HOP_LENGTH})")
+    args = ap.parse_args(argv)
+    records = load_records(expand_inputs(args.inputs))
+    if not records:
+        raise SystemExit("trafficshape: no ledger records in input")
+    shape = build_shape(records, hop_length=args.hop_length)
+    doc = json.dumps(shape, indent=1, sort_keys=True) + "\n"
+    if args.output:
+        Path(args.output).write_text(doc, encoding="utf-8")
+        print(f"trafficshape: wrote {args.output} "
+              f"({shape['records_total']} records, "
+              f"{len(shape['buckets'])} bucket rows)")
+    else:
+        sys.stdout.write(doc)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
