@@ -1315,6 +1315,13 @@ def install_signal_handlers(server, grace_s: float = 2.0) -> bool:
 
     def _drain_then_stop(sig_name: str) -> None:
         try:
+            # a SIGTERM mid-boot: bound the overlap with the warmup
+            # thread before draining (it owns readiness until it exits;
+            # a wedged compile must not stall the signal path, hence
+            # the timeout rather than an unbounded join)
+            warmup = getattr(server, "sonata_warmup_thread", None)
+            if warmup is not None:
+                warmup.join(timeout=2.0)
             service.drain(reason=sig_name)
         except Exception:
             log.exception("graceful drain failed; stopping hard")
@@ -1544,8 +1551,12 @@ def main(argv=None) -> int:
                     server.sonata_service.prewarm_all()
                 server.sonata_service.warmup_and_mark_ready()
 
-            threading.Thread(target=startup, name="sonata_warmup",
-                             daemon=True).start()
+            warmup_thread = threading.Thread(
+                target=startup, name="sonata_warmup", daemon=True)
+            # the graceful drain joins this (bounded) so a SIGTERM
+            # mid-boot does not race the warmup flipping readiness
+            server.sonata_warmup_thread = warmup_thread
+            warmup_thread.start()
         else:
             if args.prewarm:
                 log.warning("--prewarm does nothing without --voice")

@@ -607,14 +607,14 @@ class FleetScope:
     def fleet_snapshot(self) -> dict:
         """The JSON scoreboard: per-node health/occupancy/staleness/
         burn/deltas plus the fleet rollups."""
-        view = self.router.mesh_view()
+        view = self.router.snapshot()
         with self._lock:
             by_index = dict(self._nodes)
             no_scope = set(self._no_scope)
             stats = dict(self.stats)
         nodes_out = []
         for node in self.router.nodes:
-            nv = node.view()
+            nv = node.snapshot()
             ns = by_index.get(node.index)
             entry = {**nv,
                      "reporting": ns is not None,
@@ -649,7 +649,7 @@ class FleetScope:
         # converged holders, budgets, tombstones — served here so one
         # /debug/fleet load answers "where do this fleet's voices live"
         plane = getattr(self.router, "placement", None)
-        placement = (plane.placement_view() if plane is not None
+        placement = (plane.snapshot() if plane is not None
                      else None)
         # fleet cache tier (ISSUE 16): the router-side affinity/
         # replication view plus the node cache-counter rollup — one
@@ -893,7 +893,7 @@ class FleetScope:
         tests call it directly).  Auto-dump triggers — node eviction,
         breaker trip, fleet fast-burn breach — are edge-detected here
         so they cost nothing anywhere else."""
-        view = self.router.mesh_view()
+        view = self.router.snapshot()
         snap: dict = {"ts": round(time.time(), 3),
                       "routable": view["routable"],
                       "nodes_reporting": self.nodes_reporting(),

@@ -277,11 +277,7 @@ class MeshNode:
         #: budget's never-evict-a-live-voice guard reads)
         self.voice_outstanding: dict = {}
 
-    def view(self) -> dict:
-        # not named snapshot(): the repo-wide lock-order pass resolves
-        # calls by bare name, and ReplicaPool/Replica already own
-        # lock-taking snapshot() methods — a shared name would read as
-        # a mesh-lock -> pool-lock -> mesh-lock cycle
+    def snapshot(self) -> dict:
         return {"node_id": self.node_id, "addr": self.spec.addr,
                 "index": self.index,
                 "state": _STATE_NAMES[self.state],
@@ -586,14 +582,13 @@ class MeshRouter:
         with self._lock:
             return sum(1 for n in self.nodes if self._routable_locked(n))
 
-    def mesh_view(self) -> dict:
-        # see MeshNode.view for why neither method is named snapshot()
+    def snapshot(self) -> dict:
         with self._lock:
             return {"name": self.name, "closed": self._closed,
                     "routable": sum(1 for n in self.nodes
                                     if self._routable_locked(n)),
                     "stats": dict(self.stats),
-                    "nodes": [n.view() for n in self.nodes]}
+                    "nodes": [n.snapshot() for n in self.nodes]}
 
     def probe_once(self, node: MeshNode) -> bool:
         """One health cycle: ``/readyz`` gate, then ``/metrics``

@@ -415,7 +415,7 @@ class _Handler(BaseHTTPRequestHandler):
         if self.tenancy is None:
             self._reply(404, b"tenancy not enabled on this server\n")
             return
-        body = json.dumps(self.tenancy.debug_doc())
+        body = json.dumps(self.tenancy.snapshot())
         self._reply(200, body.encode("utf-8"),
                     "application/json; charset=utf-8")
 

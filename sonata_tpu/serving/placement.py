@@ -620,10 +620,7 @@ class PlacementPlane:
         return applied
 
     # -- introspection ---------------------------------------------------------
-    def placement_view(self) -> dict:
-        # not named snapshot(): the repo-wide lock-order pass resolves
-        # calls by bare name, and ReplicaPool/Replica own lock-taking
-        # snapshot() methods (the mesh_view()/view() precedent)
+    def snapshot(self) -> dict:
         nodes = self.router.nodes
         by_index = {n.index: n for n in nodes}
         now = self._clock()

@@ -668,7 +668,7 @@ class TenantPlane:
         with self._lock:
             return sorted(self._tenants)
 
-    def debug_doc(self) -> dict:
+    def snapshot(self) -> dict:
         """``GET /debug/tenants``: config + counters + queue state."""
         with self._lock:
             # copy refs under the lock, render views outside it — the

@@ -1,0 +1,63 @@
+"""Fixture: the PR-17 false lock cycle, un-renamed.
+
+Four classes share the natural method name ``snapshot()`` — exactly the
+shape that bare-name resolution (sonata-lint v1) manufactured a
+deadlock from and that forced the PR 12/17 defensive renames
+(``view()``/``mesh_view()``/``debug_doc``):
+
+- ``Replica.snapshot``     takes the replica lock
+- ``ReplicaPool.snapshot`` takes the pool lock, then calls
+  ``r.snapshot()`` on its *typed* replicas (v1: bare name also matches
+  ``MeshRouter.snapshot`` → phantom edge pool-lock → mesh-lock)
+- ``MeshNode.snapshot``    lockless
+- ``MeshRouter.snapshot``  takes the mesh lock, then calls
+  ``n.snapshot()`` on its *typed* nodes (v1: bare name also matches
+  ``Replica.snapshot``/``ReplicaPool.snapshot`` → phantom edge
+  mesh-lock → pool-lock — closing the false cycle)
+
+The v2 resolver types both receivers through the constructor-assigned
+list attributes, so neither phantom edge exists: the regression test
+asserts **no lock-cycle finding and no allowlist entry** on this file.
+"""
+
+import threading
+
+
+class Replica:
+    def __init__(self, index):
+        self.index = index
+        self._lock = threading.Lock()
+        self.served = 0
+
+    def snapshot(self):
+        with self._lock:
+            return {"index": self.index, "served": self.served}
+
+
+class ReplicaPool:
+    def __init__(self, n):
+        self._lock = threading.Lock()
+        self.replicas = [Replica(i) for i in range(n)]
+
+    def snapshot(self):
+        with self._lock:
+            return [r.snapshot() for r in self.replicas]
+
+
+class MeshNode:
+    def __init__(self, node_id):
+        self.node_id = node_id
+        self.routed = 0
+
+    def snapshot(self):
+        return {"node_id": self.node_id, "routed": self.routed}
+
+
+class MeshRouter:
+    def __init__(self, specs):
+        self._lock = threading.Lock()
+        self.nodes = [MeshNode(s) for s in specs]
+
+    def snapshot(self):
+        with self._lock:
+            return {"nodes": [n.snapshot() for n in self.nodes]}

@@ -1,0 +1,25 @@
+"""Fixture: block_line anchoring under nested ``with`` statements.
+
+A blocking call under the INNER lock must anchor its ``block_line`` to
+the inner ``with``, so an allowlist ``block = true`` entry on the outer
+lock never silently covers it (the v1 bug: an inner lock that failed to
+resolve left the outer block open).
+"""
+
+import threading
+import time
+
+
+class Nested:
+    def __init__(self):
+        self._outer = threading.Lock()
+        self._inner = threading.Lock()
+
+    def outer_only(self):
+        with self._outer:           # findings here anchor THIS line
+            time.sleep(0.1)
+
+    def both(self):
+        with self._outer:
+            with self._inner:       # findings here anchor THIS line
+                time.sleep(0.1)

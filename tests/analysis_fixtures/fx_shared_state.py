@@ -1,0 +1,34 @@
+"""Fixture: unguarded-shared-write true positive + near-miss negatives."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0       # TRUE POSITIVE: written unguarded from
+        self.total = 0      # NEGATIVE: every write under _lock
+        self._running = False  # NEGATIVE: atomic sentinel stores only
+        self._worker = None
+
+    def start(self):
+        self._running = True
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    def _loop(self):
+        while self._running:
+            self.hits += 1          # thread context, no lock
+            with self._lock:
+                self.total += 1     # thread context, guarded
+
+    def reset(self):
+        self.hits = 0               # external context, no lock → race
+        with self._lock:
+            self.total = 0          # external context, guarded
+
+    def stop(self):
+        self._running = False
+        worker, self._worker = self._worker, None
+        if worker is not None:
+            worker.join(timeout=1.0)

@@ -1,0 +1,42 @@
+"""Fixture: thread-life true positives + near-miss negatives."""
+
+import threading
+
+
+class Leaky:
+    def start(self):
+        # TRUE POSITIVES: no explicit daemon=, and never joined from
+        # any drain/close path
+        self._worker = threading.Thread(target=self._run)
+        self._worker.start()
+
+    def _run(self):
+        pass
+
+
+class Disciplined:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._ticker = None
+
+    def start(self):
+        # NEGATIVE: daemon explicit, joined in close() via the swap
+        self._ticker = threading.Thread(target=self._run, daemon=True)
+        self._ticker.start()
+
+    def _run(self):
+        self._stop.wait()
+
+    def close(self):
+        self._stop.set()
+        ticker, self._ticker = self._ticker, None
+        if ticker is not None:
+            ticker.join(timeout=2.0)
+
+    def hard_stop(self):
+        # NEGATIVE: a teardown helper IS the drain path (join not
+        # required); daemon is still explicit
+        threading.Thread(target=self._shutdown, daemon=True).start()
+
+    def _shutdown(self):
+        self._stop.set()

@@ -1,0 +1,35 @@
+"""Fixture: yield-under-lock true positive + near-miss negatives."""
+
+import contextlib
+import threading
+
+
+@contextlib.contextmanager
+def span(name):
+    yield name
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buf = []
+
+    def stream(self):
+        # TRUE POSITIVE: the generator suspends holding _lock
+        with self._lock:
+            for item in self._buf:
+                yield item
+
+    def stream_copied(self):
+        # NEGATIVE (near miss): copy under the lock, release, yield
+        with self._lock:
+            items = list(self._buf)
+        for item in items:
+            yield item
+
+    def stream_traced(self):
+        # NEGATIVE: a call-shaped context manager is not a lock —
+        # yielding inside a trace span is the streaming idiom
+        with span("stream"):
+            for item in list(self._buf):
+                yield item

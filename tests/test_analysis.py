@@ -33,6 +33,9 @@ from tools.analysis import (  # noqa: E402
     knobs,
     lockorder,
     metricsdoc,
+    sharedstate,
+    threadlife,
+    yieldlock,
 )
 from tools.analysis.core import (  # noqa: E402
     Allowlist,
@@ -234,6 +237,148 @@ def test_failpoint_exercised_requires_arming_not_substring(tmp_path):
 # ---------------------------------------------------------------------------
 # allowlist semantics
 # ---------------------------------------------------------------------------
+# the v2 resolver: the PR-17 false cycle, un-renamed
+# ---------------------------------------------------------------------------
+
+def test_pr17_false_cycle_fixture_green_unrenamed():
+    """Four classes sharing the natural name ``snapshot()`` — the exact
+    shape bare-name resolution manufactured a deadlock from (and that
+    forced the PR 12/17 ``view()``/``mesh_view()``/``debug_doc``
+    renames) — must produce NO finding and need NO allowlist entry."""
+    diags = lockorder.run(fixture_ctx("fx_false_cycle.py"))
+    assert diags == [], "\n".join(d.format() for d in diags)
+
+
+def test_real_tree_keeps_natural_snapshot_names():
+    """The PR 12/17 defensive renames stay reverted: the mesh, tenancy
+    and placement planes all expose ``snapshot()``, and none of the
+    dodge-names survive anywhere in the package."""
+    import re
+    serving = REPO / "sonata_tpu" / "serving"
+    for mod, cls in (("mesh.py", "MeshRouter"), ("tenancy.py", None),
+                     ("placement.py", None)):
+        src = (serving / mod).read_text(encoding="utf-8")
+        assert re.search(r"^    def snapshot\(self\)", src, re.M), \
+            f"{mod}: snapshot() missing"
+    for mod in serving.glob("*.py"):
+        src = mod.read_text(encoding="utf-8")
+        for dodge in ("mesh_view", "debug_doc", "placement_view"):
+            assert dodge not in src, f"{mod.name}: {dodge} survived"
+
+
+# ---------------------------------------------------------------------------
+# pass 6: yield-lock
+# ---------------------------------------------------------------------------
+
+def test_yield_under_lock_detected():
+    diags = yieldlock.run(fixture_ctx("fx_yield_lock.py"))
+    assert codes(diags) == {"yield-under-lock"}
+    assert len(diags) == 1
+    d = diags[0]
+    assert "Ring._lock" in d.message
+    # anchored at the yield, block-scoped to the with statement
+    assert d.block_line is not None and d.block_line < d.line
+
+
+def test_yield_after_release_and_span_are_clean():
+    """The near misses: copy-release-yield, and a call-shaped context
+    manager (trace span) — neither is a finding."""
+    diags = yieldlock.run(fixture_ctx("fx_yield_lock.py"))
+    lines = {d.line for d in diags}
+    src = (FIXTURES / "fx_yield_lock.py").read_text().splitlines()
+    for i, text in enumerate(src, 1):
+        if "yield item" in text and i not in lines:
+            continue  # a clean yield
+    # exactly the one seeded positive
+    assert len(lines) == 1
+
+
+# ---------------------------------------------------------------------------
+# pass 7: shared-state
+# ---------------------------------------------------------------------------
+
+def test_unguarded_shared_write_detected():
+    diags = sharedstate.run(fixture_ctx("fx_shared_state.py"))
+    assert codes(diags) == {"unguarded-shared-write"}
+    assert len(diags) == 1
+    d = diags[0]
+    assert "Counter.hits" in d.message
+    assert "thread:_loop" in d.message and "external" in d.message
+
+
+def test_guarded_and_sentinel_writes_are_clean():
+    """``total`` (every write under _lock) and ``_running`` (atomic
+    sentinel stores) must not be findings."""
+    diags = sharedstate.run(fixture_ctx("fx_shared_state.py"))
+    for d in diags:
+        assert "Counter.total" not in d.message
+        assert "_running" not in d.message
+
+
+# ---------------------------------------------------------------------------
+# pass 8: thread-life
+# ---------------------------------------------------------------------------
+
+def test_thread_life_daemon_and_drain_detected():
+    diags = threadlife.run(fixture_ctx("fx_thread_life.py"))
+    assert codes(diags) == {"daemon-unset", "undrained-thread"}
+    # both findings anchor Leaky.start's construction site
+    src = (FIXTURES / "fx_thread_life.py").read_text().splitlines()
+    ctor_line = next(i for i, t in enumerate(src, 1)
+                     if "threading.Thread(target=self._run)" in t)
+    assert {d.line for d in diags} == {ctor_line}
+
+
+def test_thread_life_swap_join_and_teardown_are_clean():
+    """Disciplined: daemon explicit + the swap-join drain
+    (``t, self._t = self._t, None; t.join()``) and a teardown-helper
+    thread (target named ``*_shutdown``) — no findings."""
+    diags = threadlife.run(fixture_ctx("fx_thread_life.py"))
+    assert all("Disciplined" not in d.message and "_ticker" not in
+               d.message for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# block_line anchoring under nested with statements
+# ---------------------------------------------------------------------------
+
+def test_nested_with_anchors_innermost_lock():
+    diags = lockorder.run(fixture_ctx("fx_nested_with.py"))
+    by_msg = {d.message: d for d in diags}
+    inner = next(d for d in diags if "_inner" in d.message)
+    outer = next(d for d in diags if "_outer" in d.message)
+    assert inner.block_line == inner.line - 1   # the inner with
+    assert outer.block_line == outer.line - 1
+    assert inner.block_line != outer.block_line
+
+
+def test_outer_block_entry_does_not_cover_inner_lock():
+    """An allowlist ``block = true`` entry anchored on the OUTER with
+    must not suppress a finding under the distinct INNER lock (the v1
+    anchoring bug this release fixes)."""
+    ctx = fixture_ctx("fx_nested_with.py")
+    diags = lockorder.run(ctx)
+    inner = next(d for d in diags if "_inner" in d.message)
+    outer_with = inner.block_line - 1           # `with self._outer:`
+    allow = Allowlist([{
+        "pass": "lock-order", "file": "fx_nested_with.py",
+        "line": outer_with, "block": True,
+        "contains": "with self._outer:", "reason": "outer only"}])
+    allow.apply(diags, ctx)
+    assert not inner.allowed, \
+        "outer block entry silently covered the inner-lock finding"
+    # and covering the inner lock requires anchoring ITS with
+    diags2 = lockorder.run(ctx)
+    inner2 = next(d for d in diags2 if "_inner" in d.message)
+    allow2 = Allowlist([{
+        "pass": "lock-order", "file": "fx_nested_with.py",
+        "line": inner2.block_line, "block": True,
+        "contains": "with self._inner:", "reason": "inner hold"}])
+    allow2.apply(diags2, ctx)
+    assert inner2.allowed
+
+
+# ---------------------------------------------------------------------------
 
 def test_unused_allowlist_entry_is_an_error():
     ctx = fixture_ctx("fx_lock_cycle.py")
@@ -353,3 +498,45 @@ def test_render_report_text_counts():
     text = render_report(diags, errors, "text")
     assert "sonata-lint:" in text.splitlines()[-1]
     assert "0 finding(s)" in text.splitlines()[-1]
+
+
+def test_allowlist_entry_count_does_not_grow():
+    """The v2 re-audit contract (ROADMAP trajectory goal): deepening
+    the analyzer must not be bought with suppressions.  9 entries was
+    the pre-v2 count; new passes and the rename revert landed without
+    adding one.  Lowering this bound is progress; raising it needs the
+    same scrutiny as a production lock."""
+    assert len(Allowlist.load().entries) <= 9
+
+
+def test_new_passes_registered():
+    names = {p.PASS_NAME for p in PASSES}
+    assert {"yield-lock", "shared-state", "thread-life"} <= names
+
+
+def test_committed_report_matches_fresh_run():
+    """tools/analysis_report.json must equal a fresh run — the same
+    freshness assertion the CI lane makes, so a code change that moves
+    any finding (or allowlisted line) cannot land without regenerating
+    the artifact in the same commit."""
+    diags, errors = run_all()
+    fresh = render_report(diags, errors, "json") + "\n"
+    committed = (REPO / "tools" / "analysis_report.json").read_text(
+        encoding="utf-8")
+    assert fresh == committed, \
+        "stale tools/analysis_report.json — re-run " \
+        "`python -m tools.analysis --report tools/analysis_report.json`"
+
+
+def test_cli_timing_prints_per_pass_and_respects_budget(capsys):
+    from tools.analysis.__main__ import main, TIMING_BUDGET_S
+
+    rc = main(["--timing"])
+    out = capsys.readouterr().out
+    assert rc == 0, "timing run failed (findings or budget)"
+    timing_lines = [ln for ln in out.splitlines()
+                    if ln.startswith("timing:")]
+    reported = {ln.split()[1] for ln in timing_lines}
+    assert {p.PASS_NAME for p in PASSES} <= reported
+    total_line = next(ln for ln in timing_lines if " total " in ln)
+    assert f"budget {TIMING_BUDGET_S:g}s" in total_line

@@ -73,17 +73,17 @@ def test_record_load_revisions_and_tombstone_lifecycle():
     try:
         plane, _ops = make_plane(r)
         assert plane.record_load("v1", "/cfg/a.json") is True
-        rev1 = plane.placement_view()["voices"][0]["revision"]
+        rev1 = plane.snapshot()["voices"][0]["revision"]
         # an idempotent re-load overwrites the record, never duplicates
         assert plane.record_load("v1", "/cfg/a.json") is False
-        rev2 = plane.placement_view()["voices"][0]["revision"]
+        rev2 = plane.snapshot()["voices"][0]["revision"]
         assert rev2 > rev1
         assert plane.record_unload("v1") is True
-        view = plane.placement_view()
+        view = plane.snapshot()
         assert view["voices"] == [] and "v1" in view["tombstones"]
         # reload after unload clears the tombstone: loadable again
         assert plane.record_load("v1", "/cfg/a.json") is True
-        view = plane.placement_view()
+        view = plane.snapshot()
         assert [v["voice_id"] for v in view["voices"]] == ["v1"]
         assert view["tombstones"] == []
     finally:
@@ -134,7 +134,7 @@ def test_placement_spread_balances_pressure():
         plane, _ops = make_plane(r, replicas=2)
         for i in range(4):
             plane.record_load(f"v{i}", f"/cfg/{i}.json")
-        view = plane.placement_view()
+        view = plane.snapshot()
         pressures = [len(row["placed"]) for row in view["nodes"]]
         assert sorted(pressures) == [2, 2, 2, 2]
         assert all(len(v["assigned"]) == 2 for v in view["voices"])
@@ -157,10 +157,10 @@ def test_placement_is_sticky_across_rebalances():
     try:
         plane, _ops = make_plane(r, replicas=1)
         plane.record_load("v1", "/cfg/a.json")
-        before = plane.placement_view()["voices"][0]["assigned"]
+        before = plane.snapshot()["voices"][0]["assigned"]
         for node in r.nodes:
             plane.reconcile_node(node)
-        assert plane.placement_view()["voices"][0]["assigned"] == before
+        assert plane.snapshot()["voices"][0]["assigned"] == before
     finally:
         r.close()
 
@@ -273,7 +273,7 @@ def test_forget_load_rolls_back_without_tombstone():
         plane, _ops = make_plane(r)
         plane.record_load("v1", "/cfg/a.json")
         plane.forget_load("v1")
-        view = plane.placement_view()
+        view = plane.snapshot()
         assert view["voices"] == [] and view["tombstones"] == []
     finally:
         r.close()
@@ -290,12 +290,12 @@ def test_tripped_only_holder_is_replaced_within_one_cycle():
         plane.record_load("v1", "/cfg/a.json")
         set_actual(r.nodes[0], "v1")
         set_actual(r.nodes[1])
-        assert plane.placement_view()["voices"][0]["assigned"] == \
+        assert plane.snapshot()["voices"][0]["assigned"] == \
             [r.nodes[0].node_id]
         r.nodes[0].state = OPEN  # the only holder trips
         applied = plane.reconcile_node(r.nodes[1])
         assert applied == [("load", "v1")]
-        view = plane.placement_view()["voices"][0]
+        view = plane.snapshot()["voices"][0]
         assert view["assigned"] == [r.nodes[1].node_id]
         assert view["converged"] == [r.nodes[1].node_id]
         assert plane.stats["evictions_unplaced"] == 1
@@ -454,7 +454,7 @@ def test_lru_eviction_order_under_ram_budget():
             plane.record_load(vid, f"/cfg/{vid}.json")
         # spread: v1,v3 -> node0; v2,v4 -> node1 (both at budget)
         view = {row["index"]: row["placed"]
-                for row in plane.placement_view()["nodes"]}
+                for row in plane.snapshot()["nodes"]}
         assert view[0] == ["v1", "v3"] and view[1] == ["v2", "v4"]
         set_actual(r.nodes[0], "v1", "v3")
         set_actual(r.nodes[1], "v2", "v4")
@@ -468,7 +468,7 @@ def test_lru_eviction_order_under_ram_budget():
         assert ("load", "v5") in applied
         assert plane.stats["evictions_ram_budget"] == 1
         view = {row["index"]: row["placed"]
-                for row in plane.placement_view()["nodes"]}
+                for row in plane.snapshot()["nodes"]}
         assert view[0] == ["v1", "v5"]
     finally:
         r.close()
@@ -496,7 +496,7 @@ def test_eviction_never_takes_a_voice_with_live_streams():
         applied = plane.reconcile_node(r.nodes[0])
         assert ("unload", "v1") not in applied
         view = {row["index"]: row["placed"]
-                for row in plane.placement_view()["nodes"]}
+                for row in plane.snapshot()["nodes"]}
         assert "v1" in view[0]          # protected by the live stream
         assert "v3" not in view[0]      # the next-LRU went instead
         r.release(n, "v1")
@@ -557,7 +557,7 @@ def test_evicted_voice_replaces_onto_node_with_budget_room():
         set_actual(r.nodes[1], "v5")
         plane.reconcile_node(r.nodes[1])
         assert plane.desired_count("v1") == 1
-        assert plane.placement_view()["voices"][0]["assigned"] == \
+        assert plane.snapshot()["voices"][0]["assigned"] == \
             [r.nodes[1].node_id]
     finally:
         r.close()
@@ -698,7 +698,7 @@ def test_forget_load_restores_the_tombstone_it_cleared():
         plane.record_unload("v1")
         plane.record_load("v1", "/cfg/a.json")   # clears the tombstone
         plane.forget_load("v1")                  # ...but the op failed
-        view = plane.placement_view()
+        view = plane.snapshot()
         assert view["voices"] == [] and "v1" in view["tombstones"]
         set_actual(r.nodes[0], "v1")             # the stale rejoiner
         assert plane.reconcile_node(r.nodes[0]) == [("unload", "v1")]
@@ -715,7 +715,7 @@ def test_forget_unload_rolls_the_tombstone_back_out():
         plane, ops = make_plane(r)
         plane.record_unload("bootvoice")
         plane.forget_unload("bootvoice")
-        assert plane.placement_view()["tombstones"] == []
+        assert plane.snapshot()["tombstones"] == []
         set_actual(r.nodes[0], "bootvoice")
         assert plane.reconcile_node(r.nodes[0]) == []
         assert ops == []
@@ -755,7 +755,7 @@ def test_probe_scrapes_voices_line_from_readyz():
     try:
         assert r.probe_once(r.nodes[0]) is True
         assert r.nodes[0].loaded_voices == frozenset(("12", "34"))
-        assert r.nodes[0].view()["voices"] == ["12", "34"]
+        assert r.nodes[0].snapshot()["voices"] == ["12", "34"]
     finally:
         r.close()
 
@@ -829,14 +829,14 @@ def test_placement_metrics_lazily_created_and_exactly_torn_down():
         r.close()
 
 
-def test_placement_view_rows():
+def test_placement_snapshot_rows():
     r = make_router(2)
     try:
         plane, _ops = make_plane(r, replicas=2)
         plane.record_load("v1", "/cfg/a.json")
         plane.record_options("v1", b"O")
         set_actual(r.nodes[0], "v1")
-        view = plane.placement_view()
+        view = plane.snapshot()
         assert view["replicas"] == 2
         row = view["voices"][0]
         assert row["voice_id"] == "v1"

@@ -596,7 +596,7 @@ def test_tenant_metrics_lazy_series_and_exact_teardown():
 def test_snapshot_shape():
     plane = make_plane(clock=FakeClock(), fair_slots=2)
     plane.note_admitted("gold")
-    doc = plane.debug_doc()
+    doc = plane.snapshot()
     assert doc["revision"] >= 1 and doc["remote_revision"] == 0
     assert doc["tenants"]["gold"]["counters"]["admitted"] == 1
     assert doc["tenants"]["gold"]["queue_depth"] == 0

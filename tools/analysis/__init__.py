@@ -1,23 +1,43 @@
 """sonata-lint: first-party static analysis for the serving stack.
 
-Five passes over the repo's own invariants, runnable as a blocking CI
+Eight passes over the repo's own invariants, runnable as a blocking CI
 lane (``python -m tools.analysis``) and importable for tests:
 
-1. ``lockorder``  — lock-order cycles + blocking calls under held locks
-2. ``hostsync``   — device syncs / retrace hazards in & around jitted code
-3. ``knobs``      — SONATA_* env knob ↔ operator-doc parity
-4. ``metricsdoc`` — metric-name doc parity + register/unregister symmetry
-5. ``failpoints`` — failpoint-registry parity: armed names exist, every
+1. ``lockorder``   — lock-order cycles + blocking calls under held locks
+2. ``hostsync``    — device syncs / retrace hazards in & around jitted code
+3. ``knobs``       — SONATA_* env knob ↔ operator-doc parity
+4. ``metricsdoc``  — metric-name doc parity + register/unregister symmetry
+5. ``failpoints``  — failpoint-registry parity: armed names exist, every
    registered site is exercised by a test and documented
+6. ``yieldlock``   — generators that suspend while holding a lock
+7. ``sharedstate`` — instance attrs written from ≥2 threaded entry
+   points with no common guarding lock
+8. ``threadlife``  — Thread construction discipline: explicit daemon,
+   reachable drain/join path
+
+Passes 1, 2, 6, 7 and 8 share one class-aware interprocedural resolver
+(:mod:`tools.analysis.callgraph`): receiver-typed method resolution,
+class-qualified lock identities, and per-function blocking/acquisition
+summaries computed once per run.
 
 See docs/ANALYSIS.md for the pass contracts and the allowlist format.
 """
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Tuple
 
-from . import failpoints, hostsync, knobs, lockorder, metricsdoc
+from . import (
+    failpoints,
+    hostsync,
+    knobs,
+    lockorder,
+    metricsdoc,
+    sharedstate,
+    threadlife,
+    yieldlock,
+)
 from .core import (
     AnalysisContext,
     Allowlist,
@@ -25,7 +45,8 @@ from .core import (
     render_report,
 )
 
-PASSES = (lockorder, hostsync, knobs, metricsdoc, failpoints)
+PASSES = (lockorder, hostsync, knobs, metricsdoc, failpoints,
+          yieldlock, sharedstate, threadlife)
 
 __all__ = [
     "AnalysisContext",
@@ -39,12 +60,19 @@ __all__ = [
 
 def run_all(ctx: Optional[AnalysisContext] = None,
             allowlist: Optional[Allowlist] = None,
-            passes=PASSES) -> Tuple[List[Diagnostic], List[str]]:
+            passes=PASSES,
+            timings: Optional[dict] = None
+            ) -> Tuple[List[Diagnostic], List[str]]:
     """Run the passes; returns (diagnostics, allowlist errors).
 
     Diagnostics covered by the allowlist come back with ``allowed=True``
     (the run log keeps them visible); stale or unused allowlist entries
     are errors — suppressions may not rot silently.
+
+    When ``timings`` is given, per-pass wall seconds are recorded into
+    it keyed by ``PASS_NAME`` (the first resolver-backed pass also pays
+    the one-time parse + summary fixpoint — by design: the budget the
+    CI lane enforces covers the whole run, not a flattering subset).
     """
     if ctx is None:
         ctx = AnalysisContext.for_repo()
@@ -52,7 +80,10 @@ def run_all(ctx: Optional[AnalysisContext] = None,
         allowlist = Allowlist.load()
     diags: List[Diagnostic] = []
     for p in passes:
+        t0 = time.perf_counter()
         diags.extend(p.run(ctx))
+        if timings is not None:
+            timings[p.PASS_NAME] = time.perf_counter() - t0
     allowlist.apply(diags, ctx,
                     active_passes={p.PASS_NAME for p in passes})
     return diags, list(allowlist.errors)

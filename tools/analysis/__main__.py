@@ -13,6 +13,12 @@ from pathlib import Path
 from . import PASSES, run_all
 from .core import Allowlist, AnalysisContext, render_report
 
+#: committed wall-clock budget for one full run (``--timing`` fails the
+#: lane when exceeded).  The analyzer is pure-AST and single-process;
+#: if a pass pushes the total past this, fix the pass — do not raise
+#: the number without a rationale in the PR that does.
+TIMING_BUDGET_S = 30.0
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python -m tools.analysis")
@@ -30,6 +36,10 @@ def main(argv=None) -> int:
                     help="also write the JSON report to PATH (one "
                          "analysis run feeds both the log and the "
                          "committed artifact)")
+    ap.add_argument("--timing", action="store_true",
+                    help="print per-pass wall time; fail if the total "
+                         f"exceeds the committed {TIMING_BUDGET_S:g}s "
+                         "budget")
     args = ap.parse_args(argv)
 
     ctx = AnalysisContext.for_repo(
@@ -38,13 +48,26 @@ def main(argv=None) -> int:
         Path(args.allowlist) if args.allowlist else None)
     passes = [p for p in PASSES
               if not args.only or p.PASS_NAME in args.only]
-    diags, errors = run_all(ctx, allowlist, passes)
+    timings: dict = {}
+    diags, errors = run_all(ctx, allowlist, passes,
+                            timings=timings if args.timing else None)
     if args.report:
         Path(args.report).write_text(
             render_report(diags, errors, "json") + "\n", encoding="utf-8")
     print(render_report(diags, errors, args.format))
+    over_budget = False
+    if args.timing:
+        total = sum(timings.values())
+        for name, secs in timings.items():  # insertion = run order
+            print(f"timing: {name:<12s} {secs:8.3f}s")
+        print(f"timing: {'total':<12s} {total:8.3f}s "
+              f"(budget {TIMING_BUDGET_S:g}s)")
+        if total > TIMING_BUDGET_S:
+            over_budget = True
+            print(f"timing: BUDGET EXCEEDED — {total:.3f}s > "
+                  f"{TIMING_BUDGET_S:g}s", file=sys.stderr)
     active = [d for d in diags if not d.allowed]
-    return 1 if (active or errors) else 0
+    return 1 if (active or errors or over_budget) else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,835 @@
+"""sonata-lint v2 resolution core: class-aware, type-seeded call graph.
+
+Until PR 19 the lock-order pass resolved calls by *bare name*: every
+``x.snapshot()`` matched every analyzed ``snapshot``, so two unrelated
+classes owning same-named lock-taking methods read as one lock-order
+cycle.  That imprecision manufactured two false cycles (PR 12's mesh
+``view()``/``mesh_view()`` workaround, PR 17's ``snapshot`` →
+``debug_doc`` rename) and started shaping production names around the
+linter.  This module replaces it with a receiver-typed resolver shared
+by every pass:
+
+- **Receiver typing.**  ``self.m()`` / ``cls.m()`` resolve within the
+  enclosing class (walking analyzed bases).  Attribute receivers
+  resolve through a per-class attribute-type table seeded from
+  ``__init__``/method bodies: ``self._pool = ReplicaPool(...)`` types
+  ``_pool``, ``self.nodes = [MeshNode(...) for ...]`` types the
+  *element* of ``nodes``, annotations (``router: MeshRouter``) count
+  too.  Module-level instances (``_REGISTRY = Registry()``) and local
+  variables (``x = ClassName(...)``, ``x = self._pool``,
+  ``for n in self.nodes``, ``with self._lock``-style aliases,
+  ``x = getattr(obj, "attr")``) are tracked the same way.
+- **Confidence.**  Every resolution is HIGH (receiver type known,
+  import-resolved module function, constructor) or LOW (the old
+  bare-name fallback, only for genuinely unresolvable receivers).
+  Passes downgrade LOW resolutions: the lock-order pass still
+  propagates *can-block* facts through them (missing a blocked hold is
+  worse than a duplicate message) but never derives lock-acquisition
+  edges from them — a LOW edge is exactly the same-name-implies-
+  same-lock false-cycle class this rewrite retires.
+- **Shared summaries.**  Per-function ``blocks`` (reason a call chain
+  can block) and ``acquires`` (lock ids taken, with confidence)
+  summaries are computed once to a fixpoint and reused by every pass
+  via :func:`for_context` (cached on the ``AnalysisContext``).
+
+Locks get class-qualified identities (``module:Class.attr``): two
+``_lock`` attributes on different classes are different locks, which is
+most of what the bare-name resolver got wrong.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import AnalysisContext, ModuleInfo, call_name, dotted_name
+
+HIGH = "high"
+LOW = "low"
+
+#: constructors that make an attribute a lock
+_LOCK_CTORS = {"threading.Lock": False, "Lock": False,
+               "threading.RLock": True, "RLock": True}
+#: constructors that make an attribute a queue
+_QUEUE_CTORS = {"queue.Queue", "Queue", "queue.SimpleQueue", "SimpleQueue",
+                "queue.LifoQueue", "LifoQueue"}
+#: constructors that make an attribute an event / condition
+_EVENT_CTORS = {"threading.Event", "Event", "threading.Condition",
+                "Condition"}
+
+#: generic names never resolved through the bare-name fallback (they
+#: alias dict/str/logging methods far more often than repo functions);
+#: HIGH-confidence resolutions ignore this list — a typed receiver is
+#: allowed to own a method called ``get``
+GENERIC_NAMES = {
+    "get", "put", "pop", "append", "extend", "items", "values", "keys",
+    "copy", "update", "add", "clear", "split", "strip", "join", "format",
+    "encode", "decode", "read", "write", "set", "is_set", "info", "debug",
+    "warning", "error", "exception", "inc", "observe", "labels", "remove",
+    "record", "annotate", "finish", "count", "index", "sort", "setdefault",
+    "startswith", "endswith", "lower", "upper", "group", "match", "search",
+    # Thread.start aliases the (blocking) coalescer stream-start method
+    "start",
+}
+
+
+@dataclass
+class LockDef:
+    """One lock the analyzed tree constructs."""
+
+    lock_id: str                 # "module:Class.attr" | "module:name" | local
+    reentrant: bool = False
+
+
+@dataclass
+class FuncInfo:
+    """One analyzed function/method plus its shared summary."""
+
+    module: str
+    cls: Optional[str]                  # enclosing class name, if a method
+    node: ast.FunctionDef
+    parent: Optional["FuncInfo"] = None  # lexical parent function
+    children: List["FuncInfo"] = field(default_factory=list)
+    is_property: bool = False
+    #: summary: first reason any call chain out of this function blocks
+    blocks: Optional[str] = None
+    #: summary: lock_id -> confidence of the acquisition (HIGH when every
+    #: propagation hop was HIGH; a single LOW hop degrades it)
+    acquires: Dict[str, str] = field(default_factory=dict)
+    #: yields while holding a lock resolved in this function (yieldlock
+    #: input; (lock_id, yield lineno, with lineno))
+    lock_yields: List[Tuple[str, int, int]] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def key(self) -> Tuple[str, Optional[str], str, int]:
+        return (self.module, self.cls, self.node.name, self.node.lineno)
+
+    def top_level(self) -> "FuncInfo":
+        f = self
+        while f.parent is not None:
+            f = f.parent
+        return f
+
+
+@dataclass
+class ClassInfo:
+    module: str
+    name: str
+    node: ast.ClassDef
+    bases: List[str] = field(default_factory=list)
+    methods: Dict[str, FuncInfo] = field(default_factory=dict)
+    #: attr -> class key ("module:Class") of the instance stored there
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    #: attr -> element class key for list/tuple/dict-valued attributes
+    attr_elem_types: Dict[str, str] = field(default_factory=dict)
+    #: attr -> LockDef for lock-valued attributes
+    locks: Dict[str, LockDef] = field(default_factory=dict)
+    #: attrs holding queues / events (blocking-call receiver detection)
+    queue_attrs: Set[str] = field(default_factory=set)
+    event_attrs: Set[str] = field(default_factory=set)
+
+    @property
+    def key(self) -> str:
+        return f"{self.module}:{self.name}"
+
+
+@dataclass
+class Resolution:
+    """One call target with the confidence of the resolution."""
+
+    func: FuncInfo
+    confidence: str  # HIGH | LOW
+
+
+class _ModuleScope:
+    """Import tables for one module (the hostsync resolver, promoted)."""
+
+    def __init__(self, rel: str, mod: ModuleInfo,
+                 all_modules: Dict[str, ModuleInfo]):
+        self.rel = rel
+        #: local alias -> module relpath ("vits" -> sonata_tpu/models/vits.py)
+        self.module_aliases: Dict[str, str] = {}
+        #: imported name -> (module relpath, name)
+        self.imported: Dict[str, Tuple[str, str]] = {}
+        pkg_parts = rel.split("/")[:-1]
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.level > 0:
+                base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                target = base + (node.module.split(".") if node.module
+                                 else [])
+                for alias in node.names:
+                    name = alias.asname or alias.name
+                    as_module = "/".join(target + [alias.name]) + ".py"
+                    as_member = "/".join(target) + ".py"
+                    if as_module in all_modules:
+                        self.module_aliases[name] = as_module
+                    elif as_member in all_modules:
+                        self.imported[name] = (as_member, alias.name)
+                    else:
+                        pkg_init = "/".join(target + [alias.name,
+                                                      "__init__.py"])
+                        if pkg_init in all_modules:
+                            self.module_aliases[name] = pkg_init
+
+
+def _ctor_class_name(value: ast.AST) -> Optional[str]:
+    """``ClassName(...)`` / ``mod.ClassName(...)`` -> rightmost name when
+    it looks like a class constructor (CapWord convention)."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = call_name(value)
+    if name and name[:1].isupper():
+        return name
+    return None
+
+
+def _elem_ctor_class_name(value: ast.AST) -> Optional[str]:
+    """Element class for ``[C(...) for ...]`` / ``[C(...), C(...)]``."""
+    if isinstance(value, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+        return _ctor_class_name(value.elt)
+    if isinstance(value, (ast.List, ast.Tuple)) and value.elts:
+        names = {_ctor_class_name(e) for e in value.elts}
+        if len(names) == 1:
+            return names.pop()
+    return None
+
+
+def _annotation_class_name(ann: ast.AST) -> Optional[str]:
+    """Class name from an annotation node (handles string annotations
+    and Optional[...]/quotes)."""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        text = ann.value.strip().strip('"\'')
+        tail = text.split("[")[-1].rstrip("]").split(".")[-1]
+        return tail if tail[:1].isupper() else None
+    if isinstance(ann, ast.Subscript):  # Optional[X] / List[X]
+        return _annotation_class_name(ann.slice)
+    name = dotted_name(ann)
+    if name:
+        tail = name.split(".")[-1]
+        return tail if tail[:1].isupper() else None
+    return None
+
+
+class CallGraph:
+    """Class-aware function index + resolver + shared summaries."""
+
+    def __init__(self, ctx: AnalysisContext):
+        self.modules = ctx.modules
+        self.scopes = {rel: _ModuleScope(rel, m, ctx.modules)
+                       for rel, m in ctx.modules.items()}
+        self.classes: Dict[str, ClassInfo] = {}     # "module:Class" -> info
+        self.class_by_name: Dict[str, List[ClassInfo]] = {}
+        self.funcs: List[FuncInfo] = []
+        self.by_name: Dict[str, List[FuncInfo]] = {}
+        self.module_funcs: Dict[Tuple[str, str], List[FuncInfo]] = {}
+        #: module-level locks: (module, name) -> LockDef
+        self.module_locks: Dict[Tuple[str, str], LockDef] = {}
+        #: module-level instances: (module, name) -> class key
+        self.module_instances: Dict[Tuple[str, str], str] = {}
+        #: module-level queue names (fallback queue receiver detection)
+        self.queue_names: Set[str] = {"_queue", "_results", "q", "queue"}
+        self.properties: Dict[str, List[FuncInfo]] = {}
+        for rel, mod in ctx.modules.items():
+            self._index_module(rel, mod)
+        for fi in self.funcs:
+            self.by_name.setdefault(fi.name, []).append(fi)
+            if fi.is_property:
+                self.properties.setdefault(fi.name, []).append(fi)
+        self._seed_attr_types()
+        #: per-function local-variable type table, computed lazily
+        self._local_types: Dict[Tuple, Dict[str, str]] = {}
+
+    # ------------------------------------------------------------------
+    # indexing
+    # ------------------------------------------------------------------
+    def _index_module(self, rel: str, mod: ModuleInfo) -> None:
+        # module-level assignments: locks, queues, instances
+        for node in mod.tree.body:
+            targets, value = [], None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if not isinstance(value, ast.Call):
+                continue
+            ctor = dotted_name(value.func) or ""
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if ctor in _LOCK_CTORS:
+                    d = LockDef(f"{rel}:{t.id}", _LOCK_CTORS[ctor])
+                    self.module_locks[(rel, t.id)] = d
+                elif ctor in _QUEUE_CTORS:
+                    self.queue_names.add(t.id)
+                else:
+                    cname = _ctor_class_name(value)
+                    if cname is not None:
+                        self.module_instances[(rel, t.id)] = cname
+        self._index_body(rel, mod.tree, None, None)
+
+    def _index_body(self, rel: str, node: ast.AST, cls: Optional[ClassInfo],
+                    parent: Optional[FuncInfo]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                ci = ClassInfo(rel, child.name, child,
+                               bases=[dotted_name(b) or "" for b in
+                                      child.bases])
+                self.classes[ci.key] = ci
+                self.class_by_name.setdefault(child.name, []).append(ci)
+                self._index_body(rel, child, ci, parent)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                is_prop = any(
+                    (dotted_name(d) or "") in ("property",
+                                               "functools.cached_property",
+                                               "cached_property")
+                    for d in child.decorator_list)
+                fi = FuncInfo(rel, cls.name if cls else None, child,
+                              parent=parent, is_property=is_prop)
+                if parent is not None:
+                    parent.children.append(fi)
+                self.funcs.append(fi)
+                self.module_funcs.setdefault((rel, child.name),
+                                             []).append(fi)
+                if cls is not None and child.name not in cls.methods:
+                    cls.methods[child.name] = fi
+                self._index_body(rel, child, cls, fi)
+            else:
+                self._index_body(rel, child, cls, parent)
+
+    def _seed_attr_types(self) -> None:
+        """Fill per-class attribute tables from ``self.X = ...`` sites."""
+        for fi in self.funcs:
+            if fi.cls is None:
+                continue
+            ci = self.classes.get(f"{fi.module}:{fi.cls}")
+            if ci is None:
+                continue
+            for stmt in ast.walk(fi.node):
+                targets, value, ann = [], None, None
+                if isinstance(stmt, ast.Assign):
+                    targets, value = stmt.targets, stmt.value
+                elif isinstance(stmt, ast.AnnAssign):
+                    targets, value, ann = [stmt.target], stmt.value, \
+                        stmt.annotation
+                else:
+                    continue
+                for t in targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    attr = t.attr
+                    ctor = dotted_name(value.func) or "" \
+                        if isinstance(value, ast.Call) else ""
+                    if ctor in _LOCK_CTORS:
+                        ci.locks[attr] = LockDef(
+                            f"{fi.module}:{fi.cls}.{attr}",
+                            _LOCK_CTORS[ctor])
+                        continue
+                    if ctor in _QUEUE_CTORS:
+                        ci.queue_attrs.add(attr)
+                        continue
+                    if ctor in _EVENT_CTORS:
+                        ci.event_attrs.add(attr)
+                        continue
+                    cname = _ctor_class_name(value) if value is not None \
+                        else None
+                    if cname is None and ann is not None:
+                        cname = _annotation_class_name(ann)
+                    if cname is not None and attr not in ci.attr_types:
+                        ck = self._class_key_for(fi.module, cname)
+                        if ck is not None:
+                            ci.attr_types[attr] = ck
+                        continue
+                    ecname = _elem_ctor_class_name(value) \
+                        if value is not None else None
+                    if ecname is not None \
+                            and attr not in ci.attr_elem_types:
+                        ck = self._class_key_for(fi.module, ecname)
+                        if ck is not None:
+                            ci.attr_elem_types[attr] = ck
+
+    def _class_key_for(self, module: str, cname: str) -> Optional[str]:
+        """Resolve a class *name* seen in ``module`` to a class key:
+        same module first, then imports, then unique-across-tree."""
+        ci = self.classes.get(f"{module}:{cname}")
+        if ci is not None:
+            return ci.key
+        imp = self.scopes[module].imported.get(cname)
+        if imp is not None:
+            ci = self.classes.get(f"{imp[0]}:{imp[1]}")
+            if ci is not None:
+                return ci.key
+        cands = self.class_by_name.get(cname, [])
+        if len(cands) == 1:
+            return cands[0].key
+        return None
+
+    # ------------------------------------------------------------------
+    # local-variable typing
+    # ------------------------------------------------------------------
+    def local_types(self, fi: FuncInfo) -> Dict[str, str]:
+        """Variable name -> class key for this function's locals (one
+        linear prepass; last assignment wins, which is good enough for
+        the idioms this tree uses)."""
+        cached = self._local_types.get(fi.key)
+        if cached is not None:
+            return cached
+        out: Dict[str, str] = {}
+        ci = self.classes.get(f"{fi.module}:{fi.cls}") if fi.cls else None
+        # parameter annotations
+        a = fi.node.args
+        for p in (a.posonlyargs + a.args + a.kwonlyargs):
+            if p.annotation is not None:
+                cname = _annotation_class_name(p.annotation)
+                if cname:
+                    ck = self._class_key_for(fi.module, cname)
+                    if ck is not None:
+                        out[p.arg] = ck
+
+        def type_of_expr(value: ast.AST) -> Optional[str]:
+            cname = _ctor_class_name(value)
+            if cname is not None:
+                return self._class_key_for(fi.module, cname)
+            # x = self.attr  (typed attribute)
+            if isinstance(value, ast.Attribute) \
+                    and isinstance(value.value, ast.Name) \
+                    and value.value.id == "self" and ci is not None:
+                return ci.attr_types.get(value.attr)
+            # x = module_instance
+            if isinstance(value, ast.Name):
+                inst = self.module_instances.get((fi.module, value.id))
+                if inst is not None:
+                    return self._class_key_for(fi.module, inst)
+                return out.get(value.id)
+            # x = getattr(obj, "attr")
+            if isinstance(value, ast.Call) \
+                    and call_name(value) == "getattr" \
+                    and len(value.args) >= 2 \
+                    and isinstance(value.args[1], ast.Constant) \
+                    and isinstance(value.args[1].value, str):
+                base = value.args[0]
+                bci = self.receiver_class(fi, base, out)
+                if bci is not None:
+                    return bci.attr_types.get(value.args[1].value)
+            return None
+
+        def elem_type_of_expr(value: ast.AST) -> Optional[str]:
+            if isinstance(value, ast.Attribute) \
+                    and isinstance(value.value, ast.Name) \
+                    and value.value.id == "self" and ci is not None:
+                return ci.attr_elem_types.get(value.attr)
+            ecname = _elem_ctor_class_name(value)
+            if ecname is not None:
+                return self._class_key_for(fi.module, ecname)
+            return None
+
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                ck = type_of_expr(node.value)
+                if ck is not None:
+                    out[node.targets[0].id] = ck
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name):
+                cname = _annotation_class_name(node.annotation)
+                ck = self._class_key_for(fi.module, cname) if cname else None
+                if ck is None and node.value is not None:
+                    ck = type_of_expr(node.value)
+                if ck is not None:
+                    out[node.target.id] = ck
+            elif isinstance(node, ast.For) \
+                    and isinstance(node.target, ast.Name):
+                ck = elem_type_of_expr(node.iter)
+                if ck is not None:
+                    out[node.target.id] = ck
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.GeneratorExp, ast.DictComp)):
+                for gen in node.generators:
+                    if isinstance(gen.target, ast.Name):
+                        ck = elem_type_of_expr(gen.iter)
+                        if ck is not None:
+                            out[gen.target.id] = ck
+        self._local_types[fi.key] = out
+        return out
+
+    # ------------------------------------------------------------------
+    # receiver / call / lock resolution
+    # ------------------------------------------------------------------
+    def receiver_class(self, fi: FuncInfo, expr: ast.AST,
+                       locals_tab: Optional[Dict[str, str]] = None
+                       ) -> Optional[ClassInfo]:
+        """Class of the *value* of ``expr`` inside ``fi``, or None."""
+        if locals_tab is None:
+            locals_tab = self.local_types(fi)
+        if isinstance(expr, ast.Name):
+            if expr.id in ("self", "cls") and fi.cls is not None:
+                return self.classes.get(f"{fi.module}:{fi.cls}")
+            ck = locals_tab.get(expr.id)
+            if ck is not None:
+                return self.classes.get(ck)
+            inst = self.module_instances.get((fi.module, expr.id))
+            if inst is not None:
+                ck = self._class_key_for(fi.module, inst)
+                return self.classes.get(ck) if ck else None
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = self.receiver_class(fi, expr.value, locals_tab)
+            if base is not None:
+                ck = base.attr_types.get(expr.attr)
+                if ck is not None:
+                    return self.classes.get(ck)
+            return None
+        return None
+
+    def _method_on(self, ci: ClassInfo, name: str,
+                   seen: Optional[Set[str]] = None) -> Optional[FuncInfo]:
+        """Method lookup walking analyzed base classes."""
+        if seen is None:
+            seen = set()
+        if ci.key in seen:
+            return None
+        seen.add(ci.key)
+        m = ci.methods.get(name)
+        if m is not None:
+            return m
+        for base in ci.bases:
+            bname = (base or "").split(".")[-1]
+            bk = self._class_key_for(ci.module, bname) if bname else None
+            bci = self.classes.get(bk) if bk else None
+            if bci is not None:
+                m = self._method_on(bci, name, seen)
+                if m is not None:
+                    return m
+        return None
+
+    def resolve_local(self, fi: FuncInfo, name: str) -> Optional[FuncInfo]:
+        """A bare name: lexically enclosing defs, then module scope."""
+        scope: Optional[FuncInfo] = fi
+        while scope is not None:
+            for child in scope.children:
+                if child.node.name == name:
+                    return child
+            scope = scope.parent
+        cands = self.module_funcs.get((fi.module, name))
+        return cands[0] if cands else None
+
+    def resolve_call(self, fi: FuncInfo, call: ast.Call,
+                     allow_fallback: bool = True) -> List[Resolution]:
+        """Targets of ``call`` made inside ``fi``, with confidence."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            # constructor?
+            ck = self._class_key_for(fi.module, func.id) \
+                if func.id[:1].isupper() else None
+            if ck is not None:
+                ci = self.classes.get(ck)
+                init = self._method_on(ci, "__init__") if ci else None
+                return [Resolution(init, HIGH)] if init else []
+            target = self.resolve_local(fi, func.id)
+            if target is not None:
+                return [Resolution(target, HIGH)]
+            imp = self.scopes[fi.module].imported.get(func.id)
+            if imp is not None:
+                cands = self.module_funcs.get(imp)
+                if cands:
+                    return [Resolution(c, HIGH) for c in cands]
+            return []
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            # module alias: vits.infer(...)
+            if isinstance(base, ast.Name):
+                alias = self.scopes[fi.module].module_aliases.get(base.id)
+                if alias is not None:
+                    cands = self.module_funcs.get((alias, func.attr))
+                    if cands:
+                        return [Resolution(c, HIGH) for c in cands]
+            ci = self.receiver_class(fi, base)
+            if ci is not None:
+                m = self._method_on(ci, func.attr)
+                return [Resolution(m, HIGH)] if m is not None else []
+            # typed-constructor attribute call:  C(...).m()
+            cname = _ctor_class_name(base)
+            if cname is not None:
+                ck = self._class_key_for(fi.module, cname)
+                ci = self.classes.get(ck) if ck else None
+                if ci is not None:
+                    m = self._method_on(ci, func.attr)
+                    return [Resolution(m, HIGH)] if m is not None else []
+            if not allow_fallback or func.attr in GENERIC_NAMES:
+                return []
+            # LOW: the old bare-name fallback, for unresolvable receivers
+            return [Resolution(f, LOW)
+                    for f in self.by_name.get(func.attr, ())]
+        return []
+
+    def resolve_lock(self, fi: FuncInfo, expr: ast.AST) -> Optional[LockDef]:
+        """The lock a ``with``-item / ``.acquire()`` receiver denotes."""
+        name = dotted_name(expr)
+        if name is None:
+            return None
+        parts = name.split(".")
+        attr = parts[-1]
+        # typed receiver (self._lock, self._pool._lock, node._lock, ...)
+        if isinstance(expr, ast.Attribute):
+            ci = self.receiver_class(fi, expr.value)
+            if ci is not None:
+                d = ci.locks.get(attr)
+                if d is not None:
+                    return d
+                # a typed receiver without that lock attribute is not a
+                # lock we know — fall through to the heuristics below
+        if len(parts) == 1:
+            d = self.module_locks.get((fi.module, attr))
+            if d is not None:
+                return d
+            # local lock-ish names (LoadVoice's per-voice load_lock)
+            if "lock" in attr.lower():
+                return LockDef(f"{fi.module}:{fi.name}.<local>{attr}")
+            return None
+        # untyped receiver: same-class attr lock (self.X handled above,
+        # but 'self' may be untracked for module funcs) then unique
+        # attr-name match across analyzed classes
+        if parts[0] in ("self", "cls") and fi.cls is not None:
+            ci = self.classes.get(f"{fi.module}:{fi.cls}")
+            if ci is not None and attr in ci.locks:
+                return ci.locks[attr]
+        defs = [c.locks[attr] for c in self.classes.values()
+                if attr in c.locks]
+        if defs:
+            if len(defs) == 1:
+                return defs[0]
+            return LockDef(f"*.{attr}", all(d.reentrant for d in defs))
+        # unresolvable but lock-ish attribute: give it a function-local
+        # identity so an inner `with x.foo_lock:` still opens its OWN
+        # block (allowlist block=true on an outer lock must not cover it)
+        if "lock" in attr.lower():
+            return LockDef(f"{fi.module}:{fi.name}.<unresolved>{attr}")
+        return None
+
+    def is_queue(self, fi: FuncInfo, expr: ast.AST) -> bool:
+        """Does ``expr`` denote a queue (for get/put blocking rules)?"""
+        if isinstance(expr, ast.Attribute):
+            ci = self.receiver_class(fi, expr.value)
+            if ci is not None and expr.attr in ci.queue_attrs:
+                return True
+        name = dotted_name(expr)
+        if name is None:
+            return False
+        last = name.split(".")[-1]
+        if last in self.queue_names:
+            return True
+        return any(last in c.queue_attrs for c in self.classes.values())
+
+
+def for_context(ctx: AnalysisContext) -> CallGraph:
+    """The shared, memoized CallGraph for one analysis context (built
+    once, reused by every pass in the run)."""
+    cg = getattr(ctx, "_callgraph", None)
+    if cg is None:
+        cg = CallGraph(ctx)
+        ctx._callgraph = cg
+    return cg
+
+
+# ---------------------------------------------------------------------------
+# shared blocking/acquisition summaries
+# ---------------------------------------------------------------------------
+
+#: callables that can block regardless of receiver
+ALWAYS_BLOCKING = {
+    "sleep": "time.sleep",
+    "speak_batch": "device dispatch (speak_batch)",
+    "device_get": "device→host sync (jax.device_get)",
+    "block_until_ready": "device sync (block_until_ready)",
+    "device_put": "host→device transfer (jax.device_put)",
+    "result": "Future.result (waits for a worker/device)",
+    "open": "file I/O",
+}
+
+#: repo-specific names known to block (seeded; summaries propagate them)
+KNOWN_BLOCKING = {
+    "resolve_policy": "dispatch-policy resolution may run a device probe",
+    "from_config_path": "voice load: file I/O + weight import",
+    "capture_profile": "profiler capture sleeps for the capture window",
+}
+
+
+def walk_own(fn: ast.AST):
+    """Walk a function's AST excluding nested function subtrees — a
+    nested callback's facts belong to ITS summary, not its definer's."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def has_timeout(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "timeout" and not (
+                isinstance(kw.value, ast.Constant)
+                and kw.value.value is None):
+            return True
+    return False
+
+
+def kw_false(call: ast.Call, name: str) -> bool:
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    return False
+
+
+def direct_block_reason(cg: CallGraph, fi: FuncInfo,
+                        call: ast.Call) -> Optional[str]:
+    """Reason this single call can block, by the generic rules."""
+    name = call_name(call)
+    if name is None:
+        return None
+    dotted = dotted_name(call.func) or name
+    if name == "sleep" and (dotted.startswith("time.") or dotted == "sleep"):
+        return ALWAYS_BLOCKING["sleep"]
+    if name in ("speak_batch", "device_get", "block_until_ready",
+                "device_put"):
+        return ALWAYS_BLOCKING[name]
+    if name == "result":
+        return ALWAYS_BLOCKING["result"]
+    if name == "open" and isinstance(call.func, ast.Name):
+        return ALWAYS_BLOCKING["open"]
+    if dotted.startswith("subprocess."):
+        return f"subprocess call ({dotted})"
+    if name == "join":
+        recv = call.func.value if isinstance(call.func, ast.Attribute) \
+            else None
+        if recv is not None and not isinstance(recv, ast.Constant):
+            return "join (thread/process wait)"
+    if name == "wait" and not has_timeout(call) and not call.args:
+        return "wait without timeout"
+    if name in ("get", "put"):
+        if isinstance(call.func, ast.Attribute) \
+                and cg.is_queue(fi, call.func.value) \
+                and not has_timeout(call):
+            return f"queue.{name} without timeout"
+    if name == "acquire" and not kw_false(call, "blocking"):
+        recv = call.func.value if isinstance(call.func, ast.Attribute) \
+            else None
+        if recv is not None and dotted_name(recv) \
+                and "lock" in (dotted_name(recv) or "").lower():
+            return "blocking lock acquire"
+    if name in KNOWN_BLOCKING:
+        return KNOWN_BLOCKING[name]
+    return None
+
+
+def _degrade(a: str, b: str) -> str:
+    return HIGH if a == HIGH and b == HIGH else LOW
+
+
+def build_summaries(cg: CallGraph) -> None:
+    """Per-function (blocks, acquires) to a fixpoint, memoized on the
+    graph.  ``acquires`` carries per-lock confidence: HIGH only when
+    the whole propagation chain was receiver-typed."""
+    if getattr(cg, "_summaries_done", False):
+        return
+    cg._summaries_done = True
+
+    #: per-function resolvable call sites (resolved once, reused each
+    #: fixpoint round) and property loads
+    call_sites: Dict[Tuple, List[Resolution]] = {}
+    for fi in cg.funcs:
+        sites: List[Resolution] = []
+        prop_names: Set[str] = set()
+        for node in walk_own(fi.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        continue
+                    d = cg.resolve_lock(fi, item.context_expr)
+                    if d is not None:
+                        fi.acquires.setdefault(d.lock_id, HIGH)
+                # yields under this with's locks feed the yieldlock pass
+                self_locks = [
+                    cg.resolve_lock(fi, it.context_expr)
+                    for it in node.items
+                    if not isinstance(it.context_expr, ast.Call)]
+                self_locks = [d for d in self_locks if d is not None]
+                if self_locks:
+                    for sub in walk_own(node):
+                        if isinstance(sub, (ast.Yield, ast.YieldFrom)):
+                            for d in self_locks:
+                                fi.lock_yields.append(
+                                    (d.lock_id, sub.lineno, node.lineno))
+            if isinstance(node, ast.Call):
+                reason = direct_block_reason(cg, fi, node)
+                if reason is not None and fi.blocks is None:
+                    fi.blocks = reason
+                if call_name(node) == "acquire" \
+                        and isinstance(node.func, ast.Attribute):
+                    d = cg.resolve_lock(fi, node.func.value)
+                    if d is not None:
+                        fi.acquires.setdefault(d.lock_id, HIGH)
+                sites.extend(cg.resolve_call(fi, node))
+                # getattr(x, "prop") is an attribute load in disguise
+                if call_name(node) == "getattr" and len(node.args) >= 2 \
+                        and isinstance(node.args[1], ast.Constant) \
+                        and isinstance(node.args[1].value, str):
+                    prop_names.add(node.args[1].value)
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load):
+                prop_names.add(node.attr)
+        for pname in sorted(prop_names):
+            for p in cg.properties.get(pname, ()):
+                sites.append(Resolution(p, LOW))
+        # deterministic order: the first blocking callee becomes the
+        # diagnostic's witness chain and must not churn between runs
+        sites.sort(key=lambda r: (r.func.module, r.func.node.lineno,
+                                  r.confidence))
+        call_sites[fi.key] = sites
+
+    changed = True
+    rounds = 0
+    while changed and rounds < 30:
+        changed = False
+        rounds += 1
+        for fi in cg.funcs:
+            for res in call_sites[fi.key]:
+                callee = res.func
+                if callee is fi:
+                    continue
+                if callee.blocks is not None and fi.blocks is None:
+                    fi.blocks = (f"calls {callee.name}() which can block "
+                                 f"({callee.blocks})")
+                    changed = True
+                for lock_id, conf in callee.acquires.items():
+                    eff = _degrade(conf, res.confidence)
+                    cur = fi.acquires.get(lock_id)
+                    if cur is None or (cur == LOW and eff == HIGH):
+                        fi.acquires[lock_id] = eff
+                        changed = True
+    cg._call_sites = call_sites
+
+
+def graph_with_summaries(ctx: AnalysisContext) -> CallGraph:
+    """The one entry point passes use: shared graph + shared summaries."""
+    cg = for_context(ctx)
+    build_summaries(cg)
+    return cg
+
+
+def scoped(modules: Dict[str, ModuleInfo],
+           prefixes: Sequence[str]) -> Dict[str, ModuleInfo]:
+    """Filter helper: fixture modules (anything outside ``sonata_tpu``)
+    are always in scope; package modules must match a prefix."""
+    return {rel: m for rel, m in modules.items()
+            if not rel.startswith("sonata_tpu")
+            or any(rel.startswith(p) for p in prefixes)}

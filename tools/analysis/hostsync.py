@@ -18,24 +18,24 @@ Two families of hazard every XLA serving system lints for:
   dispatch-scaling probe) — those are allowlisted with rationales; new
   ones must justify themselves the same way.
 
-Reachability is computed over the repo's own import graph: ``from . import
-vits`` / ``from .chunker import plan_chunks`` style imports resolve to
-analyzed modules, ``self.method`` resolves within the enclosing class.
+v2 (PR 19): the transitive reachability walk runs on the shared
+class-aware resolver (:mod:`tools.analysis.callgraph`) instead of this
+pass's private import-graph copy.  HIGH-confidence resolutions
+(receiver-typed methods, import-resolved module functions) are always
+followed; the bare-name fallback is followed only when it is
+*unambiguous* (exactly one candidate across the tree — the coalescers'
+single-letter voice aliases), so a common method name no longer drags
+unrelated classes into the traced set.
 """
 
 from __future__ import annotations
 
 import ast
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, List, Set, Tuple
 
-from .core import (
-    AnalysisContext,
-    Diagnostic,
-    ModuleInfo,
-    call_name,
-    dotted_name,
-)
+from . import callgraph
+from .callgraph import HIGH, CallGraph, FuncInfo, walk_own
+from .core import AnalysisContext, Diagnostic, call_name, dotted_name
 
 PASS_NAME = "host-sync"
 
@@ -48,180 +48,51 @@ SYNC_CALLS = {"device_get": "jax.device_get",
               "item": ".item()"}
 
 
-@dataclass
-class _Func:
-    module: str
-    cls: Optional[str]
-    node: ast.FunctionDef
-    parent: Optional["_Func"] = None  # lexical parent function
-    children: List["_Func"] = field(default_factory=list)
-
-    @property
-    def key(self) -> Tuple[str, Optional[str], str, int]:
-        return (self.module, self.cls, self.node.name, self.node.lineno)
-
-    def top_level(self) -> "_Func":
-        f = self
-        while f.parent is not None:
-            f = f.parent
-        return f
+def _followed_targets(cg: CallGraph, f: FuncInfo,
+                      call: ast.Call) -> List[FuncInfo]:
+    """Call targets the reachability walk follows: every HIGH
+    resolution, plus an unambiguous (single-candidate) LOW one."""
+    res = cg.resolve_call(f, call)
+    high = [r.func for r in res if r.confidence == HIGH]
+    if high:
+        return high
+    low = [r.func for r in res]
+    return low if len(low) == 1 else []
 
 
-class _ModuleScope:
-    """Name-resolution tables for one module."""
+def _find_jit_roots(cg: CallGraph) -> List[FuncInfo]:
+    roots: List[FuncInfo] = []
+    marked: Set[Tuple] = set()
 
-    def __init__(self, rel: str, mod: ModuleInfo,
-                 all_modules: Dict[str, ModuleInfo]):
-        self.rel = rel
-        self.mod = mod
-        #: local alias -> module relpath ("vits" -> sonata_tpu/models/vits.py)
-        self.module_aliases: Dict[str, str] = {}
-        #: imported name -> (module relpath, name)
-        self.imported: Dict[str, Tuple[str, str]] = {}
-        pkg_parts = rel.split("/")[:-1]  # directory parts
-        for node in ast.walk(mod.tree):
-            if isinstance(node, ast.ImportFrom) and node.level > 0:
-                base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
-                target = base + (node.module.split(".") if node.module
-                                 else [])
-                for alias in node.names:
-                    name = alias.asname or alias.name
-                    as_module = "/".join(target + [alias.name]) + ".py"
-                    as_member = "/".join(target) + ".py"
-                    if as_module in all_modules:
-                        self.module_aliases[name] = as_module
-                    elif as_member in all_modules:
-                        self.imported[name] = (as_member, alias.name)
-                    else:
-                        pkg_init = "/".join(target + [alias.name,
-                                                      "__init__.py"])
-                        if pkg_init in all_modules:
-                            self.module_aliases[name] = pkg_init
+    def mark(f: FuncInfo) -> None:
+        if f.key not in marked:
+            marked.add(f.key)
+            roots.append(f)
 
-
-class _Graph:
-    """Function index + call resolution over the analyzed set."""
-
-    def __init__(self, ctx: AnalysisContext):
-        self.modules = ctx.modules
-        self.scopes = {rel: _ModuleScope(rel, m, ctx.modules)
-                       for rel, m in ctx.modules.items()}
-        self.funcs: List[_Func] = []
-        #: (module, name) -> funcs;  (module, cls, name) -> func
-        self.module_funcs: Dict[Tuple[str, str], List[_Func]] = {}
-        self.class_methods: Dict[Tuple[str, str, str], _Func] = {}
-        for rel, mod in ctx.modules.items():
-            self._index(rel, mod.tree, None, None)
-        self.jit_roots: List[_Func] = []
-        self._find_jit_roots()
-
-    def _index(self, rel: str, node: ast.AST, cls: Optional[str],
-               parent: Optional[_Func]) -> None:
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, ast.ClassDef):
-                self._index(rel, child, child.name, parent)
-            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                f = _Func(rel, cls, child, parent=parent)
-                if parent is not None:
-                    parent.children.append(f)
-                self.funcs.append(f)
-                self.module_funcs.setdefault((rel, child.name),
-                                             []).append(f)
-                if cls is not None:
-                    self.class_methods.setdefault((rel, cls, child.name), f)
-                self._index(rel, child, cls, f)
-            else:
-                self._index(rel, child, cls, parent)
-
-    # -- jit roots -----------------------------------------------------------
-    def _find_jit_roots(self) -> None:
-        marked: Set[Tuple] = set()
-
-        def mark(f: _Func) -> None:
-            if f.key not in marked:
-                marked.add(f.key)
-                self.jit_roots.append(f)
-
-        for f in self.funcs:
-            for deco in f.node.decorator_list:
-                d = deco.func if isinstance(deco, ast.Call) else deco
-                name = dotted_name(d) or ""
-                if name.endswith("jit") or (
-                        isinstance(deco, ast.Call)
-                        and any((dotted_name(a) or "").endswith("jit")
-                                for a in deco.args)):
-                    mark(f)
-        # jax.jit(fn, ...) / self._jit(fn, ...) call forms: the first arg
-        # names a function defined in the same lexical scope
-        for f in self.funcs:
-            for node in ast.walk(f.node):
-                if not isinstance(node, ast.Call) or not node.args:
-                    continue
-                cname = dotted_name(node.func) or (call_name(node) or "")
-                if not (cname.endswith("jit") or cname.endswith("_jit")):
-                    continue
-                arg = node.args[0]
-                if isinstance(arg, ast.Name):
-                    target = self._resolve_local(f, arg.id)
-                    if target is not None:
-                        mark(target)
-
-    def _resolve_local(self, f: _Func, name: str) -> Optional[_Func]:
-        scope: Optional[_Func] = f
-        while scope is not None:
-            for child in scope.children:
-                if child.node.name == name:
-                    return child
-            scope = scope.parent
-        cands = self.module_funcs.get((f.module, name))
-        return cands[0] if cands else None
-
-    # -- call resolution -----------------------------------------------------
-    def resolve_call(self, f: _Func, call: ast.Call) -> List[_Func]:
-        func = call.func
-        out: List[_Func] = []
-        if isinstance(func, ast.Name):
-            target = self._resolve_local(f, func.id)
-            if target is not None:
-                return [target]
-            imp = self.scopes[f.module].imported.get(func.id)
-            if imp is not None:
-                cands = self.module_funcs.get(imp)
-                if cands:
-                    return list(cands)
-            return out
-        if isinstance(func, ast.Attribute):
-            base = func.value
-            if isinstance(base, ast.Name):
-                if base.id in ("self", "cls") and f.cls is not None:
-                    m = self.class_methods.get((f.module, f.cls, func.attr))
-                    if m is not None:
-                        return [m]
-                    return out
-                alias = self.scopes[f.module].module_aliases.get(base.id)
-                if alias is not None:
-                    cands = self.module_funcs.get((alias, func.attr))
-                    if cands:
-                        return list(cands)
-            # single-letter voice aliases (the coalescers' ``v._pad_batch``)
-            # resolve by unique method name across analyzed classes
-            cands = [fn for (mod, _c, name), fn in self.class_methods.items()
-                     if name == func.attr]
-            if len(cands) == 1:
-                return cands
-        return out
-
-
-def _walk_own(fn: ast.FunctionDef):
-    """Walk a function's AST excluding nested function subtrees (those
-    have their own ``_Func`` and are analyzed separately)."""
-    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
-    while stack:
-        node = stack.pop()
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        yield node
-        stack.extend(ast.iter_child_nodes(node))
+    for f in cg.funcs:
+        for deco in f.node.decorator_list:
+            d = deco.func if isinstance(deco, ast.Call) else deco
+            name = dotted_name(d) or ""
+            if name.endswith("jit") or (
+                    isinstance(deco, ast.Call)
+                    and any((dotted_name(a) or "").endswith("jit")
+                            for a in deco.args)):
+                mark(f)
+    # jax.jit(fn, ...) / self._jit(fn, ...) call forms: the first arg
+    # names a function defined in the same lexical scope
+    for f in cg.funcs:
+        for node in ast.walk(f.node):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            cname = dotted_name(node.func) or (call_name(node) or "")
+            if not (cname.endswith("jit") or cname.endswith("_jit")):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Name):
+                target = cg.resolve_local(f, arg.id)
+                if target is not None:
+                    mark(target)
+    return roots
 
 
 def _param_names(fn: ast.FunctionDef) -> Set[str]:
@@ -238,12 +109,12 @@ def _names_in(node: ast.AST) -> Set[str]:
     return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
 
 
-def _check_traced_function(f: _Func, diags: List[Diagnostic],
-                           root: _Func) -> None:
+def _check_traced_function(f: FuncInfo, diags: List[Diagnostic],
+                           root: FuncInfo) -> None:
     """Flags inside jit-traced code."""
     params = _param_names(f.node)
     top = f.top_level().node.lineno
-    for node in _walk_own(f.node):
+    for node in walk_own(f.node):
         if isinstance(node, ast.Call):
             cname = call_name(node)
             dotted = dotted_name(node.func) or cname or ""
@@ -295,49 +166,48 @@ def _check_traced_function(f: _Func, diags: List[Diagnostic],
                     block_line=top))
 
 
-def _jit_factories(graph: _Graph) -> Set[str]:
+def _jit_factories(cg: CallGraph) -> Set[str]:
     """Names of functions that build and return jitted executables
     (``_full_fn``-style caches: body contains a ``*jit`` call and a
     ``return``) — calling one and then calling its result is a device
     dispatch."""
     out: Set[str] = set()
-    for f in graph.funcs:
+    for f in cg.funcs:
         has_jit = any(
             isinstance(n, ast.Call)
             and ((dotted_name(n.func) or call_name(n) or "")
                  .endswith(("jit", "_jit")))
-            for n in _walk_own(f.node))
+            for n in walk_own(f.node))
         has_return = any(isinstance(n, ast.Return) and n.value is not None
-                         for n in _walk_own(f.node))
+                         for n in walk_own(f.node))
         if has_jit and has_return:
             out.add(f.node.name)
     return out
 
 
-def _is_dispatch_site_fn(graph: _Graph, f: _Func,
-                         factories: Set[str]) -> bool:
+def _is_dispatch_site_fn(cg: CallGraph, f: FuncInfo, factories: Set[str],
+                         root_keys: Set[Tuple]) -> bool:
     """Does this function call a jitted executable?
 
     The jit-factory idiom (``self._full_fn(b, t, f)(*args)`` — a call
     whose callee is itself a call, or a call to a known factory whose
     result is invoked later) or a direct call to a known jit-root.
     """
-    root_keys = {(r.module, r.node.name) for r in graph.jit_roots}
-    for node in _walk_own(f.node):
+    for node in walk_own(f.node):
         if isinstance(node, ast.Call):
             if isinstance(node.func, ast.Call):
                 return True
             if (call_name(node) or "") in factories:
                 return True
-            for target in graph.resolve_call(f, node):
+            for target in _followed_targets(cg, f, node):
                 if (target.module, target.node.name) in root_keys:
                     return True
     return False
 
 
-def _check_dispatch_path(f: _Func, diags: List[Diagnostic]) -> None:
+def _check_dispatch_path(f: FuncInfo, diags: List[Diagnostic]) -> None:
     top = f.top_level().node.lineno
-    for node in _walk_own(f.node):
+    for node in walk_own(f.node):
         if isinstance(node, ast.Call):
             cname = call_name(node)
             if cname in SYNC_CALLS:
@@ -351,13 +221,14 @@ def _check_dispatch_path(f: _Func, diags: List[Diagnostic]) -> None:
 
 
 def run(ctx: AnalysisContext) -> List[Diagnostic]:
-    graph = _Graph(ctx)
+    cg = callgraph.graph_with_summaries(ctx)
     diags: List[Diagnostic] = []
+    jit_roots = _find_jit_roots(cg)
 
-    # 1. everything reachable from a jit root, through repo-resolvable
-    # calls, is traced code
+    # 1. everything reachable from a jit root, through followed
+    # resolutions, is traced code
     visited: Set[Tuple] = set()
-    stack: List[Tuple[_Func, _Func]] = [(r, r) for r in graph.jit_roots]
+    stack: List[Tuple[FuncInfo, FuncInfo]] = [(r, r) for r in jit_roots]
     while stack:
         f, root = stack.pop()
         if f.key in visited:
@@ -366,18 +237,19 @@ def run(ctx: AnalysisContext) -> List[Diagnostic]:
         _check_traced_function(f, diags, root)
         for node in ast.walk(f.node):
             if isinstance(node, ast.Call):
-                for target in graph.resolve_call(f, node):
+                for target in _followed_targets(cg, f, node):
                     if target.key not in visited:
                         stack.append((target, root))
 
     # 2. host functions that dispatch jitted executables
-    factories = _jit_factories(graph)
-    for f in graph.funcs:
+    factories = _jit_factories(cg)
+    root_keys = {(r.module, r.node.name) for r in jit_roots}
+    for f in cg.funcs:
         if f.key in visited:
             continue  # traced code already covered (stricter rules)
         if f.node.name in factories:
             continue  # building the executable is not dispatching it
-        if _is_dispatch_site_fn(graph, f, factories):
+        if _is_dispatch_site_fn(cg, f, factories, root_keys):
             _check_dispatch_path(f, diags)
 
     unique: Dict[Tuple, Diagnostic] = {}

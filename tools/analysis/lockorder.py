@@ -6,26 +6,31 @@ trace-JSONL appends under the ring lock) were all instances of two
 patterns this pass machine-checks:
 
 - **lock-order inversion**: thread 1 holds A and wants B while thread 2
-  holds B and wants A.  The pass extracts every lock the tree constructs
-  (``threading.Lock()`` / ``RLock()`` attributes and module globals),
-  records an edge A → B whenever code acquires B while holding A
-  (directly nested ``with``, or via a call whose transitive summary
-  acquires B), and fails on any cycle in that graph.
+  holds B and wants A.  The pass records an edge A → B whenever code
+  acquires B while holding A (directly nested ``with``, or via a call
+  whose transitive summary acquires B) and fails on any cycle.
 - **blocking while locked**: a call that can block — ``queue.put/get``
   without a timeout, ``Future.result``, ``Thread.join``, ``Event.wait``
-  without a timeout, ``time.sleep``, file ``open``, and device work
-  (``speak_batch``, ``jax.device_get``, ``block_until_ready``,
-  ``device_put``, dispatch-policy resolution) — made while a lock is
-  held.  A blocked holder stalls every thread contending for that lock;
-  in this tree that has meant /metrics scrapes stalled behind disk
-  appends and pool routing stalled behind scheduler construction.
+  without a timeout, ``time.sleep``, file ``open``, and device work —
+  made while a lock is held.
 
-Interprocedural model: call resolution is *name-based* over the analyzed
-set (``x.close()`` blocks if any analyzed ``close`` blocks), with a
-conservative exclusion list for generic names that would otherwise alias
-dict/str methods.  Summaries (``blocks``, ``acquires``) propagate to a
-fixpoint, so a lock held around ``_Voice(...)`` sees the scheduler
-construction → dispatch-policy → device-probe chain behind it.
+v2 (PR 19): resolution runs on :mod:`tools.analysis.callgraph` — the
+class-aware, type-seeded resolver — instead of bare names.  Locks have
+class-qualified identities (``module:Class.attr``), method calls
+resolve through receiver types, and the bare-name fallback survives
+only as a LOW-confidence last resort that this pass *downgrades*:
+
+- LOW resolutions still propagate **can-block** facts (missing a
+  blocked hold is worse than an occasional duplicate), but
+- lock-acquisition **edges are HIGH-confidence only** — a LOW edge is
+  exactly the same-name-implies-same-lock false-cycle class that
+  forced the PR 12/17 defensive renames (``mesh_view``, ``debug_doc``)
+  this release reverts.
+
+``block_line`` anchors the ``with`` statement of the *innermost* held
+lock, so an allowlist ``block = true`` entry on an outer lock never
+silently covers findings under a distinct inner one (locks that fail
+to resolve still open their own anonymous block).
 
 Intentional holds are suppressed in ``allowlist.toml``; each entry
 carries a rationale and a line anchor that breaks loudly when the code
@@ -35,17 +40,19 @@ moves.
 from __future__ import annotations
 
 import ast
-from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
-from .core import (
-    AnalysisContext,
-    Diagnostic,
-    ModuleInfo,
-    call_name,
-    dotted_name,
-    walk_functions,
+from . import callgraph
+from .callgraph import (
+    HIGH,
+    CallGraph,
+    FuncInfo,
+    LockDef,
+    Resolution,
+    direct_block_reason,
+    walk_own,
 )
+from .core import AnalysisContext, Diagnostic, call_name
 
 PASS_NAME = "lock-order"
 
@@ -60,340 +67,17 @@ SCOPE_PREFIXES = (
     "sonata_tpu/utils/dispatch_policy.py",
 )
 
-#: callables that can block regardless of receiver
-ALWAYS_BLOCKING = {
-    "sleep": "time.sleep",
-    "speak_batch": "device dispatch (speak_batch)",
-    "device_get": "device→host sync (jax.device_get)",
-    "block_until_ready": "device sync (block_until_ready)",
-    "device_put": "host→device transfer (jax.device_put)",
-    "result": "Future.result (waits for a worker/device)",
-    "open": "file I/O",
-}
 
-#: repo-specific names known to block (seeded; summaries propagate them)
-KNOWN_BLOCKING = {
-    "resolve_policy": "dispatch-policy resolution may run a device probe",
-    "from_config_path": "voice load: file I/O + weight import",
-    "capture_profile": "profiler capture sleeps for the capture window",
-}
-
-#: properties whose getters we must treat as calls when their summary
-#: blocks or acquires (attribute loads are otherwise invisible)
-TRACKED_PROPERTY_LOADS = True
-
-#: generic names never resolved through function summaries (they alias
-#: dict/str/logging methods far more often than repo functions)
-SUMMARY_EXCLUDE = {
-    "get", "put", "pop", "append", "extend", "items", "values", "keys",
-    "copy", "update", "add", "clear", "split", "strip", "join", "format",
-    "encode", "decode", "read", "write", "set", "is_set", "info", "debug",
-    "warning", "error", "exception", "inc", "observe", "labels", "remove",
-    "record", "annotate", "finish", "count", "index", "sort", "setdefault",
-    "startswith", "endswith", "lower", "upper", "group", "match", "search",
-    # Thread.start aliases the (blocking) coalescer stream-start method
-    "start",
-}
+def in_scope(rel: str) -> bool:
+    return not rel.startswith("sonata_tpu") \
+        or any(rel.startswith(p) for p in SCOPE_PREFIXES)
 
 
-def _walk_own(fn: ast.AST):
-    """Walk a function's AST excluding nested function subtrees — a
-    nested callback's blocking calls belong to ITS summary (it has its
-    own FuncInfo), not to the function that merely defines it."""
-    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
-    while stack:
-        node = stack.pop()
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        yield node
-        stack.extend(ast.iter_child_nodes(node))
-
-
-def _has_timeout(call: ast.Call) -> bool:
-    for kw in call.keywords:
-        if kw.arg == "timeout" and not (
-                isinstance(kw.value, ast.Constant) and kw.value.value is None):
-            return True
-    return False
-
-
-def _kw_false(call: ast.Call, name: str) -> bool:
-    for kw in call.keywords:
-        if kw.arg == name and isinstance(kw.value, ast.Constant) \
-                and kw.value.value is False:
-            return True
-    return False
-
-
-@dataclass
-class LockDef:
-    lock_id: str
-    reentrant: bool = False
-
-
-@dataclass
-class FuncInfo:
-    module: str
-    cls: Optional[str]
-    node: ast.FunctionDef
-    is_property: bool = False
-    #: direct + propagated
-    blocks: Optional[str] = None       # reason, or None
-    acquires: Set[str] = field(default_factory=set)
-    #: direct blocking reason before propagation (for messages)
-    calls: Set[str] = field(default_factory=set)       # resolvable names
-    prop_loads: Set[str] = field(default_factory=set)  # attribute loads
-
-    @property
-    def name(self) -> str:
-        return self.node.name
-
-
-class _Index:
-    """Locks, queues, functions, and classes across the analyzed set."""
-
-    def __init__(self, modules: Dict[str, ModuleInfo]):
-        self.locks: Dict[str, LockDef] = {}           # lock_id -> def
-        self.class_locks: Dict[Tuple[str, str], LockDef] = {}
-        self.module_locks: Dict[Tuple[str, str], LockDef] = {}
-        self.attr_locks: Dict[str, List[LockDef]] = {}  # attr -> defs
-        self.queue_attrs: Set[str] = {"_queue", "_results"}
-        self.funcs: List[FuncInfo] = []
-        self.by_name: Dict[str, List[FuncInfo]] = {}
-        self.class_init: Dict[str, FuncInfo] = {}
-        for rel, mod in modules.items():
-            self._index_module(rel, mod)
-        for fi in self.funcs:
-            self.by_name.setdefault(fi.name, []).append(fi)
-            if fi.name == "__init__" and fi.cls is not None:
-                self.class_init.setdefault(fi.cls, fi)
-
-    def _register_lock(self, rel: str, cls: Optional[str], attr: str,
-                       reentrant: bool) -> None:
-        if cls is not None:
-            lock_id = f"{rel}:{cls}.{attr}"
-            d = LockDef(lock_id, reentrant)
-            self.class_locks[(cls, attr)] = d
-        else:
-            lock_id = f"{rel}:{attr}"
-            d = LockDef(lock_id, reentrant)
-            self.module_locks[(rel, attr)] = d
-        self.locks[lock_id] = d
-        self.attr_locks.setdefault(attr, []).append(d)
-
-    def _index_module(self, rel: str, mod: ModuleInfo) -> None:
-        # module-level locks / queues
-        for node in mod.tree.body:
-            targets = []
-            value = None
-            if isinstance(node, ast.Assign):
-                targets, value = node.targets, node.value
-            elif isinstance(node, ast.AnnAssign) and node.value is not None:
-                targets, value = [node.target], node.value
-            if not isinstance(value, ast.Call):
-                continue
-            ctor = dotted_name(value.func) or ""
-            for t in targets:
-                if not isinstance(t, ast.Name):
-                    continue
-                if ctor in ("threading.Lock", "threading.RLock",
-                            "Lock", "RLock"):
-                    self._register_lock(rel, None, t.id,
-                                        ctor.endswith("RLock"))
-                elif ctor in ("queue.Queue", "Queue"):
-                    self.queue_attrs.add(t.id)
-        # class-attribute locks / queues + function index
-        for cls, fn in walk_functions(mod.tree):
-            is_prop = any(
-                (dotted_name(d) or "") in ("property", "functools.cached_property")
-                for d in fn.decorator_list)
-            self.funcs.append(FuncInfo(rel, cls, fn, is_property=is_prop))
-            for stmt in ast.walk(fn):
-                targets, value = [], None
-                if isinstance(stmt, ast.Assign):
-                    targets, value = stmt.targets, stmt.value
-                elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
-                    targets, value = [stmt.target], stmt.value
-                if not isinstance(value, ast.Call):
-                    continue
-                ctor = dotted_name(value.func) or ""
-                for t in targets:
-                    if (isinstance(t, ast.Attribute)
-                            and isinstance(t.value, ast.Name)
-                            and t.value.id == "self" and cls is not None):
-                        if ctor in ("threading.Lock", "threading.RLock",
-                                    "Lock", "RLock"):
-                            self._register_lock(rel, cls, t.attr,
-                                                ctor.endswith("RLock"))
-                        elif ctor in ("queue.Queue", "Queue"):
-                            self.queue_attrs.add(t.attr)
-
-    # -- lock resolution -----------------------------------------------------
-    def resolve_lock(self, expr: ast.AST, module: str,
-                     cls: Optional[str], func: str) -> Optional[LockDef]:
-        name = dotted_name(expr)
-        if name is None:
-            return None
-        parts = name.split(".")
-        attr = parts[-1]
-        if parts[0] == "self" and len(parts) == 2 and cls is not None:
-            d = self.class_locks.get((cls, attr))
-            if d is not None:
-                return d
-        if len(parts) == 1:
-            d = self.module_locks.get((module, attr))
-            if d is not None:
-                return d
-        # cross-class / cross-module fallback by attribute name
-        defs = self.attr_locks.get(attr)
-        if defs:
-            return defs[0] if len(defs) == 1 else LockDef(
-                f"*.{attr}", all(d.reentrant for d in defs))
-        # local lock-ish names (e.g. LoadVoice's per-voice load_lock)
-        if len(parts) == 1 and "lock" in attr.lower():
-            return LockDef(f"{module}:{func}.<local>{attr}")
-        return None
-
-    def is_queue(self, expr: ast.AST) -> bool:
-        name = dotted_name(expr)
-        if name is None:
-            return False
-        last = name.split(".")[-1]
-        return last in self.queue_attrs or last in ("q", "queue")
-
-
-def _direct_block_reason(index: _Index, call: ast.Call) -> Optional[str]:
-    """Reason this single call can block, by the generic rules."""
-    name = call_name(call)
-    if name is None:
-        return None
-    dotted = dotted_name(call.func) or name
-    if name == "sleep" and (dotted.startswith("time.") or dotted == "sleep"):
-        return ALWAYS_BLOCKING["sleep"]
-    if name in ("speak_batch", "device_get", "block_until_ready",
-                "device_put"):
-        return ALWAYS_BLOCKING[name]
-    if name == "result":
-        return ALWAYS_BLOCKING["result"]
-    if name == "open" and isinstance(call.func, ast.Name):
-        return ALWAYS_BLOCKING["open"]
-    if dotted.startswith("subprocess."):
-        return f"subprocess call ({dotted})"
-    if name == "join":
-        recv = call.func.value if isinstance(call.func, ast.Attribute) \
-            else None
-        if recv is not None and not isinstance(recv, ast.Constant):
-            return "join (thread/process wait)"
-    if name == "wait" and not _has_timeout(call) and not call.args:
-        return "wait without timeout"
-    if name in ("get", "put"):
-        if isinstance(call.func, ast.Attribute) \
-                and index.is_queue(call.func.value) \
-                and not _has_timeout(call):
-            return f"queue.{name} without timeout"
-    if name == "acquire" and not _kw_false(call, "blocking"):
-        recv = call.func.value if isinstance(call.func, ast.Attribute) \
-            else None
-        if recv is not None and dotted_name(recv) \
-                and "lock" in (dotted_name(recv) or "").lower():
-            return "blocking lock acquire"
-    if name in KNOWN_BLOCKING:
-        return KNOWN_BLOCKING[name]
-    return None
-
-
-def _build_summaries(index: _Index) -> None:
-    """Per-function (blocks, acquires) to a fixpoint."""
-    # direct facts + recorded resolvable call / property-load names
-    # (nested defs are pruned: each has its own FuncInfo, and a merely
-    # *defined* callback must not make its definer look blocking)
-    for fi in index.funcs:
-        for node in _walk_own(fi.node):
-            if isinstance(node, ast.With):
-                for item in node.items:
-                    if isinstance(item.context_expr, ast.Call):
-                        continue
-                    d = index.resolve_lock(item.context_expr, fi.module,
-                                           fi.cls, fi.name)
-                    if d is not None:
-                        fi.acquires.add(d.lock_id)
-            if isinstance(node, ast.Call):
-                reason = _direct_block_reason(index, node)
-                if reason is not None and fi.blocks is None:
-                    fi.blocks = reason
-                name = call_name(node)
-                if name and name not in SUMMARY_EXCLUDE:
-                    fi.calls.add(name)
-                # getattr(x, "prop", ...) is an attribute load in disguise
-                if name == "getattr" and len(node.args) >= 2 \
-                        and isinstance(node.args[1], ast.Constant) \
-                        and isinstance(node.args[1].value, str):
-                    fi.prop_loads.add(node.args[1].value)
-                if name == "acquire":
-                    recv = dotted_name(node.func.value) if isinstance(
-                        node.func, ast.Attribute) else None
-                    if recv:
-                        d = index.resolve_lock(node.func.value, fi.module,
-                                               fi.cls, fi.name)
-                        if d is not None:
-                            fi.acquires.add(d.lock_id)
-            if isinstance(node, ast.Attribute) \
-                    and isinstance(node.ctx, ast.Load):
-                fi.prop_loads.add(node.attr)
-
-    properties = {fi.name: fi for fi in index.funcs if fi.is_property}
-
-    def resolve_called(fi: FuncInfo) -> List[FuncInfo]:
-        # sorted: set iteration order is hash-randomized, and the first
-        # blocking callee found becomes the diagnostic's witness chain —
-        # the committed report must not churn between runs
-        out: List[FuncInfo] = []
-        for name in sorted(fi.calls):
-            init = index.class_init.get(name)
-            if init is not None:
-                out.append(init)
-                continue
-            out.extend(index.by_name.get(name, ()))
-        for name in sorted(fi.prop_loads):
-            p = properties.get(name)
-            if p is not None:
-                out.append(p)
-        return out
-
-    changed = True
-    rounds = 0
-    while changed and rounds < 30:
-        changed = False
-        rounds += 1
-        for fi in index.funcs:
-            for callee in resolve_called(fi):
-                if callee is fi:
-                    continue
-                if callee.blocks is not None and fi.blocks is None:
-                    fi.blocks = (f"calls {callee.name}() which can block "
-                                 f"({callee.blocks})")
-                    changed = True
-                new = callee.acquires - fi.acquires
-                if new:
-                    fi.acquires |= new
-                    changed = True
-
-
-def _analyze_holds(index: _Index, fi: FuncInfo,
+def _analyze_holds(cg: CallGraph, fi: FuncInfo,
                    edges: Dict[str, Dict[str, Tuple[str, int]]],
                    diags: List[Diagnostic]) -> None:
     """Walk one function; report blocking calls made while holding a
     lock and record acquisition-order edges."""
-    properties = {f.name: f for f in index.funcs if f.is_property}
-
-    def summaries_for(call: ast.Call) -> List[FuncInfo]:
-        name = call_name(call)
-        if not name or name in SUMMARY_EXCLUDE:
-            return []
-        init = index.class_init.get(name)
-        if init is not None:
-            return [init]
-        return list(index.by_name.get(name, ()))
 
     def add_edge(held: LockDef, acquired_id: str, line: int) -> None:
         if held.lock_id == acquired_id:
@@ -407,6 +91,37 @@ def _analyze_holds(index: _Index, fi: FuncInfo,
         edges.setdefault(held.lock_id, {}).setdefault(
             acquired_id, (fi.module, line))
 
+    def callee_effects(node: ast.Call, held: List[Tuple[LockDef, int]],
+                       block_line: int) -> None:
+        """Blocking + edge effects of one call's resolved summaries."""
+        reported = False
+        for res in cg.resolve_call(fi, node):
+            callee = res.func
+            if callee is fi:
+                continue
+            # can-block propagates at ANY confidence; a LOW witness is
+            # labeled so readers know the resolution was by name only
+            if callee.blocks is not None and not reported:
+                hedge = "" if res.confidence == HIGH \
+                    else " (name-resolved; low confidence)"
+                diags.append(Diagnostic(
+                    PASS_NAME, "blocking-under-lock", fi.module,
+                    node.lineno,
+                    f"{fi.name}: call to {callee.name}() can block "
+                    f"({callee.blocks}) while holding "
+                    f"{held[-1][0].lock_id}{hedge}",
+                    block_line=block_line))
+                reported = True
+            # lock-order edges are HIGH-confidence ONLY: resolution AND
+            # every propagation hop of the acquisition must be typed
+            if res.confidence != HIGH:
+                continue
+            for lock_id, conf in callee.acquires.items():
+                if conf != HIGH:
+                    continue
+                for h, _ln in held:
+                    add_edge(h, lock_id, node.lineno)
+
     def visit(node: ast.AST, held: List[Tuple[LockDef, int]]) -> None:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                 and node is not fi.node:
@@ -415,8 +130,7 @@ def _analyze_holds(index: _Index, fi: FuncInfo,
             new_held = list(held)
             for item in node.items:
                 if not isinstance(item.context_expr, ast.Call):
-                    d = index.resolve_lock(item.context_expr, fi.module,
-                                           fi.cls, fi.name)
+                    d = cg.resolve_lock(fi, item.context_expr)
                     if d is not None:
                         for h, _ln in new_held:
                             add_edge(h, d.lock_id, node.lineno)
@@ -427,8 +141,10 @@ def _analyze_holds(index: _Index, fi: FuncInfo,
                 visit(child, new_held)
             return
         if isinstance(node, ast.Call) and held:
+            # the innermost held lock anchors the finding: an allowlist
+            # block entry on an OUTER lock must not cover it
             block_line = held[-1][1]
-            reason = _direct_block_reason(index, node)
+            reason = direct_block_reason(cg, fi, node)
             if reason is not None:
                 diags.append(Diagnostic(
                     PASS_NAME, "blocking-under-lock", fi.module,
@@ -436,52 +152,52 @@ def _analyze_holds(index: _Index, fi: FuncInfo,
                     f"{fi.name}: {reason} while holding "
                     f"{held[-1][0].lock_id}", block_line=block_line))
             else:
-                for callee in summaries_for(node):
-                    if callee.blocks is not None:
-                        diags.append(Diagnostic(
-                            PASS_NAME, "blocking-under-lock", fi.module,
-                            node.lineno,
-                            f"{fi.name}: call to {callee.name}() can "
-                            f"block ({callee.blocks}) while holding "
-                            f"{held[-1][0].lock_id}",
-                            block_line=block_line))
-                        break
-            # lock-order edges through callees
-            seen_acquired: Set[str] = set()
-            for callee in summaries_for(node):
-                seen_acquired |= callee.acquires
-            name = call_name(node)
-            if name == "getattr" and len(node.args) >= 2 \
+                callee_effects(node, held, block_line)
+            # getattr(x, "prop") property load under the lock
+            if call_name(node) == "getattr" and len(node.args) >= 2 \
                     and isinstance(node.args[1], ast.Constant) \
-                    and node.args[1].value in properties:
-                p = properties[node.args[1].value]
-                seen_acquired |= p.acquires
-                if p.blocks is not None:
-                    diags.append(Diagnostic(
-                        PASS_NAME, "blocking-under-lock", fi.module,
-                        node.lineno,
-                        f"{fi.name}: property {p.name} can block "
-                        f"({p.blocks}) while holding "
-                        f"{held[-1][0].lock_id}", block_line=block_line))
-            for acq in seen_acquired:
-                for h, _ln in held:
-                    add_edge(h, acq, node.lineno)
+                    and isinstance(node.args[1].value, str):
+                _property_effects(node.args[1].value, node.args[0],
+                                  node.lineno, held, block_line)
         if isinstance(node, ast.Attribute) and held \
-                and isinstance(node.ctx, ast.Load) \
-                and node.attr in properties:
-            p = properties[node.attr]
-            if p.blocks is not None:
-                diags.append(Diagnostic(
-                    PASS_NAME, "blocking-under-lock", fi.module,
-                    node.lineno,
-                    f"{fi.name}: property {p.name} can block "
-                    f"({p.blocks}) while holding {held[-1][0].lock_id}",
-                    block_line=held[-1][1]))
-            for acq in p.acquires:
-                for h, _ln in held:
-                    add_edge(h, acq, node.lineno)
+                and isinstance(node.ctx, ast.Load):
+            _property_effects(node.attr, node.value, node.lineno, held,
+                              held[-1][1])
         for child in ast.iter_child_nodes(node):
             visit(child, held)
+
+    def _property_effects(attr: str, base: ast.AST, line: int,
+                          held: List[Tuple[LockDef, int]],
+                          block_line: int) -> None:
+        props = cg.properties.get(attr)
+        if not props:
+            return
+        # typed receiver narrows to the owning class's property (HIGH);
+        # otherwise every same-named property is a LOW candidate
+        ci = cg.receiver_class(fi, base)
+        if ci is not None:
+            m = ci.methods.get(attr)
+            cands = [Resolution(m, HIGH)] if m is not None \
+                and m.is_property else []
+        else:
+            cands = [Resolution(p, callgraph.LOW) for p in props]
+        for res in cands:
+            p = res.func
+            if p.blocks is not None:
+                diags.append(Diagnostic(
+                    PASS_NAME, "blocking-under-lock", fi.module, line,
+                    f"{fi.name}: property {p.name} can block "
+                    f"({p.blocks}) while holding {held[-1][0].lock_id}",
+                    block_line=block_line))
+                break
+        for res in cands:
+            if res.confidence != HIGH:
+                continue
+            for lock_id, conf in res.func.acquires.items():
+                if conf != HIGH:
+                    continue
+                for h, _ln in held:
+                    add_edge(h, lock_id, line)
 
     for stmt in fi.node.body:
         visit(stmt, [])
@@ -490,20 +206,19 @@ def _analyze_holds(index: _Index, fi: FuncInfo,
     # non-blocking acquire): treat lines after the acquire as held
     acq_line: Optional[int] = None
     acq_lock: Optional[LockDef] = None
-    for node in _walk_own(fi.node):
+    for node in walk_own(fi.node):
         if isinstance(node, ast.Call) and call_name(node) == "acquire" \
                 and isinstance(node.func, ast.Attribute):
-            d = index.resolve_lock(node.func.value, fi.module, fi.cls,
-                                   fi.name)
+            d = cg.resolve_lock(fi, node.func.value)
             if d is not None:
                 acq_line, acq_lock = node.lineno, d
                 break
     if acq_lock is not None:
-        for node in _walk_own(fi.node):
+        for node in walk_own(fi.node):
             if isinstance(node, ast.Call) and node.lineno > acq_line:
                 if call_name(node) in ("release", "acquire"):
                     continue
-                reason = _direct_block_reason(index, node)
+                reason = direct_block_reason(cg, fi, node)
                 if reason is not None:
                     diags.append(Diagnostic(
                         PASS_NAME, "blocking-under-lock", fi.module,
@@ -535,16 +250,12 @@ def _find_cycles(edges: Dict[str, Dict[str, Tuple[str, int]]]
 
 
 def run(ctx: AnalysisContext) -> List[Diagnostic]:
-    modules = {
-        rel: mod for rel, mod in ctx.modules.items()
-        if not rel.startswith("sonata_tpu")
-        or any(rel.startswith(p) for p in SCOPE_PREFIXES)}
-    index = _Index(modules)
-    _build_summaries(index)
+    cg = callgraph.graph_with_summaries(ctx)
     diags: List[Diagnostic] = []
     edges: Dict[str, Dict[str, Tuple[str, int]]] = {}
-    for fi in index.funcs:
-        _analyze_holds(index, fi, edges, diags)
+    for fi in cg.funcs:
+        if in_scope(fi.module):
+            _analyze_holds(cg, fi, edges, diags)
     for cycle in _find_cycles(edges):
         a, b = cycle[0], cycle[1]
         mod, line = edges[a][b]

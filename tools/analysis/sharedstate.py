@@ -1,0 +1,263 @@
+"""Pass 7: instance state mutated from ≥2 threaded entry points without
+a common lock.
+
+Every threaded module in this tree follows the same shape: a class owns
+worker/prober/reconciler threads (``threading.Thread(target=self._loop)``)
+whose loops run concurrently with the request path (the class's public
+methods, called from gRPC handler threads).  Any instance attribute
+both sides *write* is shared mutable state; unless every write happens
+under one common lock, the interleavings are unbounded — the
+fill-handle truncation and the prober-vs-request races this repo has
+paid for were exactly compound read-modify-writes on such attributes.
+
+The pass, per class that owns at least one thread root:
+
+- **Entry contexts.**  Each method used as a ``Thread`` target is a
+  context; every method reachable from it through receiver-typed
+  (HIGH) intra-class calls inherits that context.  All *public*
+  methods (no ``_`` prefix) that are not thread-internal form one
+  collapsed ``external`` context — the request path.
+- **Write sites.**  ``self.attr = …`` / ``self.attr += …`` outside
+  ``__init__``.  Methods named ``*_locked`` are skipped (the repo
+  convention: the caller holds the lock).  Infrastructure values
+  (``Lock()``/``Queue()``/``Event()``/``Thread(...)`` constructions)
+  and *atomic sentinel stores* (plain assignment of a ``True`` /
+  ``False`` / ``None`` constant — the monotonic flag-flip idiom, a
+  single atomic store in CPython) are not findings; the hazard class
+  is compound writes, not flag flips.
+- **The finding** (``unguarded-shared-write``): one attribute written
+  from two or more distinct contexts with no single lock common to
+  every write site (lexically held ``with``-stack, via the shared
+  resolver's class-qualified lock identities).
+- **Single-writer exemption.**  When every write lives in *one* method
+  and at most one thread context reaches it, the attribute is
+  thread-confined by the recorder idiom (``tick()`` is the thread
+  body; it is public only so tests can drive it synchronously) — a
+  race would need concurrent calls of that same method, which the
+  collapsed ``external`` context cannot witness.  Two distinct thread
+  roots reaching the writer, or a second writing method, still flag.
+
+Known limitation, by design: only ``self.attr`` assignment/augassign
+sites count — container mutation through methods (``self.buf.append``)
+and writes to *other* objects' attributes are out of scope for v2 (the
+lock-order pass covers the lock side of those patterns).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from . import callgraph
+from .callgraph import HIGH, CallGraph, ClassInfo, FuncInfo, walk_own
+from .core import AnalysisContext, Diagnostic, call_name, dotted_name
+
+PASS_NAME = "shared-state"
+
+_THREAD_CTORS = {"threading.Thread", "Thread", "threading.Timer", "Timer"}
+_INFRA_CTORS = set(_THREAD_CTORS) | {
+    "threading.Lock", "Lock", "threading.RLock", "RLock",
+    "threading.Event", "Event", "threading.Condition", "Condition",
+    "queue.Queue", "Queue", "queue.SimpleQueue", "SimpleQueue",
+    "queue.LifoQueue", "LifoQueue"}
+
+
+def _thread_roots_by_class(cg: CallGraph) -> Dict[str, Set[str]]:
+    """One sweep over the analyzed set: class key -> method names used
+    as ``Thread(target=...)`` (receiver-typed or same-class self)."""
+    roots: Dict[str, Set[str]] = {}
+    for fi in cg.funcs:
+        for node in walk_own(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            ctor = dotted_name(node.func) or (call_name(node) or "")
+            if ctor not in _THREAD_CTORS:
+                continue
+            for kw in node.keywords:
+                if kw.arg != "target":
+                    continue
+                v = kw.value
+                if not isinstance(v, ast.Attribute):
+                    continue
+                owner = cg.receiver_class(fi, v.value)
+                if owner is None and fi.cls is not None \
+                        and isinstance(v.value, ast.Name) \
+                        and v.value.id == "self":
+                    owner = cg.classes.get(f"{fi.module}:{fi.cls}")
+                if owner is not None and v.attr in owner.methods:
+                    roots.setdefault(owner.key, set()).add(v.attr)
+    return roots
+
+
+def _intra_edges(cg: CallGraph, ci: ClassInfo) -> Dict[str, Set[str]]:
+    """method -> same-class methods it calls through a HIGH (typed)
+    resolution; each method body is resolved exactly once per run."""
+    edges: Dict[str, Set[str]] = {}
+    for name, fi in ci.methods.items():
+        outs: Set[str] = set()
+        for node in walk_own(fi.node):
+            if isinstance(node, ast.Call):
+                for res in cg.resolve_call(fi, node,
+                                           allow_fallback=False):
+                    if res.confidence == HIGH \
+                            and res.func.cls == ci.name \
+                            and res.func.module == ci.module:
+                        outs.add(res.func.name)
+        edges[name] = outs
+    return edges
+
+
+def _reach(edges: Dict[str, Set[str]], entry: str) -> Set[str]:
+    seen: Set[str] = set()
+    stack = [entry]
+    while stack:
+        name = stack.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        stack.extend(edges.get(name, ()))
+    return seen
+
+
+def _is_sentinel_store(value: ast.AST) -> bool:
+    return isinstance(value, ast.Constant) \
+        and (value.value is None or value.value is True
+             or value.value is False)
+
+
+def _is_infra_value(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    ctor = dotted_name(value.func) or (call_name(value) or "")
+    return ctor in _INFRA_CTORS
+
+
+class _WriteSite:
+    __slots__ = ("method", "line", "locks")
+
+    def __init__(self, method: str, line: int, locks: FrozenSet[str]):
+        self.method = method
+        self.line = line
+        self.locks = locks
+
+
+def _write_sites(cg: CallGraph, ci: ClassInfo
+                 ) -> Dict[str, List[_WriteSite]]:
+    """attr -> write sites with the lexically-held lock set at each."""
+    out: Dict[str, List[_WriteSite]] = {}
+
+    def record(fi: FuncInfo, target: ast.AST, value: Optional[ast.AST],
+               line: int, locks: FrozenSet[str],
+               is_aug: bool) -> None:
+        if not (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+            return
+        if not is_aug and value is not None and (
+                _is_sentinel_store(value) or _is_infra_value(value)):
+            return
+        out.setdefault(target.attr, []).append(
+            _WriteSite(fi.name, line, locks))
+
+    for name, fi in ci.methods.items():
+        if name == "__init__" or name.endswith("_locked"):
+            continue
+
+        def visit(node: ast.AST, held: FrozenSet[str],
+                  fi: FuncInfo = fi) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fi.node:
+                return
+            if isinstance(node, ast.With):
+                new_held = set(held)
+                for item in node.items:
+                    if not isinstance(item.context_expr, ast.Call):
+                        d = cg.resolve_lock(fi, item.context_expr)
+                        if d is not None:
+                            new_held.add(d.lock_id)
+                for child in node.body:
+                    visit(child, frozenset(new_held))
+                return
+            if isinstance(node, ast.Assign):
+                targets = []
+                for t in node.targets:
+                    if isinstance(t, ast.Tuple):
+                        targets.extend(t.elts)
+                    else:
+                        targets.append(t)
+                for t in targets:
+                    record(fi, t, node.value, node.lineno, held, False)
+            elif isinstance(node, ast.AugAssign):
+                record(fi, node.target, node.value, node.lineno, held,
+                       True)
+            elif isinstance(node, ast.AnnAssign) \
+                    and node.value is not None:
+                record(fi, node.target, node.value, node.lineno, held,
+                       False)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in fi.node.body:
+            visit(stmt, frozenset())
+    return out
+
+
+def run(ctx: AnalysisContext) -> List[Diagnostic]:
+    cg = callgraph.graph_with_summaries(ctx)
+    diags: List[Diagnostic] = []
+    roots_by_class = _thread_roots_by_class(cg)
+    for ci in cg.classes.values():
+        roots = roots_by_class.get(ci.key)
+        if not roots:
+            continue
+        edges = _intra_edges(cg, ci)
+        #: method name -> set of context labels
+        contexts: Dict[str, Set[str]] = {}
+        for r in sorted(roots):
+            for m in _reach(edges, r):
+                contexts.setdefault(m, set()).add(f"thread:{r}")
+        external_entries = [m for m in ci.methods
+                            if not m.startswith("_") and m not in roots]
+        ext_seen: Set[str] = set()
+        for e in external_entries:
+            ext_seen |= _reach(edges, e)
+        for m in ext_seen:
+            contexts.setdefault(m, set()).add("external")
+
+        for attr, sites in sorted(_write_sites(cg, ci).items()):
+            ctxs: Set[str] = set()
+            for s in sites:
+                ctxs |= contexts.get(s.method, set())
+            if len(ctxs) < 2:
+                continue
+            # single-writer discipline: every write in ONE method that
+            # only ONE thread context reaches (the ``tick()`` idiom —
+            # the recorder thread calls it, it is public for tests).
+            # A write-write race would need concurrent calls to that
+            # same method, which the collapsed "external" context
+            # cannot witness; two *distinct* thread roots reaching the
+            # writer, or a second writing method, still flag.
+            writers = {s.method for s in sites}
+            thread_ctxs = {c for c in ctxs if c != "external"}
+            if len(writers) == 1 and len(thread_ctxs) <= 1:
+                continue
+            common = None
+            for s in sites:
+                common = s.locks if common is None else common & s.locks
+            if common:
+                continue  # one lock guards every write
+            unguarded = [s for s in sites if not s.locks] or sites
+            site = unguarded[0]
+            diags.append(Diagnostic(
+                PASS_NAME, "unguarded-shared-write", ci.module,
+                site.line,
+                f"{ci.name}.{attr} is written from "
+                f"{len(ctxs)} threaded entry points "
+                f"({', '.join(sorted(ctxs))}) with no common lock — "
+                f"writes in {sorted({s.method for s in sites})}; "
+                "guard every write with one lock or confine the "
+                "attribute to a single thread"))
+    unique: Dict[Tuple, Diagnostic] = {}
+    for d in diags:
+        unique.setdefault((d.code, d.file, d.line, d.message), d)
+    return sorted(unique.values(), key=lambda d: (d.file, d.line))

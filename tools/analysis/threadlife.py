@@ -1,0 +1,256 @@
+"""Pass 8: thread lifecycle discipline — explicit daemon, reachable drain.
+
+Two thread-leak classes this repo has already paid for (the PR 2/3
+prober-vs-shutdown leak, the wedged-prober incidents the mesh close path
+now drains) reduce to two checkable rules at every
+``threading.Thread(...)`` construction site:
+
+- **``daemon`` is explicit** (``daemon-unset``).  The default is
+  inherited from the creating thread, which makes lifetime depend on
+  *who* constructed the object — a pool built from a worker thread
+  silently flips semantics.  Say what you mean: ``daemon=True`` for
+  threads the process may abandon, ``daemon=False`` for threads a
+  drain path owns.  A ``t.daemon = …`` assignment before ``start()``
+  counts.
+- **a drain/close path can reach the thread** (``undrained-thread``).
+  The thread object must be joinable from teardown: stored to an
+  attribute (or appended to a list attribute) that some analyzed
+  method ``join()``s — directly (``self._thread.join(…)``), through a
+  local alias (``t, self._t = self._t, None; t.join(…)``,
+  ``getattr(obj, "_thread")``), or by iterating the list
+  (``for t in self._probers: t.join(…)``) — or a local joined in its
+  creating function.  This is the prober/reconciler discipline
+  (create → signal → join with timeout), enforced instead of
+  remembered.
+
+Teardown helpers are exempt from the join rule: a thread whose
+``target`` name matches ``drain``/``stop``/``shutdown``/``close`` *is*
+the drain path (the gRPC SIGTERM drain thread, the replica pool's
+off-thread scheduler shutdown) — requiring the drain path to drain
+itself is circular.  They still must set ``daemon`` explicitly.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import callgraph
+from .callgraph import CallGraph, FuncInfo, walk_own
+from .core import AnalysisContext, Diagnostic, call_name, dotted_name
+
+PASS_NAME = "thread-life"
+
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+_TEARDOWN_RE = re.compile(r"(drain|stop|shutdown|close)", re.IGNORECASE)
+
+
+def _target_name(call: ast.Call) -> Optional[str]:
+    for kw in call.keywords:
+        if kw.arg == "target":
+            name = dotted_name(kw.value)
+            if name is not None:
+                return name.split(".")[-1]
+            if isinstance(kw.value, ast.Lambda):
+                return "<lambda>"
+    return None
+
+
+def _joined_names(cg: CallGraph) -> Tuple[Set[str], Set[Tuple[str, str]]]:
+    """(attribute names, (module, function) local names) that some
+    analyzed code calls ``.join()`` on — directly, through a local
+    alias of an attribute, or through a loop over a list attribute."""
+    attrs: Set[str] = set()
+    local_joins: Set[Tuple[str, str, str]] = set()
+    for fi in cg.funcs:
+        #: local name -> source attribute it aliases
+        aliases: Dict[str, str] = {}
+        #: local name -> list attribute it iterates
+        loop_over: Dict[str, str] = {}
+        # sweep 1: aliases/loops (walk_own order is not source order,
+        # so the tables must be complete before any join is judged)
+        for node in walk_own(fi.node):
+            if isinstance(node, ast.Assign):
+                # pairwise tuple unpacking: t, self._t = self._t, None
+                pairs: List[Tuple[ast.AST, ast.AST]] = []
+                for t in node.targets:
+                    if isinstance(t, ast.Tuple) \
+                            and isinstance(node.value, ast.Tuple) \
+                            and len(t.elts) == len(node.value.elts):
+                        pairs.extend(zip(t.elts, node.value.elts))
+                    else:
+                        pairs.append((t, node.value))
+                for tgt, val in pairs:
+                    if not isinstance(tgt, ast.Name):
+                        continue
+                    if isinstance(val, ast.Attribute):
+                        aliases[tgt.id] = val.attr
+                    elif isinstance(val, ast.Call) \
+                            and call_name(val) == "getattr" \
+                            and len(val.args) >= 2 \
+                            and isinstance(val.args[1], ast.Constant) \
+                            and isinstance(val.args[1].value, str):
+                        aliases[tgt.id] = val.args[1].value
+            elif isinstance(node, ast.For) \
+                    and isinstance(node.target, ast.Name) \
+                    and isinstance(node.iter, ast.Attribute):
+                loop_over[node.target.id] = node.iter.attr
+        # sweep 2: join() receivers, resolved through the tables
+        for node in walk_own(fi.node):
+            if isinstance(node, ast.Call) \
+                    and call_name(node) == "join" \
+                    and isinstance(node.func, ast.Attribute):
+                recv = node.func.value
+                if isinstance(recv, ast.Attribute):
+                    attrs.add(recv.attr)
+                elif isinstance(recv, ast.Name):
+                    if recv.id in aliases:
+                        attrs.add(aliases[recv.id])
+                    elif recv.id in loop_over:
+                        attrs.add(loop_over[recv.id])
+                    else:
+                        local_joins.add((fi.module, fi.name, recv.id))
+    return attrs, {(m, f, n) for (m, f, n) in local_joins}
+
+
+def run(ctx: AnalysisContext) -> List[Diagnostic]:
+    cg = callgraph.graph_with_summaries(ctx)
+    joined_attrs, local_joins = _joined_names(cg)
+    diags: List[Diagnostic] = []
+
+    for fi in cg.funcs:
+        #: attrs holding lists that threads get appended to
+        for node in walk_own(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            ctor = dotted_name(node.func) or (call_name(node) or "")
+            if ctor not in _THREAD_CTORS:
+                continue
+            # find where the thread object lands
+            stored_attr: Optional[str] = None
+            stored_local: Optional[str] = None
+            orig_local: Optional[str] = None
+            daemon_kw = any(kw.arg == "daemon" for kw in node.keywords)
+            parent = _assignment_target(fi, node)
+            if parent is not None:
+                kind, name = parent
+                if kind == "attr":
+                    stored_attr = name
+                else:
+                    stored_local = orig_local = name
+                    # a local later published to an attribute
+                    # (t = Thread(...); server.X = t) is attr-stored
+                    pub = _published_attr(fi, name)
+                    if pub is not None:
+                        stored_attr, stored_local = pub, None
+            daemon_set = daemon_kw or _daemon_assigned_later(
+                fi, node, stored_attr, orig_local)
+            if not daemon_set:
+                diags.append(Diagnostic(
+                    PASS_NAME, "daemon-unset", fi.module, node.lineno,
+                    f"{fi.name}: threading.Thread(...) without an "
+                    "explicit daemon= — lifetime inherits from the "
+                    "creating thread; state daemon=True (abandonable) "
+                    "or daemon=False (a drain path owns the join)"))
+            # drain reachability
+            target = _target_name(node)
+            if target is not None and _TEARDOWN_RE.search(target):
+                continue  # the thread IS a teardown path
+            drained = False
+            if stored_attr is not None:
+                drained = stored_attr in joined_attrs
+                if not drained:
+                    # appended to a list attribute that gets joined?
+                    drained = _appended_list_attr(
+                        fi, stored_attr) in joined_attrs
+            elif stored_local is not None:
+                drained = (fi.module, fi.name,
+                           stored_local) in local_joins
+                if not drained:
+                    la = _appended_list_attr(fi, stored_local)
+                    drained = la is not None and la in joined_attrs
+            if not drained:
+                where = (f"self.{stored_attr}" if stored_attr
+                         else stored_local or "an unnamed Thread")
+                diags.append(Diagnostic(
+                    PASS_NAME, "undrained-thread", fi.module,
+                    node.lineno,
+                    f"{fi.name}: {where} is never join()ed from any "
+                    "analyzed drain/close path — a wedged or leaked "
+                    "thread is invisible at shutdown; store it and "
+                    "join (with a timeout) from the owner's "
+                    "close/stop, or make it a teardown helper"))
+    unique: Dict[Tuple, Diagnostic] = {}
+    for d in diags:
+        unique.setdefault((d.code, d.file, d.line, d.message), d)
+    return sorted(unique.values(), key=lambda d: (d.file, d.line))
+
+
+def _assignment_target(fi: FuncInfo, thread_call: ast.Call
+                       ) -> Optional[Tuple[str, str]]:
+    """Where the Thread(...) value is stored: ('attr', name) for
+    ``self.X = Thread(...)`` (or ``x.X = ...``), ('local', name) for
+    ``t = Thread(...)``; None for fire-and-forget ``Thread(...).start()``."""
+    for node in walk_own(fi.node):
+        if isinstance(node, ast.Assign) and node.value is thread_call:
+            t = node.targets[0]
+            if isinstance(t, ast.Attribute):
+                return ("attr", t.attr)
+            if isinstance(t, ast.Name):
+                return ("local", t.id)
+        if isinstance(node, ast.AnnAssign) and node.value is thread_call:
+            if isinstance(node.target, ast.Attribute):
+                return ("attr", node.target.attr)
+            if isinstance(node.target, ast.Name):
+                return ("local", node.target.id)
+    return None
+
+
+def _published_attr(fi: FuncInfo, local: str) -> Optional[str]:
+    """Attribute a local thread is published to: ``x.Y = local`` -> 'Y'."""
+    for node in walk_own(fi.node):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == local:
+            for t in node.targets:
+                if isinstance(t, ast.Attribute):
+                    return t.attr
+    return None
+
+
+def _daemon_assigned_later(fi: FuncInfo, thread_call: ast.Call,
+                           attr: Optional[str],
+                           local: Optional[str]) -> bool:
+    """``t.daemon = …`` / ``self.X.daemon = …`` after construction."""
+    for node in walk_own(fi.node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "daemon":
+                    base = t.value
+                    if local is not None and isinstance(base, ast.Name) \
+                            and base.id == local:
+                        return True
+                    if attr is not None \
+                            and isinstance(base, ast.Attribute) \
+                            and base.attr == attr:
+                        return True
+    return False
+
+
+def _appended_list_attr(fi: FuncInfo, local_or_attr: str
+                        ) -> Optional[str]:
+    """List attribute that ``local_or_attr`` gets appended to:
+    ``self.X.append(t)`` -> 'X'."""
+    for node in walk_own(fi.node):
+        if isinstance(node, ast.Call) and call_name(node) == "append" \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Attribute) \
+                and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Name) and arg.id == local_or_attr:
+                return node.func.value.attr
+            if isinstance(arg, ast.Attribute) \
+                    and arg.attr == local_or_attr:
+                return node.func.value.attr
+    return None

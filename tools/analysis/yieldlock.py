@@ -1,0 +1,59 @@
+"""Pass 6: ``yield`` inside a ``with <lock>:`` body.
+
+A generator that yields while holding a lock suspends *with the lock
+held* and does not resume until the caller asks for the next item — or
+never resumes at all, if the caller abandons the iterator.  Between the
+yield and the resume, arbitrary caller code runs (stream writes to a
+slow client, another RPC, a GC pause) while every other thread
+contending for that lock is stalled; an abandoned generator leaks the
+hold until finalization.  The tee/fill-wrapper pattern in the gRPC
+frontend (a wrapper generator interposed on the stream path) is exactly
+the shape where this bites: the fill handle truncation incident started
+as a wrapper that held state it should have released before yielding.
+
+The rule: no ``yield`` / ``yield from`` lexically inside the body of a
+``with`` statement whose context manager resolves to a lock (class
+attribute, module global, or lock-ish local — the shared resolver's
+lock table).  The fix is almost always to copy what the lock guards
+into locals, release, then yield:
+
+    with self._lock:                  with self._lock:
+        for item in self._buf:   →        items = list(self._buf)
+            yield item                for item in items:
+                                          yield item
+
+Call-shaped context managers (``with tracing.span(...):``,
+``with closing(...)``) are not locks and are not findings — yielding
+inside a trace span is the streaming idiom this tree is built on.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from . import callgraph
+from .core import AnalysisContext, Diagnostic
+
+PASS_NAME = "yield-lock"
+
+
+def run(ctx: AnalysisContext) -> List[Diagnostic]:
+    cg = callgraph.graph_with_summaries(ctx)
+    diags: List[Diagnostic] = []
+    for fi in cg.funcs:
+        seen: set = set()
+        for lock_id, yield_line, with_line in fi.lock_yields:
+            if (lock_id, yield_line) in seen:
+                continue
+            seen.add((lock_id, yield_line))
+            diags.append(Diagnostic(
+                PASS_NAME, "yield-under-lock", fi.module, yield_line,
+                f"{fi.name}: yield while holding {lock_id} — the "
+                "generator suspends with the lock held and arbitrary "
+                "caller code runs before (if ever) it resumes; copy "
+                "under the lock, release, then yield",
+                block_line=with_line))
+    unique: Dict[Tuple, Diagnostic] = {}
+    for d in diags:
+        unique.setdefault((d.code, d.file, d.line, d.message), d)
+    return sorted(unique.values(), key=lambda d: (d.file, d.line))

@@ -672,7 +672,7 @@ def main() -> int:
     check("mesh: injected route errors fail typed", route_errs == 3)
     check("mesh: route errors tripped the node breaker",
           mnode.state == OPEN and mrouter.stats["breaker_opens"] >= 1,
-          f"({mnode.view()})")
+          f"({mnode.snapshot()})")
     # pin the OPEN window: the 0.5 s probe backoff would otherwise race
     # the readyz check below (a clean probe flips half-open the moment
     # next_probe_at passes — that recovery is exactly what the phase
@@ -730,12 +730,12 @@ def main() -> int:
     while time.monotonic() < deadline and mnode.state == OPEN:
         time.sleep(0.1)
     check("mesh: re-probe flips the breaker half-open",
-          mnode.state != OPEN, f"({mnode.view()})")
+          mnode.state != OPEN, f"({mnode.snapshot()})")
     results, trailers, err = mesh_synth(TEXTS[2])
     check("mesh: trial request closes the breaker end to end",
           err is None and results and mnode.state == CLOSED
           and mrouter.stats["recovered"] >= 1,
-          f"({mnode.view()}, {err.code().name if err else 'ok'})")
+          f"({mnode.snapshot()}, {err.code().name if err else 'ok'})")
     code, _ = http_get(mbase + "/readyz")
     check("mesh: router readyz recovers with the node", code == 200,
           f"(code {code})")
@@ -803,7 +803,7 @@ def main() -> int:
     check("placement: probes scrape the loaded-voice set from /readyz",
           prouter.nodes[0].loaded_voices is not None
           and voice_id in prouter.nodes[0].loaded_voices,
-          f"({prouter.nodes[0].view()})")
+          f"({prouter.nodes[0].snapshot()})")
     def assigned_indexes() -> list:
         # the phantom scrapes the real node's sonata_node_info, so both
         # entries share a node_id string — identity checks go by the
@@ -820,7 +820,7 @@ def main() -> int:
           "holder (replicas=1)",
           assigned_indexes() == [0]
           and plane.converged_count(voice_id) == 1,
-          f"({plane.placement_view()['voices']})")
+          f"({plane.snapshot()['voices']})")
 
     # mesh.reconcile:error — three injected cycle errors must count
     # toward THAT node's breaker (threshold 3) like failed probes
@@ -831,7 +831,7 @@ def main() -> int:
     check("placement: reconcile errors tripped the holder's breaker",
           prouter.nodes[0].state == OPEN
           and prouter.nodes[1].state == CLOSED,
-          f"({prouter.nodes[0].view()})")
+          f"({prouter.nodes[0].snapshot()})")
     check("placement: mesh.reconcile fires counted",
           fires_total().get("mesh.reconcile", 0) == rec0 + 3,
           f"({fires_total()})")
@@ -846,7 +846,7 @@ def main() -> int:
           assigned_indexes() == [1]
           and plane.converged_count(voice_id) == 1
           and plane.stats["evictions_unplaced"] == 1,
-          f"({plane.placement_view()['voices']}, {plane.stats})")
+          f"({plane.snapshot()['voices']}, {plane.stats})")
 
     # mesh.reconcile:hang — a hung cycle stalls only its own node's
     # reconcile (per-node prober isolation); the 400 ms cap converts it
@@ -990,7 +990,7 @@ def main() -> int:
     check("tenancy: labeled request serves under an enabled table",
           err is None and served and len(served[0]) > 0
           and tt_rt.tenancy.stat("chaos-a", "admitted") == 1,
-          f"({tt_rt.tenancy.debug_doc()['tenants'].get('chaos-a')})")
+          f"({tt_rt.tenancy.snapshot()['tenants'].get('chaos-a')})")
     classify0 = fires_total().get("tenancy.classify", 0)
     arm_spec("tenancy.classify:error:1::2")
     served, err = tt_synth(TEXTS[1], "chaos-a")  # classification errors
@@ -1011,7 +1011,7 @@ def main() -> int:
     check("tenancy: disarmed classification attributes correctly again",
           err is None and served
           and tt_rt.tenancy.stat("chaos-b", "admitted") == 1,
-          f"({tt_rt.tenancy.debug_doc()['tenants'].get('chaos-b')})")
+          f"({tt_rt.tenancy.snapshot()['tenants'].get('chaos-b')})")
     tt_channel.close()
     tt_server.stop(grace=None)
     tt_server.sonata_service.shutdown()

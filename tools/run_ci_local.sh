@@ -3,9 +3,12 @@
 #
 # Mirrors .github/workflows/ci.yml step by step:
 #   1. "Static analysis (sonata-lint)" — python -m tools.analysis: the
-#      lock-order / host-sync / knob-registry / metric-registry passes
-#      over the tree, blocking; the machine-readable report lands in
-#      tools/analysis_report.json (committed like the bench artifacts)
+#      eight-pass suite (lock-order / host-sync / knobs / metrics /
+#      failpoints / yield-lock / shared-state / thread-life) on the
+#      shared class-aware resolver, blocking, with --timing gated on
+#      the committed budget; the machine-readable report must equal
+#      the committed tools/analysis_report.json (freshness assert —
+#      a stale artifact is refreshed but still fails the step)
 #   2. "Run test suite"  — python -m pytest tests/ -q
 #   3. "Compile check (graft entry, CPU)" — dryrun_multichip on the
 #      virtual 8-device CPU mesh
@@ -79,11 +82,23 @@ print(f"env: python {sys.version.split()[0]}, jax {jax.__version__}")
 EOF
 
 echo "-- step 1/7: static analysis (sonata-lint)" | tee -a "$LOG"
-# one analysis run: findings into the log, the machine-readable report
-# (committed next to the bench artifacts) via --report, one gated rc
-python -m tools.analysis --report tools/analysis_report.json 2>&1 \
+# one analysis run: findings into the log, per-pass wall time gated
+# against the committed budget (--timing), and the machine-readable
+# report via --report.  The committed tools/analysis_report.json must
+# equal a fresh run — a drift means code changed without re-running
+# the lane; the script refreshes the artifact but still FAILS so the
+# update lands in the same commit as the change that caused it.
+fresh_report=$(mktemp)
+python -m tools.analysis --timing --report "$fresh_report" 2>&1 \
     | tee -a "$LOG"
 rc_lint=${PIPESTATUS[0]}
+if ! cmp -s "$fresh_report" tools/analysis_report.json; then
+    echo "sonata-lint: tools/analysis_report.json is STALE —" \
+         "refreshed; commit the update" | tee -a "$LOG"
+    cp "$fresh_report" tools/analysis_report.json
+    rc_lint=1
+fi
+rm -f "$fresh_report"
 
 echo "-- step 2/7: python -m pytest tests/ -q $*" | tee -a "$LOG"
 JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@" 2>&1 | tee -a "$LOG"

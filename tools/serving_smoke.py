@@ -1155,7 +1155,7 @@ def main(args=None) -> int:
         time.sleep(0.1)
     check("mesh: trial request closes the rejoined node's breaker",
           bool(results) and all(n.state == NODE_CLOSED for n in router.nodes),
-          f"({[n.view() for n in router.nodes]})")
+          f"({[n.snapshot() for n in router.nodes]})")
 
     # SIGKILL under 8 concurrent streams (the acceptance bar): a dead
     # process loses ZERO not-yet-streaming requests — they reroute —
@@ -1187,7 +1187,7 @@ def main(args=None) -> int:
     check("mesh: killed node leaves membership (breaker open)",
           router.routable_count() == 1
           and any(n.state == NODE_OPEN for n in router.nodes),
-          f"({[n.view() for n in router.nodes]})")
+          f"({[n.snapshot() for n in router.nodes]})")
     code, _ = http_get(mesh_base + "/readyz")
     check("mesh: router readyz 200 after the kill (one healthy node)",
           code == 200, f"(code {code})")
